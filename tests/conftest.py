@@ -1,0 +1,19 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU platform.
+
+Multi-chip TPU hardware is not available in CI; all sharding/collective tests
+run against ``--xla_force_host_platform_device_count=8`` CPU devices, which
+exercises the same Mesh/pjit/shard_map code paths XLA uses on a real slice.
+Must run before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,162 @@
+"""Selector/allocator tests — the rebuild analog of Gaia's Exp.1-4
+correctness runs (Gaia PDF §IV Tables I-IV; SURVEY.md §4): deterministic
+repetition, staged occupancy fixtures, and zero invalid choices."""
+
+import pytest
+
+from tputopo.topology import Allocator, ChipTopology, enumerate_shapes
+from tputopo.topology.slices import box_chips, enumerate_placements
+
+
+def v5p32():
+    """The BASELINE.json target: v5p-32 == 16 chips as a 2x2x4 box."""
+    return ChipTopology.build("v5p", (2, 2, 4))
+
+
+def test_shape_enumeration_prefers_compact():
+    t = v5p32()
+    shapes = enumerate_shapes(t, 8)
+    assert shapes[0].dims == (2, 2, 2)  # most bandwidth for 8 chips
+    assert all(s.num_chips == 8 for s in shapes)
+    shapes4 = enumerate_shapes(t, 4)
+    assert shapes4[0].dims in ((2, 2, 1), (1, 2, 2), (2, 1, 2))
+
+
+def test_placement_enumeration_respects_occupancy():
+    t = v5p32()
+    alloc = Allocator(t)
+    shape = enumerate_shapes(t, 8)[0]
+    free_all = enumerate_placements(t, shape, alloc.free)
+    assert len(free_all) == 3  # 2x2x2 slides along z only: offsets 0,1,2
+    alloc.mark_used([(0, 0, 0)])
+    fewer = enumerate_placements(t, shape, alloc.free)
+    assert len(fewer) == 2
+
+
+def test_allocate_full_slice():
+    t = v5p32()
+    alloc = Allocator(t)
+    p = alloc.allocate(16)
+    assert p is not None and p.is_contiguous_box
+    assert p.dims == (2, 2, 4)
+    assert len(alloc.free) == 0
+    assert alloc.allocate(1) is None  # exhausted
+
+
+def test_deterministic_repetition_like_gaia_exp1():
+    # Gaia Exp.1: 500 repetitions, invalid choices must be zero
+    # (PDF §IV Table I).  Ours is deterministic: identical every time.
+    results = set()
+    for _ in range(100):
+        alloc = Allocator(v5p32())
+        p = alloc.allocate(8)
+        results.add(p.chips)
+    assert len(results) == 1
+    chips = next(iter(results))
+    assert len(chips) == 8
+
+
+def test_singular_anti_fragmentation():
+    # Gaia Exp.3 analog (PDF Alg.3, Table III): a 1-chip request must not
+    # break up a pristine region when a tighter spot exists.
+    t = v5p32()
+    alloc = Allocator(t)
+    # Occupy the z=0 plane except one chip: that hole is the tight spot.
+    alloc.mark_used([(0, 0, 0), (0, 1, 0), (1, 0, 0)])
+    p = alloc.allocate(1)
+    assert p.chips == ((1, 1, 0),)  # fills the hole, not the open region
+
+
+def test_pair_request_prefers_adjacent():
+    # Gaia Exp.4 analog (PDF Alg.4, Table IV) / BASELINE config 2.
+    t = v5p32()
+    alloc = Allocator(t)
+    p = alloc.allocate(2)
+    assert p is not None
+    a, b = p.chips
+    assert t.hop_distance(a, b) == 1
+
+
+def test_gang_4x4_disjoint_contiguous():
+    # BASELINE config 4: gang-schedule 4 x (4-chip) DP replicas on v5p-32.
+    t = v5p32()
+    alloc = Allocator(t)
+    gang = alloc.allocate_gang(4, 4)
+    assert gang is not None and len(gang) == 4
+    seen = set()
+    for p in gang:
+        assert p.is_contiguous_box
+        assert len(p.chips) == 4
+        assert not (seen & set(p.chips))  # disjoint
+        seen.update(p.chips)
+    assert len(seen) == 16  # tiles the whole slice
+
+
+def test_gang_all_or_nothing():
+    t = v5p32()
+    alloc = Allocator(t)
+    alloc.mark_used(box_chips(t, (0, 0, 0), (2, 2, 1)))  # 4 chips gone
+    assert alloc.find_gang(4, 4) is None  # only 12 chips left
+    assert len(alloc.free) == 12  # nothing was consumed by the failed gang
+    gang = alloc.allocate_gang(3, 4)
+    assert gang is not None
+
+
+def test_blob_fallback_for_non_box_k():
+    # k=7 admits no box in 2x2x4; fallback must return a *connected* set.
+    t = v5p32()
+    alloc = Allocator(t)
+    p = alloc.allocate(7)
+    assert p is not None and len(p.chips) == 7
+    assert not p.is_contiguous_box
+    # connectivity check
+    chips = set(p.chips)
+    frontier = [next(iter(chips))]
+    seen = {frontier[0]}
+    while frontier:
+        c = frontier.pop()
+        for n in t.neighbors(c):
+            if n in chips and n not in seen:
+                seen.add(n)
+                frontier.append(n)
+    assert seen == chips
+
+
+def test_packing_survives_fragmentation_pressure():
+    # SURVEY.md §7 hard part 1: allocate/release churn must keep a 2x2x2
+    # request satisfiable when 8 chips are free.
+    t = v5p32()
+    alloc = Allocator(t)
+    p1 = alloc.allocate(4)
+    p2 = alloc.allocate(2)
+    p3 = alloc.allocate(2)
+    assert len(alloc.free) == 8
+    p = alloc.find(8)
+    assert p is not None, "anti-fragmentation packing should leave a free 8-box"
+    assert p.is_contiguous_box
+
+
+def test_largest_free_box_metric():
+    t = v5p32()
+    alloc = Allocator(t)
+    vol, dims = alloc.largest_free_box()
+    assert vol == 16
+    alloc.allocate(8)
+    vol2, dims2 = alloc.largest_free_box()
+    assert vol2 == 8
+
+
+def test_release_returns_capacity():
+    t = v5p32()
+    alloc = Allocator(t)
+    p = alloc.allocate(16)
+    assert alloc.find(1) is None
+    alloc.release(p.chips)
+    assert alloc.allocate(16) is not None
+
+
+def test_invalid_requests():
+    alloc = Allocator(v5p32())
+    with pytest.raises(ValueError):
+        alloc.find(0)
+    assert alloc.find(17) is None

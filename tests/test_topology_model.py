@@ -1,0 +1,122 @@
+"""Topology model unit tests.
+
+Mirrors the reference test strategy's first rung (SURVEY.md §4.1): topology
+model / cost table as pure functions, with property tests for the invariants
+the design states (symmetric matrix -> symmetric hop distance; the 1-device
+no-topology convention, design.md:17-19).
+"""
+
+import pytest
+
+from tputopo.topology import (
+    ChipTopology,
+    LinkType,
+    classify_link,
+    get_generation,
+    parse_topology,
+)
+from tputopo.topology.model import format_topology
+
+
+def test_generation_registry():
+    for name in ("v4", "v5e", "v5p", "v6e"):
+        g = get_generation(name)
+        assert g.name == name
+        assert len(g.max_dims) == g.ndims
+        assert len(g.host_bounds) == g.ndims
+        assert g.ici_link_gbps > 0
+    with pytest.raises(KeyError):
+        get_generation("v99")
+
+
+def test_slice_naming_counts_cores():
+    # v5p-32 == 16 chips (2 cores/chip) — the BASELINE.json 2x2x4 target.
+    assert get_generation("v5p").slice_name(16) == "v5p-32"
+    assert get_generation("v5e").slice_name(8) == "v5e-8"
+
+
+def test_build_and_indexing_roundtrip():
+    t = ChipTopology.build("v5p", (2, 2, 4))
+    assert t.num_chips == 16
+    assert len(t.chips) == 16
+    for i, c in enumerate(t.chips):
+        assert t.index(c) == i
+        assert t.coord(i) == c
+
+
+def test_neighbors_open_mesh_corner_and_interior():
+    t = ChipTopology.build("v5p", (2, 2, 4))  # no wraparound (not full pod)
+    assert t.wrap == (False, False, False)
+    corner = (0, 0, 0)
+    assert sorted(t.neighbors(corner)) == [(0, 0, 1), (0, 1, 0), (1, 0, 0)]
+    interior = (0, 0, 1)
+    assert len(t.neighbors(interior)) == 4
+
+
+def test_wraparound_on_full_axis():
+    # Full 16x16 v5e pod wraps both axes.
+    t = ChipTopology.build("v5e", (16, 16))
+    assert t.wrap == (True, True)
+    assert (15, 0) in t.neighbors((0, 0))
+    assert (0, 15) in t.neighbors((0, 0))
+    assert t.hop_distance((0, 0), (15, 0)) == 1
+    assert t.hop_distance((0, 0), (8, 8)) == 16
+
+
+def test_hop_distance_symmetric():
+    t = ChipTopology.build("v5e", (8, 8))
+    chips = t.chips
+    for a in chips[::7]:
+        for b in chips[::5]:
+            assert t.hop_distance(a, b) == t.hop_distance(b, a)
+            if a == b:
+                assert t.hop_distance(a, b) == 0
+
+
+def test_single_chip_topology_has_no_links():
+    # design.md:17-19: a 1-GPU node reports no topology; here a 1-chip
+    # topology is representable and simply has zero ICI links.
+    t = ChipTopology.build("v5e", (1, 1))
+    assert t.num_chips == 1
+    assert t.neighbors((0, 0)) == []
+    assert t.links() == []
+
+
+def test_link_count_open_vs_torus():
+    open_t = ChipTopology.build("v5p", (2, 2, 4), wrap=(False, False, False))
+    # Box links: for each axis, (d-1) * prod(other dims).
+    assert len(open_t.links()) == (1 * 8) + (1 * 8) + (3 * 4)
+    torus = ChipTopology.build("v5e", (16, 16))
+    # Full torus: 2 links per chip per axis / 2 = dims product per axis.
+    assert len(torus.links()) == 2 * 16 * 16
+
+
+def test_hosts_grouping_v5p():
+    t = ChipTopology.build("v5p", (2, 2, 4))
+    # v5p host_bounds (2,2,1): 4 chips/host, 4 hosts for 16 chips.
+    assert t.num_hosts == 4
+    assert all(len(chips) == 4 for chips in t.hosts.values())
+    assert t.host_of((0, 0, 0)) == t.host_of((1, 1, 0))
+    assert t.host_of((0, 0, 0)) != t.host_of((0, 0, 1))
+
+
+def test_parse_format_roundtrip():
+    t = ChipTopology.build("v5p", (2, 2, 4))
+    spec = format_topology(t)
+    assert spec == "v5p:2x2x4:wrap=000"
+    t2 = parse_topology(spec)
+    assert t2 == t
+    with pytest.raises(ValueError):
+        parse_topology("v5p")
+    with pytest.raises(ValueError):
+        ChipTopology.build("v5e", (2, 2, 2))  # v5e is 2-D
+
+
+def test_classify_link():
+    t = ChipTopology.build("v5p", (2, 2, 4))
+    assert classify_link(t, (0, 0, 0), (0, 0, 1)) is LinkType.ICI_NEIGHBOR
+    assert classify_link(t, (0, 0, 0), (1, 1, 3)) is LinkType.ICI_MESH
+    with pytest.raises(ValueError):
+        classify_link(t, (0, 0, 0), (0, 0, 0))
+    # Worst-to-best ordering with fixed direction (SURVEY.md §5 score bug).
+    assert LinkType.DCN < LinkType.ICI_MESH < LinkType.ICI_NEIGHBOR

@@ -1,0 +1,17 @@
+"""tputopo — TPU-native topology-aware Kubernetes scheduling framework.
+
+A ground-up rebuild of the capability set specified by the reference design
+``hellolijj/gpu-topology-on-k8s`` (a Gaia-style GPU-topology scheduler,
+``/root/reference/design.md``), reformulated natively for TPUs:
+
+- The NVML pairwise P2P link matrix (design.md:25-74) becomes a regular
+  ICI torus model with known chip coordinates (:mod:`tputopo.topology`).
+- The greedy k-subset selector (design.md:131-190) becomes contiguous
+  slice-shape enumeration with an anti-fragmentation packing policy.
+- The affinity-mark scorer (design.md:192-217) becomes an analytic
+  all-reduce bandwidth model over ICI/DCN links.
+- The device plugin / scheduler-extender / annotation-handshake shapes
+  (design.md:57-121, 223-246) are preserved — they are accelerator-agnostic.
+"""
+
+__version__ = "0.1.0"

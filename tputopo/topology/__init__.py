@@ -1,0 +1,27 @@
+"""Topology core: TPU generation specs, ICI torus model, cost model, slice
+enumeration, and all-reduce bandwidth scoring.
+
+This package is the TPU-native replacement for the reference's topology
+stack: the ``gpuTopology`` pairwise matrix (design.md:61-74), the link
+taxonomy and affinity marks (design.md:31-47, 194-203), the device-combination
+selector (design.md:131-190), the combo scorer (design.md:205-217), and the
+Gaia access-cost tree (Gaia PDF §III.B).  A TPU pod is a regular 2D/3D torus
+with known coordinates, so pairwise discovery is replaced by an analytic
+model and subset search by contiguous sub-slice enumeration.
+"""
+
+from tputopo.topology.generations import (  # noqa: F401
+    TpuGeneration,
+    GENERATIONS,
+    get_generation,
+)
+from tputopo.topology.model import ChipTopology, parse_topology  # noqa: F401
+from tputopo.topology.cost import LinkType, LinkCostModel, classify_link  # noqa: F401
+from tputopo.topology.slices import (  # noqa: F401
+    SliceShape,
+    Placement,
+    enumerate_shapes,
+    enumerate_placements,
+    Allocator,
+)
+from tputopo.topology.score import predict_allreduce_gbps, score_chip_set  # noqa: F401

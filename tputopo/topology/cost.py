@@ -1,0 +1,97 @@
+"""Link taxonomy and cost model — the TPU analog of the reference's link
+classes and affinity marks.
+
+The reference orders NVLink/PCIe link classes SYS < NODE < PHB < PXB < PIX <
+PSB < NV1-4 and assigns each an affinity mark 1-6 (design.md:31-47, 194-203),
+leaving actual bandwidth weights as an unresolved TODO (design.md:47).  On
+TPU the taxonomy collapses to three physically distinct classes:
+
+=============  ======================================  =========================
+TPU class      meaning                                 GPU-design analog
+=============  ======================================  =========================
+ICI_NEIGHBOR   direct ICI link (1 hop)                 NV1-4 (direct NVLink)
+ICI_MESH       same ICI domain, >1 hop                 PIX/PXB/PHB (via switches)
+DCN            different ICI domain (cross-slice /     SYS ("Cross CPU socket",
+               cross-pod, data-center network)         design.md:33-36)
+=============  ======================================  =========================
+
+Unlike the reference's abstract 1-6 marks (and its inverted score formula —
+see SURVEY.md §5 "Score-direction bug"), costs here are expressed directly
+in physical units (GB/s per link, hop counts), so *higher score == better
+placement* by construction and the TODO weight table becomes explicit,
+overridable config (:mod:`tputopo.extender.config`).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from tputopo.topology.model import ChipTopology, Coord
+
+
+class LinkType(enum.IntEnum):
+    """Pairwise chip-to-chip link classification, worst-to-best ordered
+    (same ordering convention as the reference's mark table, design.md:196-203,
+    but with the score direction fixed: bigger enum value == faster path)."""
+
+    DCN = 1           # cross-ICI-domain, rides the data-center network
+    ICI_MESH = 2      # same torus, multi-hop
+    ICI_NEIGHBOR = 3  # direct ICI link
+
+    def describe(self) -> str:
+        return {
+            LinkType.DCN: "Cross ICI domain (data-center network)",
+            LinkType.ICI_MESH: "Same ICI torus, multi-hop",
+            LinkType.ICI_NEIGHBOR: "Direct ICI link",
+        }[self]
+
+
+def classify_link(topo: ChipTopology, a: Coord, b: Coord) -> LinkType:
+    """Classify the path between two chips of one topology.
+
+    Chips in *different* topologies (different slices/pods) are always DCN;
+    callers with multi-slice state handle that case themselves (see
+    :func:`tputopo.topology.score.score_chip_set`).
+    """
+    if a == b:
+        raise ValueError("a chip has no link to itself")
+    return LinkType.ICI_NEIGHBOR if topo.hop_distance(a, b) == 1 else LinkType.ICI_MESH
+
+
+@dataclass(frozen=True)
+class LinkCostModel:
+    """Bandwidth/latency figures the scorer consumes.
+
+    Defaults derive from the generation spec; deployments override via config
+    with measured numbers (closing the reference's design.md:47 TODO).
+
+    Attributes:
+        ici_link_gbps: one-way GB/s of a single ICI link.
+        dcn_host_gbps: per-host DCN GB/s.
+        ici_hop_latency_us: per-hop ICI latency (tiebreak only; ICI is ~1us).
+        dcn_latency_us: DCN round-trip latency.
+    """
+
+    ici_link_gbps: float
+    dcn_host_gbps: float
+    ici_hop_latency_us: float = 1.0
+    dcn_latency_us: float = 25.0
+    overrides: dict = field(default_factory=dict)
+
+    @staticmethod
+    def for_generation(gen_name: str, **overrides) -> "LinkCostModel":
+        from tputopo.topology.generations import get_generation
+
+        g = get_generation(gen_name)
+        return LinkCostModel(
+            ici_link_gbps=float(overrides.pop("ici_link_gbps", g.ici_link_gbps)),
+            dcn_host_gbps=float(overrides.pop("dcn_host_gbps", g.dcn_host_gbps)),
+            **overrides,
+        )
+
+    def link_gbps(self, link: LinkType) -> float:
+        """Point-to-point bandwidth for one link of the given class."""
+        if link is LinkType.DCN:
+            return self.dcn_host_gbps
+        return self.ici_link_gbps

@@ -1,0 +1,131 @@
+"""TPU generation specifications.
+
+TPU-native analog of the reference's link taxonomy table (design.md:31-47):
+where the GPU design enumerates NVLink/PCIe link classes (SYS/NODE/PHB/PXB/
+PIX/PSB/NV1-4) discovered pairwise via NVML, a TPU fleet has a small set of
+*generations*, each with a known interconnect geometry (2D or 3D ICI torus),
+fixed per-link bandwidth, and a fixed chips-per-host layout.  The reference
+left its bandwidth-weight table as an open TODO (design.md:47, "带宽权值"
+unresolved); here the weights are first-class, explicit data — editable via
+the extender config (see :mod:`tputopo.extender.config`) so deployments can
+substitute measured numbers.
+
+Bandwidth figures are public-spec derived (GB/s = one-way, per link, per
+direction): v4 advertises 2400 Gbps/chip over 6 ICI links, v5e 1600 Gbps
+over 4 links, v5p 4800 Gbps over 6 links, v6e 3584 Gbps over 4 links.
+They are *defaults*, not ground truth — the north-star acceptance test
+(BASELINE.md) validates predicted vs. measured all-reduce throughput.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class TpuGeneration:
+    """Static interconnect spec for one TPU generation.
+
+    Attributes:
+        name: canonical generation name, e.g. ``"v5p"``.
+        ndims: dimensionality of the ICI mesh (2 for v5e/v6e, 3 for v4/v5p).
+        max_dims: largest pod shape in chips along each axis.
+        host_bounds: chips per host along each axis (v5p host = 2x2x1,
+            v5e host = 4x2).  The analog of the reference's CPU-affinity
+            grouping (design.md:145-146): chips on one host share a NUMA
+            domain and a DCN attachment.
+        cores_per_chip: TensorCores per chip.  v5p slice names count cores
+            (v5p-32 == 16 chips, the 2x2x4 target in BASELINE.json).
+        ici_link_gbps: one-way bandwidth of a single ICI link, GB/s.
+        hbm_gbps: per-chip HBM bandwidth, GB/s (used by workload heuristics).
+        dcn_host_gbps: per-host data-center-network bandwidth, GB/s.  DCN is
+            the TPU analog of the reference's worst link class ``SYS``
+            ("Cross CPU socket", design.md:33-36): traffic that leaves the
+            ICI domain entirely.
+        wrap_when_full: axes acquire wraparound (torus) links when a slice
+            spans the full pod extent on that axis — standard TPU behavior;
+            smaller sub-slices on that axis are open meshes.
+    """
+
+    name: str
+    ndims: int
+    max_dims: tuple[int, ...]
+    host_bounds: tuple[int, ...]
+    cores_per_chip: int
+    ici_link_gbps: float
+    hbm_gbps: float
+    dcn_host_gbps: float
+    wrap_when_full: bool = True
+    # Slice shapes officially offered for this generation, in chips.
+    # Used by the enumerator as the preferred shape vocabulary; arbitrary
+    # boxes that fit the torus are still representable.
+    standard_shapes: tuple[tuple[int, ...], ...] = field(default=())
+
+    @property
+    def chips_per_host(self) -> int:
+        return math.prod(self.host_bounds)
+
+    def slice_name(self, num_chips: int) -> str:
+        """Public slice name, e.g. v5p counts cores: 16 chips -> 'v5p-32'."""
+        return f"{self.name}-{num_chips * self.cores_per_chip}"
+
+
+GENERATIONS: dict[str, TpuGeneration] = {
+    g.name: g
+    for g in [
+        TpuGeneration(
+            name="v4",
+            ndims=3,
+            max_dims=(8, 8, 16),
+            host_bounds=(2, 2, 1),
+            cores_per_chip=2,
+            ici_link_gbps=50.0,
+            hbm_gbps=1228.0,
+            dcn_host_gbps=25.0,
+            standard_shapes=((2, 2, 1), (2, 2, 2), (2, 2, 4), (4, 4, 4), (4, 4, 8)),
+        ),
+        TpuGeneration(
+            name="v5e",
+            ndims=2,
+            max_dims=(16, 16),
+            host_bounds=(4, 2),
+            cores_per_chip=1,
+            ici_link_gbps=50.0,
+            hbm_gbps=819.0,
+            dcn_host_gbps=25.0,
+            standard_shapes=((1, 1), (2, 2), (2, 4), (4, 4), (4, 8), (8, 8), (8, 16), (16, 16)),
+        ),
+        TpuGeneration(
+            name="v5p",
+            ndims=3,
+            max_dims=(16, 16, 24),
+            host_bounds=(2, 2, 1),
+            cores_per_chip=2,
+            ici_link_gbps=100.0,
+            hbm_gbps=2765.0,
+            dcn_host_gbps=50.0,
+            standard_shapes=((2, 2, 1), (2, 2, 2), (2, 2, 4), (4, 4, 4), (4, 4, 8), (8, 8, 8)),
+        ),
+        TpuGeneration(
+            name="v6e",
+            ndims=2,
+            max_dims=(16, 16),
+            host_bounds=(4, 2),
+            cores_per_chip=1,
+            ici_link_gbps=112.0,
+            hbm_gbps=1638.0,
+            dcn_host_gbps=50.0,
+            standard_shapes=((1, 1), (2, 2), (2, 4), (4, 4), (4, 8), (8, 8), (8, 16), (16, 16)),
+        ),
+    ]
+}
+
+
+def get_generation(name: str) -> TpuGeneration:
+    try:
+        return GENERATIONS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown TPU generation {name!r}; known: {sorted(GENERATIONS)}"
+        ) from None

@@ -1,0 +1,187 @@
+"""In-memory topology model: chip coordinates on an ICI mesh/torus.
+
+TPU-native replacement for the reference's pairwise matrix
+``gpuTopology map[uint]map[uint]gpuTopologyType`` (design.md:61-74).  The GPU
+design must *discover* an irregular PCIe/NVLink hierarchy pairwise; a TPU
+slice is a regular torus, so the model is a coordinate grid plus an axis
+wrap mask, and every pairwise property (hop distance, link class) is derived
+analytically rather than stored.
+
+The reference's convention that a 1-GPU node reports no topology at all
+(design.md:17-19) maps here to a 1-chip topology with no ICI links — it is
+still representable (``num_chips == 1``) because the device plugin must be
+able to advertise single-chip hosts (BASELINE config 1).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import cached_property
+
+from tputopo.topology.generations import TpuGeneration, get_generation
+
+Coord = tuple[int, ...]
+
+
+@dataclass(frozen=True)
+class ChipTopology:
+    """A concrete slice/pod topology: a box of chips with optional wraparound.
+
+    Attributes:
+        generation: the TPU generation spec.
+        dims: extent in chips along each axis (e.g. ``(2, 2, 4)``).
+        wrap: per-axis torus wraparound.  By default an axis wraps iff the
+            slice spans the generation's full pod extent on that axis
+            (``TpuGeneration.wrap_when_full``).
+    """
+
+    generation: TpuGeneration
+    dims: tuple[int, ...]
+    wrap: tuple[bool, ...]
+
+    @staticmethod
+    def build(generation: str | TpuGeneration, dims: tuple[int, ...],
+              wrap: tuple[bool, ...] | None = None) -> "ChipTopology":
+        gen = get_generation(generation) if isinstance(generation, str) else generation
+        if len(dims) != gen.ndims:
+            raise ValueError(
+                f"{gen.name} is {gen.ndims}-D; got dims {dims}"
+            )
+        for d, m in zip(dims, gen.max_dims):
+            if d < 1 or d > m:
+                raise ValueError(f"dims {dims} out of range for {gen.name} (max {gen.max_dims})")
+        if wrap is None:
+            wrap = tuple(
+                gen.wrap_when_full and d == m and d > 2
+                for d, m in zip(dims, gen.max_dims)
+            )
+        elif len(wrap) != gen.ndims:
+            raise ValueError(f"wrap mask {wrap} must have {gen.ndims} axes")
+        return ChipTopology(gen, tuple(dims), tuple(wrap))
+
+    @property
+    def num_chips(self) -> int:
+        return math.prod(self.dims)
+
+    @cached_property
+    def chips(self) -> list[Coord]:
+        """All chip coordinates in row-major order (also the device index order)."""
+        coords: list[Coord] = [()]
+        for d in self.dims:
+            coords = [c + (i,) for c in coords for i in range(d)]
+        return coords
+
+    def index(self, coord: Coord) -> int:
+        """Row-major flat index of a coordinate — the stable device id."""
+        idx = 0
+        for c, d in zip(coord, self.dims):
+            if not (0 <= c < d):
+                raise ValueError(f"coord {coord} outside dims {self.dims}")
+            idx = idx * d + c
+        return idx
+
+    def coord(self, index: int) -> Coord:
+        if not (0 <= index < self.num_chips):
+            raise ValueError(f"index {index} outside 0..{self.num_chips - 1}")
+        out = []
+        for d in reversed(self.dims):
+            out.append(index % d)
+            index //= d
+        return tuple(reversed(out))
+
+    def neighbors(self, coord: Coord) -> list[Coord]:
+        """ICI-adjacent chips (±1 along each axis, honoring wraparound)."""
+        out: list[Coord] = []
+        for ax, (d, w) in enumerate(zip(self.dims, self.wrap)):
+            if d == 1:
+                continue
+            for step in (-1, 1):
+                c = coord[ax] + step
+                if 0 <= c < d:
+                    out.append(coord[:ax] + (c,) + coord[ax + 1:])
+                elif w:
+                    out.append(coord[:ax] + (c % d,) + coord[ax + 1:])
+        # d == 2 with wrap would produce the same neighbor twice; dedupe.
+        seen: set[Coord] = set()
+        uniq = []
+        for c in out:
+            if c not in seen:
+                seen.add(c)
+                uniq.append(c)
+        return uniq
+
+    def hop_distance(self, a: Coord, b: Coord) -> int:
+        """Minimal ICI hop count between two chips (Manhattan on the torus)."""
+        hops = 0
+        for ax, (d, w) in enumerate(zip(self.dims, self.wrap)):
+            delta = abs(a[ax] - b[ax])
+            hops += min(delta, d - delta) if w else delta
+        return hops
+
+    def host_of(self, coord: Coord) -> Coord:
+        """Host coordinate for a chip — chips grouped by ``host_bounds``.
+
+        Analog of the reference's CPU-affinity grouping used as the k=1
+        tiebreak (design.md:145-146): same host == same NUMA/DCN attachment.
+        """
+        hb = self.generation.host_bounds
+        return tuple(c // b for c, b in zip(coord, hb))
+
+    @cached_property
+    def hosts(self) -> dict[Coord, list[Coord]]:
+        out: dict[Coord, list[Coord]] = {}
+        for c in self.chips:
+            out.setdefault(self.host_of(c), []).append(c)
+        return out
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.hosts)
+
+    def links(self) -> list[tuple[Coord, Coord]]:
+        """All ICI links, each undirected edge once, as sorted coordinate pairs."""
+        out: list[tuple[Coord, Coord]] = []
+        seen: set[frozenset] = set()
+        for c in self.chips:
+            for n in self.neighbors(c):
+                e = frozenset((c, n))
+                if e not in seen:
+                    seen.add(e)
+                    lo, hi = sorted((c, n))
+                    out.append((lo, hi))
+        return out
+
+    def describe(self) -> str:
+        w = "x".join(str(d) for d in self.dims)
+        return f"{self.generation.name} {w} ({self.num_chips} chips, {self.num_hosts} hosts)"
+
+
+def parse_topology(spec: str) -> ChipTopology:
+    """Parse ``"v5p:2x2x4"`` (with optional ``:wrap=101`` axis mask) into a topology.
+
+    This string form is what the device plugin publishes in node annotations
+    (the analog of the reference's per-edge ``GPU_<ABBR>_<i>_<j>`` annotation
+    scheme, design.md:76-82 — a torus is described by its shape, not edges).
+    """
+    parts = spec.split(":")
+    if len(parts) < 2:
+        raise ValueError(f"bad topology spec {spec!r}; want 'gen:AxBxC[:wrap=mask]'")
+    gen = parts[0]
+    dims = tuple(int(x) for x in parts[1].split("x"))
+    wrap = None
+    for extra in parts[2:]:
+        if extra.startswith("wrap="):
+            mask = extra[len("wrap="):]
+            if not mask or set(mask) - {"0", "1"}:
+                raise ValueError(f"bad wrap mask {mask!r}; want e.g. wrap=110")
+            wrap = tuple(ch == "1" for ch in mask)
+        else:
+            raise ValueError(f"unknown topology spec field {extra!r}")
+    return ChipTopology.build(gen, dims, wrap)
+
+
+def format_topology(t: ChipTopology) -> str:
+    dims = "x".join(str(d) for d in t.dims)
+    wrap = "".join("1" if w else "0" for w in t.wrap)
+    return f"{t.generation.name}:{dims}:wrap={wrap}"

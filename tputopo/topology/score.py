@@ -1,0 +1,209 @@
+"""All-reduce bandwidth scorer — the TPU-native combo scorer.
+
+Replaces the reference's affinity-mark formula ``10 - 10*sum(marks)/(6*n)``
+(design.md:205-217).  That formula has a documented direction bug (it ranks
+the *worst* node highest — SURVEY.md §5); this scorer fixes it by
+construction: the score *is* the predicted ring all-reduce algorithm
+bandwidth in GB/s of the candidate chip set, so higher is strictly better
+and the number is physically checkable against a measured JAX collective
+(the BASELINE.md north-star metric).
+
+Model (documented so deployments can calibrate it):
+
+- A contiguous axis-aligned box does one bidirectional-ring reduce-scatter /
+  all-gather per axis, with the payload split across axes.  Per-axis
+  algorithm bandwidth for extent ``d``:
+
+      algbw_axis = link_gbps * n_dirs * d / (2 * (d - 1))
+
+  where ``n_dirs`` is 2 when the axis wraps (torus) or ``d == 2`` (both
+  directions of the single link are usable), else 1 (open mesh — the
+  classic "non-wrapped axis halves all-reduce bandwidth" rule).
+  Box score = sum of algbw_axis over axes with d > 1.
+
+- A connected but non-box ("blob") set is injection-limited by its most
+  weakly attached chip: ``link_gbps * min_internal_degree * N / (2*(N-1))``.
+
+- A set spanning several ICI components (or several nodes/slices) must cross
+  DCN; its score is the narrowest component's aggregate DCN pipe — orders of
+  magnitude below ICI, which yields the same strict preference ordering the
+  reference encodes with SYS-vs-NVLink marks (design.md:33-44).
+"""
+
+from __future__ import annotations
+
+from tputopo.topology.cost import LinkCostModel
+from tputopo.topology.model import ChipTopology, Coord
+
+
+def _ring_factor(d: int) -> float:
+    return d / (2.0 * (d - 1)) if d > 1 else 0.0
+
+
+def _axis_algbw(link_gbps: float, d: int, wrapped: bool) -> float:
+    if d <= 1:
+        return 0.0
+    n_dirs = 2.0 if (wrapped or d == 2) else 1.0
+    return link_gbps * n_dirs * _ring_factor(d)
+
+
+def predict_allreduce_gbps(topo: ChipTopology, dims: tuple[int, ...],
+                           cost: LinkCostModel | None = None,
+                           wrap: tuple[bool, ...] | None = None) -> float:
+    """Predicted all-reduce algorithm bandwidth of an axis-aligned box slice.
+
+    ``wrap`` marks which axes of the *box* have wraparound links; by default
+    an axis wraps iff the box spans the host topology's full wrapped extent.
+    """
+    cost = cost or LinkCostModel.for_generation(topo.generation.name)
+    if wrap is None:
+        wrap = tuple(
+            topo.wrap[i] and dims[i] == topo.dims[i] for i in range(len(dims))
+        )
+    return sum(
+        _axis_algbw(cost.ici_link_gbps, d, w) for d, w in zip(dims, wrap)
+    )
+
+
+def _components(topo: ChipTopology, chips: frozenset[Coord]) -> list[set[Coord]]:
+    todo = set(chips)
+    comps: list[set[Coord]] = []
+    while todo:
+        seed = todo.pop()
+        comp = {seed}
+        frontier = [seed]
+        while frontier:
+            c = frontier.pop()
+            for n in topo.neighbors(c):
+                if n in todo:
+                    todo.discard(n)
+                    comp.add(n)
+                    frontier.append(n)
+        comps.append(comp)
+    return comps
+
+
+def _circular_extent(vals: list[int], dim: int, wrapped: bool) -> tuple[int, int]:
+    """Minimal covering extent of coordinate values along one axis.
+
+    Returns (start, length).  On a wrapped axis the covering arc may cross
+    the boundary (e.g. values {7, 0} on a wrapped axis of 8 -> start 7, len 2).
+    """
+    uniq = sorted(set(vals))
+    span = uniq[-1] - uniq[0] + 1
+    if not wrapped or len(uniq) == dim:
+        return uniq[0], span
+    # Largest gap between consecutive occupied values (circularly); the
+    # minimal covering arc is everything outside that gap.
+    best_gap, best_start = 0, uniq[0]
+    for i, v in enumerate(uniq):
+        nxt = uniq[(i + 1) % len(uniq)]
+        gap = (nxt - v - 1) % dim
+        if gap > best_gap:
+            best_gap, best_start = gap, nxt
+    return best_start, dim - best_gap
+
+
+def _box_of(topo: ChipTopology, chips: frozenset[Coord]) -> tuple[tuple[int, ...], tuple[int, ...]] | None:
+    """If ``chips`` is exactly an axis-aligned (possibly wrap-crossing) box,
+    return (origin, dims); else None."""
+    nd = len(topo.dims)
+    origin, dims = [], []
+    vol = 1
+    for ax in range(nd):
+        start, length = _circular_extent([c[ax] for c in chips], topo.dims[ax], topo.wrap[ax])
+        origin.append(start)
+        dims.append(length)
+        vol *= length
+    if vol != len(chips):
+        return None
+    # Verify every cell of the box is present.
+    for c in chips:
+        for ax in range(nd):
+            off = (c[ax] - origin[ax]) % topo.dims[ax] if topo.wrap[ax] else c[ax] - origin[ax]
+            if not (0 <= off < dims[ax]):
+                return None
+    return tuple(origin), tuple(dims)
+
+
+def _internal_degree(topo: ChipTopology, chips: frozenset[Coord], c: Coord) -> int:
+    return sum(1 for n in topo.neighbors(c) if n in chips)
+
+
+def score_chip_set(topo: ChipTopology, chips: frozenset[Coord] | set[Coord],
+                   cost: LinkCostModel | None = None) -> float:
+    """Score an arbitrary chip set within one ICI domain: predicted all-reduce
+    GB/s (higher is better).  A single chip scores 0.0 — no collective runs,
+    and k=1 placement is decided by the anti-fragmentation policy instead
+    (the analog of Gaia's Singular scheduler, Gaia PDF Alg. 3)."""
+    chips = frozenset(chips)
+    cost = cost or LinkCostModel.for_generation(topo.generation.name)
+    n = len(chips)
+    if n == 0:
+        raise ValueError("empty chip set")
+    if n == 1:
+        return 0.0
+
+    comps = _components(topo, chips)
+    if len(comps) > 1:
+        # Disconnected within ICI: the collective must ride DCN between the
+        # components.  Narrowest component's aggregate host DCN pipe bounds it.
+        narrowest = min(
+            len({topo.host_of(c) for c in comp}) for comp in comps
+        )
+        return cost.dcn_host_gbps * narrowest * _ring_factor(n) * 2.0 / n
+
+    box = _box_of(topo, chips)
+    if box is not None:
+        _, dims = box
+        wrap = tuple(
+            topo.wrap[i] and dims[i] == topo.dims[i] for i in range(len(dims))
+        )
+        return sum(_axis_algbw(cost.ici_link_gbps, d, w) for d, w in zip(dims, wrap))
+
+    min_deg = min(_internal_degree(topo, chips, c) for c in chips)
+    return cost.ici_link_gbps * max(min_deg, 1) * _ring_factor(n)
+
+
+def predict_multidomain_allreduce_gbps(
+    domains: list[tuple[ChipTopology, frozenset[Coord]]],
+    cost: LinkCostModel,
+) -> float:
+    """Score a chip set spanning several ICI domains (nodes/slices).
+
+    Cross-domain traffic rides DCN; the collective is bottlenecked by the
+    narrowest domain's aggregate DCN attachment.  Within-domain bandwidth
+    only matters if it is (pathologically) below the DCN bound.
+    """
+    if not domains:
+        raise ValueError("no domains")
+    if len(domains) == 1:
+        topo, chips = domains[0]
+        return score_chip_set(topo, chips, cost)
+    dcn_bound = min(
+        cost.dcn_host_gbps * len({t.host_of(c) for c in chips})
+        for t, chips in domains
+    )
+    ici_bound = min(
+        score_chip_set(t, chips, cost) if len(chips) > 1 else float("inf")
+        for t, chips in domains
+    )
+    return min(dcn_bound, ici_bound)
+
+
+def explain_chip_set(topo: ChipTopology, chips: frozenset[Coord] | set[Coord],
+                     cost: LinkCostModel | None = None) -> dict:
+    """Human-readable decision record — the analog of the reference's worked
+    scoring example (design.md:213-217) and its annotation-as-observability
+    posture (SURVEY.md §5.5)."""
+    chips = frozenset(chips)
+    cost = cost or LinkCostModel.for_generation(topo.generation.name)
+    box = _box_of(topo, chips) if len(chips) > 1 else None
+    return {
+        "chips": sorted(chips),
+        "num_chips": len(chips),
+        "hosts": sorted({topo.host_of(c) for c in chips}),
+        "contiguous_box": list(box[1]) if box else None,
+        "predicted_allreduce_gbps": round(score_chip_set(topo, chips, cost), 3),
+        "ici_link_gbps": cost.ici_link_gbps,
+    }

@@ -1,0 +1,319 @@
+"""Contiguous slice enumeration, anti-fragmentation placement, and occupancy
+bookkeeping — the TPU-native device-combination selector.
+
+Replaces the reference's greedy k-subset search (design.md:131-190): the
+closest-unused-pair seed plus Prim-style accretion, whose tie-handling flaw
+the design itself documents (design.md:188-190 — committing to an arbitrary
+shortest pair can strand the remaining device).  On a torus the flaw
+disappears structurally: we enumerate *axis-aligned contiguous boxes* (the
+shapes XLA actually maps meshes onto) and score them with the analytic
+bandwidth model, so the search is exact over the shape vocabulary rather
+than greedy over pairs.
+
+Policy mapping to the reference / Gaia paper:
+
+- k = 1  -> Singular (Gaia PDF Alg. 3): prefer a free chip whose neighbors
+  are already used, preserving tight free blocks for future multi-chip
+  requests.  This also supersedes the design's contradictory k=1 pseudocode
+  (design.md:153-160 returns an arbitrary unused device; the prose at
+  design.md:135-147 wants anti-fragmentation — we implement the prose).
+- k >= 2 -> Link (Gaia PDF Alg. 4): allocate a contiguous sub-slice; among
+  equal-bandwidth placements, pack against used chips / walls so the largest
+  aligned free blocks survive.
+- Non-box fallback: if k admits no box shape in the free set, fall back to
+  connected-blob growth (the only place the reference's Prim-style accretion
+  survives, design.md:161-186) — still scored honestly by the blob formula.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from tputopo.topology.cost import LinkCostModel
+from tputopo.topology.model import ChipTopology, Coord
+from tputopo.topology.score import predict_allreduce_gbps, score_chip_set
+
+
+@dataclass(frozen=True)
+class SliceShape:
+    dims: tuple[int, ...]
+
+    @property
+    def num_chips(self) -> int:
+        return math.prod(self.dims)
+
+
+@dataclass(frozen=True)
+class Placement:
+    """A concrete allocation: a set of chips, usually an axis-aligned box."""
+
+    chips: tuple[Coord, ...]
+    origin: Coord | None = None          # None for blob fallback
+    dims: tuple[int, ...] | None = None  # None for blob fallback
+    score_gbps: float = 0.0
+
+    @property
+    def is_contiguous_box(self) -> bool:
+        return self.dims is not None
+
+
+def _factorizations(k: int, ndims: int, max_dims: tuple[int, ...]) -> list[tuple[int, ...]]:
+    """All ordered factorizations of k into ndims factors with factor i <= max_dims[i]."""
+    out: list[tuple[int, ...]] = []
+
+    def rec(prefix: tuple[int, ...], remaining: int, axis: int) -> None:
+        if axis == ndims - 1:
+            if remaining <= max_dims[axis]:
+                out.append(prefix + (remaining,))
+            return
+        for f in range(1, min(remaining, max_dims[axis]) + 1):
+            if remaining % f == 0:
+                rec(prefix + (f,), remaining // f, axis + 1)
+
+    rec((), k, 0)
+    return out
+
+
+def enumerate_shapes(topo: ChipTopology, k: int,
+                     cost: LinkCostModel | None = None) -> list[SliceShape]:
+    """All box shapes of volume k fitting ``topo``, best predicted-bandwidth
+    first (ties: prefer the generation's standard shape vocabulary, then the
+    most compact), deterministic order."""
+    cost = cost or LinkCostModel.for_generation(topo.generation.name)
+    std = set(topo.generation.standard_shapes)
+    shapes = [SliceShape(f) for f in _factorizations(k, len(topo.dims), topo.dims)]
+
+    def key(s: SliceShape):
+        return (
+            -predict_allreduce_gbps(topo, s.dims, cost),
+            0 if s.dims in std else 1,
+            max(s.dims) - min(s.dims),
+            s.dims,
+        )
+
+    return sorted(shapes, key=key)
+
+
+def _origins(topo: ChipTopology, dims: tuple[int, ...]) -> list[Coord]:
+    """Candidate box origins.  On wrapped axes any offset is valid (the box
+    may cross the seam); on open axes the box must fit within bounds."""
+    ranges = []
+    for ax, d in enumerate(dims):
+        td = topo.dims[ax]
+        if d > td:
+            return []
+        if topo.wrap[ax] and d < td:
+            ranges.append(range(td))
+        else:
+            ranges.append(range(td - d + 1))
+    out: list[Coord] = [()]
+    for r in ranges:
+        out = [o + (i,) for o in out for i in r]
+    return out
+
+
+def box_chips(topo: ChipTopology, origin: Coord, dims: tuple[int, ...]) -> tuple[Coord, ...]:
+    cells: list[Coord] = [()]
+    for ax, d in enumerate(dims):
+        td = topo.dims[ax]
+        cells = [c + ((origin[ax] + i) % td,) for c in cells for i in range(d)]
+    return tuple(sorted(cells))
+
+
+def enumerate_placements(topo: ChipTopology, shape: SliceShape,
+                         free: frozenset[Coord],
+                         cost: LinkCostModel | None = None) -> list[Placement]:
+    """All placements of ``shape`` whose chips are entirely free."""
+    cost = cost or LinkCostModel.for_generation(topo.generation.name)
+    score = predict_allreduce_gbps(topo, shape.dims, cost)
+    out = []
+    for o in _origins(topo, shape.dims):
+        chips = box_chips(topo, o, shape.dims)
+        if all(c in free for c in chips):
+            out.append(Placement(chips=chips, origin=o, dims=shape.dims,
+                                 score_gbps=score))
+    return out
+
+
+def _free_boundary(topo: ChipTopology, chips: frozenset[Coord],
+                   free: frozenset[Coord]) -> int:
+    """Number of *free* chips adjacent to the set — the fragmentation damage
+    a placement does.  Packing against used chips/walls minimizes it."""
+    boundary: set[Coord] = set()
+    for c in chips:
+        for n in topo.neighbors(c):
+            if n in free and n not in chips:
+                boundary.add(n)
+    return len(boundary)
+
+
+class Allocator:
+    """Free/used bookkeeping plus the placement policy for one ICI domain.
+
+    The stateful analog of the reference's per-device ``isUsed`` reporting
+    (design.md:84-86) and the extender's in-memory combo search (SURVEY.md
+    §3.2 hot loop).  State is rebuildable from cluster annotations — the
+    framework keeps the reference's statelessness posture (SURVEY.md §5.4).
+    """
+
+    def __init__(self, topo: ChipTopology, cost: LinkCostModel | None = None):
+        self.topo = topo
+        self.cost = cost or LinkCostModel.for_generation(topo.generation.name)
+        self._used: set[Coord] = set()
+
+    @property
+    def free(self) -> frozenset[Coord]:
+        return frozenset(c for c in self.topo.chips if c not in self._used)
+
+    @property
+    def used(self) -> frozenset[Coord]:
+        return frozenset(self._used)
+
+    def mark_used(self, chips) -> None:
+        batch = [tuple(c) for c in chips]
+        valid = set(self.topo.chips)
+        for c in batch:
+            if c not in valid:
+                raise ValueError(f"chip {c} not in topology {self.topo.describe()}")
+            if c in self._used:
+                raise ValueError(f"chip {c} already used")
+        if len(set(batch)) != len(batch):
+            raise ValueError(f"duplicate chips in batch {batch}")
+        self._used.update(batch)
+
+    def release(self, chips) -> None:
+        for c in chips:
+            self._used.discard(tuple(c))
+
+    # ---- k = 1: Singular policy (Gaia PDF Alg. 3) --------------------------
+
+    def _pick_single(self, free: frozenset[Coord]) -> Placement | None:
+        if not free:
+            return None
+
+        def key(c: Coord):
+            free_neighbors = sum(1 for n in self.topo.neighbors(c) if n in free)
+            host = self.topo.host_of(c)
+            host_chips = self.topo.hosts[host]
+            # "Used" must be judged against the *passed-in* free set so that
+            # gang placement and hypothetical queries tiebreak consistently.
+            host_has_used = any(h not in free for h in host_chips)
+            # Prefer: fewest free neighbors (pack tight), then a host already
+            # partially used (CPU-affinity-style tiebreak, design.md:145-146),
+            # then deterministic lexicographic order.
+            return (free_neighbors, 0 if host_has_used else 1, c)
+
+        best = min(free, key=key)
+        return Placement(chips=(best,), origin=best,
+                         dims=tuple(1 for _ in self.topo.dims), score_gbps=0.0)
+
+    # ---- k >= 2: Link policy (Gaia PDF Alg. 4) -----------------------------
+
+    def _pick_box(self, k: int, free: frozenset[Coord]) -> Placement | None:
+        best: tuple | None = None
+        best_p: Placement | None = None
+        for shape in enumerate_shapes(self.topo, k, self.cost):
+            shape_score = predict_allreduce_gbps(self.topo, shape.dims, self.cost)
+            # Shapes arrive best-bandwidth-first; once a placement exists, a
+            # strictly worse shape can never win the primary key.
+            if best_p is not None and shape_score < best_p.score_gbps:
+                break
+            for p in enumerate_placements(self.topo, shape, free, self.cost):
+                frag = _free_boundary(self.topo, frozenset(p.chips), free)
+                key = (-p.score_gbps, frag, p.chips)
+                if best is None or key < best:
+                    best, best_p = key, p
+        return best_p
+
+    def _pick_blob(self, k: int, free: frozenset[Coord]) -> Placement | None:
+        """Connected-blob fallback for k with no feasible box (e.g. k=7, or a
+        fragmented free set).  Greedy accretion, the surviving piece of the
+        reference's design.md:161-186 selector — seeded from every free chip
+        (not one arbitrary closest pair) to dodge the documented tie flaw."""
+        if len(free) < k:
+            return None
+        best: tuple | None = None
+        best_chips: frozenset[Coord] | None = None
+        for seed in sorted(free):
+            blob = {seed}
+            while len(blob) < k:
+                frontier = {
+                    n for c in blob for n in self.topo.neighbors(c)
+                    if n in free and n not in blob
+                }
+                if not frontier:
+                    break
+                # Accrete the chip with most links into the blob (densest growth).
+                nxt = max(
+                    sorted(frontier),
+                    key=lambda c: sum(1 for n in self.topo.neighbors(c) if n in blob),
+                )
+                blob.add(nxt)
+            if len(blob) == k:
+                fb = frozenset(blob)
+                s = score_chip_set(self.topo, fb, self.cost)
+                frag = _free_boundary(self.topo, fb, free)
+                key = (-s, frag, tuple(sorted(fb)))
+                if best is None or key < best:
+                    best, best_chips = key, fb
+        if best_chips is None:
+            return None
+        return Placement(chips=tuple(sorted(best_chips)),
+                         score_gbps=score_chip_set(self.topo, best_chips, self.cost))
+
+    # ---- public API --------------------------------------------------------
+
+    def find(self, k: int, free: frozenset[Coord] | None = None) -> Placement | None:
+        """Best placement for a k-chip request against the (given or current)
+        free set; does not mutate state."""
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        free = self.free if free is None else free
+        if len(free) < k:
+            return None
+        if k == 1:
+            return self._pick_single(free)
+        return self._pick_box(k, free) or self._pick_blob(k, free)
+
+    def allocate(self, k: int) -> Placement | None:
+        p = self.find(k)
+        if p is not None:
+            self.mark_used(p.chips)
+        return p
+
+    def find_gang(self, replicas: int, k: int) -> list[Placement] | None:
+        """All-or-nothing placement of ``replicas`` disjoint k-chip slices
+        (BASELINE config 4: gang-schedule 4 x 4-chip DP replicas on v5p-32).
+
+        Greedy with the anti-fragmentation policy: each successive replica
+        packs against the previous ones, which for divisible shapes yields a
+        lattice tiling.  Returns None unless every replica fits.
+        """
+        free = set(self.free)
+        out: list[Placement] = []
+        for _ in range(replicas):
+            p = self.find(k, frozenset(free))
+            if p is None:
+                return None
+            out.append(p)
+            free.difference_update(p.chips)
+        return out
+
+    def allocate_gang(self, replicas: int, k: int) -> list[Placement] | None:
+        ps = self.find_gang(replicas, k)
+        if ps is not None:
+            for p in ps:
+                self.mark_used(p.chips)
+        return ps
+
+    def largest_free_box(self) -> tuple[int, tuple[int, ...]] | None:
+        """(volume, dims) of the largest free axis-aligned box — the
+        fragmentation health metric (analog of Gaia's fragment-node count,
+        Gaia PDF §III.B)."""
+        free = self.free
+        for k in range(len(free), 0, -1):
+            for shape in enumerate_shapes(self.topo, k, self.cost):
+                if enumerate_placements(self.topo, shape, free, self.cost):
+                    return k, shape.dims
+        return None
