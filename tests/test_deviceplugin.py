@@ -1,0 +1,136 @@
+"""Device-plugin tests: bring-up flow (SURVEY.md §3.1), Allocate flow
+(§3.3), and the ASSIGNED/ASSUME_TIME handshake confirm leg
+(design.md:237-246)."""
+
+import json
+import os
+
+import pytest
+
+from tputopo.deviceplugin import FakeKubelet, TpuDevicePlugin
+from tputopo.deviceplugin import api as dp_api
+from tputopo.discovery.shim import _probe_python, _to_host_probe
+from tputopo.k8s import FakeApiServer, make_pod
+from tputopo.k8s import objects as ko
+
+
+def fake_probe(spec: str):
+    env = dict(os.environ)
+    env["TPUTOPO_FAKE"] = spec
+    return _to_host_probe(_probe_python(env))
+
+
+def make_plugin(spec="v5p:2x2x4@1", node="n1", clock=None):
+    api_server = FakeApiServer()
+    kubelet = FakeKubelet()
+    plugin = TpuDevicePlugin(
+        node_name=node, slice_id="slice-a", kubelet=kubelet,
+        api_server=api_server, probe=fake_probe(spec),
+        clock=clock or (lambda: 1000.0),
+    )
+    return plugin, kubelet, api_server
+
+
+def test_bringup_registers_and_reports():
+    plugin, kubelet, api_server = make_plugin()
+    plugin.start()
+    # Registration happened with the canonical resource name.
+    assert kubelet.registrations[0].resource_name == ko.RESOURCE_CHIPS
+    assert kubelet.allocatable(ko.RESOURCE_CHIPS) == 4
+    # Node object was created with topology annotations.
+    node = api_server.get("nodes", "n1")
+    anns = node["metadata"]["annotations"]
+    assert anns[ko.ANN_TOPOLOGY] == "v5p:2x2x4:wrap=000"
+    assert anns[ko.ANN_HOST_COORD] == "0,0,1"  # worker 1 of 4 hosts along z
+    chips = json.loads(anns[ko.ANN_CHIPS])
+    assert [c["id"] for c in chips] == ["0,0,1", "0,1,1", "1,0,1", "1,1,1"]
+    assert anns[ko.ANN_SLICE_ID] == "slice-a"
+    assert "v5p 2x2x4" in anns[ko.ANN_TOPOLOGY_HUMAN]
+    assert node["metadata"]["labels"][ko.ANN_GENERATION_LABEL] == "v5p"
+
+
+def test_bringup_patches_existing_node():
+    plugin, kubelet, api_server = make_plugin()
+    from tputopo.k8s import make_node
+    api_server.create("nodes", make_node("n1", chips=0, labels={"x": "y"}))
+    plugin.start()
+    node = api_server.get("nodes", "n1")
+    assert node["metadata"]["labels"] == {"x": "y"}  # preserved
+    assert ko.ANN_TOPOLOGY in node["metadata"]["annotations"]
+
+
+def test_allocate_honors_extender_group_and_confirms():
+    plugin, kubelet, api_server = make_plugin()
+    plugin.start()
+    # The extender bound a pod to this node choosing chips (0,0,1),(0,1,1).
+    pod = make_pod("job-0", chips=2, node_name="n1", annotations={
+        ko.ANN_GROUP: "0,0,1;0,1,1",
+        ko.ANN_ASSUME_TIME: "999.0",
+        ko.ANN_ASSIGNED: "false",
+    })
+    api_server.create("pods", pod)
+    # kubelet calls Allocate with its own (possibly different) pick:
+    resp = kubelet.allocate(ko.RESOURCE_CHIPS, ["1,0,1", "1,1,1"])
+    env = resp.container_responses[0].envs
+    # The pod annotation wins (flow ⑥), mapped to local chip indices 0,1.
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1"
+    assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+    assert env["TPU_WORKER_ID"] == "1"
+    assert env["TPU_SLICE_TOPOLOGY"] == "2x2x4"
+    # Device mounts for the chosen chips.
+    assert [d.host_path for d in resp.container_responses[0].devices] == \
+        ["/dev/accel0", "/dev/accel1"]
+    # Handshake confirmed: ASSIGNED true, fresh assume time.
+    fresh = api_server.get("pods", "job-0", "default")
+    assert fresh["metadata"]["annotations"][ko.ANN_ASSIGNED] == "true"
+    assert fresh["metadata"]["annotations"][ko.ANN_ASSUME_TIME] == "1000.0"
+
+
+def test_allocate_without_pending_pod_uses_kubelet_ids():
+    plugin, kubelet, api_server = make_plugin()
+    plugin.start()
+    resp = kubelet.allocate(ko.RESOURCE_CHIPS, ["0,0,1"])
+    assert resp.container_responses[0].envs["TPU_VISIBLE_CHIPS"] == "0"
+
+
+def test_allocate_oldest_pending_pod_wins():
+    plugin, kubelet, api_server = make_plugin()
+    plugin.start()
+    for name, t, group in [("new", "2000", "0,0,1"), ("old", "100", "0,1,1")]:
+        api_server.create("pods", make_pod(name, chips=1, node_name="n1",
+                          annotations={ko.ANN_GROUP: group,
+                                       ko.ANN_ASSUME_TIME: t,
+                                       ko.ANN_ASSIGNED: "false"}))
+    kubelet.allocate(ko.RESOURCE_CHIPS, ["1,1,1"])
+    assert api_server.get("pods", "old", "default")["metadata"]["annotations"][
+        ko.ANN_ASSIGNED] == "true"
+    assert api_server.get("pods", "new", "default")["metadata"]["annotations"][
+        ko.ANN_ASSIGNED] == "false"
+
+
+def test_health_flip_propagates_to_kubelet():
+    plugin, kubelet, api_server = make_plugin()
+    plugin.start()
+    assert kubelet.allocatable(ko.RESOURCE_CHIPS) == 4
+    plugin.set_health("0,0,1", healthy=False)
+    assert kubelet.allocatable(ko.RESOURCE_CHIPS) == 3
+    assert kubelet.devices["0,0,1"].health == dp_api.UNHEALTHY
+    plugin.set_health("0,0,1", healthy=True)
+    assert kubelet.allocatable(ko.RESOURCE_CHIPS) == 4
+    with pytest.raises(KeyError):
+        plugin.set_health("9,9,9", True)
+
+
+def test_allocate_rejects_foreign_chip():
+    plugin, kubelet, api_server = make_plugin()
+    plugin.start()
+    with pytest.raises(ValueError):
+        kubelet.allocate(ko.RESOURCE_CHIPS, ["0,0,0"])  # chip on worker 0, not 1
+
+
+def test_failed_probe_refuses_to_start():
+    env = {k: v for k, v in os.environ.items() if k != "TPUTOPO_FAKE"}
+    env.pop("TPU_ACCELERATOR_TYPE", None)
+    bad = _to_host_probe(_probe_python(env))
+    with pytest.raises(RuntimeError):
+        TpuDevicePlugin("n0", "s", FakeKubelet(), FakeApiServer(), probe=bad)

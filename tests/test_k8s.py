@@ -1,0 +1,93 @@
+"""Fake API server + object model tests."""
+
+import threading
+
+import pytest
+
+from tputopo.k8s import Conflict, FakeApiServer, NotFound, make_node, make_pod
+from tputopo.k8s import objects as ko
+
+
+def test_create_get_list_delete():
+    api = FakeApiServer()
+    api.create("nodes", make_node("n0", chips=4))
+    api.create("pods", make_pod("p0", chips=2))
+    assert api.get("nodes", "n0")["status"]["allocatable"][ko.RESOURCE_CHIPS] == "4"
+    assert len(api.list("pods")) == 1
+    api.delete("pods", "p0", namespace="default")
+    with pytest.raises(NotFound):
+        api.get("pods", "p0", namespace="default")
+    with pytest.raises(Conflict):
+        api.create("nodes", make_node("n0"))
+
+
+def test_requested_chips_parsing():
+    assert ko.pod_requested_chips(make_pod("p", chips=4)) == 4
+    assert ko.pod_requested_chips(make_pod("p", chips=0)) == 0
+
+
+def test_group_annotation_roundtrip():
+    coords = [(0, 0, 1), (0, 1, 1)]
+    s = ko.coords_to_ann(coords)
+    assert s == "0,0,1;0,1,1"
+    assert ko.ann_to_coords(s) == coords
+    assert ko.ann_to_coords("") == []
+
+
+def test_patch_annotations_merge_and_delete():
+    api = FakeApiServer()
+    api.create("pods", make_pod("p0", annotations={"a": "1"}))
+    api.patch_annotations("pods", "p0", {"b": "2"}, namespace="default")
+    obj = api.patch_annotations("pods", "p0", {"a": None}, namespace="default")
+    assert obj["metadata"]["annotations"] == {"b": "2"}
+
+
+def test_patch_cas_conflict():
+    api = FakeApiServer()
+    obj = api.create("pods", make_pod("p0"))
+    rv = obj["metadata"]["resourceVersion"]
+    api.patch_annotations("pods", "p0", {"x": "1"}, namespace="default")
+    with pytest.raises(Conflict):
+        api.patch_annotations("pods", "p0", {"y": "2"}, namespace="default",
+                              expect_version=rv)
+
+
+def test_bind_pod_once():
+    api = FakeApiServer()
+    api.create("pods", make_pod("p0", chips=1))
+    pod = api.bind_pod("p0", "n3", namespace="default")
+    assert pod["spec"]["nodeName"] == "n3"
+    with pytest.raises(Conflict):
+        api.bind_pod("p0", "n4", namespace="default")
+    assert api.pods_on_node("n3")[0]["metadata"]["name"] == "p0"
+
+
+def test_deep_copy_isolation():
+    api = FakeApiServer()
+    api.create("nodes", make_node("n0", chips=4))
+    got = api.get("nodes", "n0")
+    got["status"]["allocatable"][ko.RESOURCE_CHIPS] = "999"
+    assert api.get("nodes", "n0")["status"]["allocatable"][ko.RESOURCE_CHIPS] == "4"
+
+
+def test_concurrent_patches_are_serialized():
+    api = FakeApiServer()
+    api.create("pods", make_pod("p0"))
+    errs = []
+
+    def worker(i):
+        try:
+            for j in range(50):
+                api.patch_annotations("pods", "p0", {f"k{i}-{j}": "v"},
+                                      namespace="default")
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    anns = api.get("pods", "p0", "default")["metadata"]["annotations"]
+    assert len(anns) == 200

@@ -43,6 +43,17 @@ def test_disconnected_set_scores_dcn_low():
     assert connected / disconnected > 2
 
 
+def test_split_ordering_is_total():
+    # ICI-contiguous > same-host split (host-DMA path, the PHB analog,
+    # design.md:38-40) > cross-host split (DCN) — strict, no ties.
+    t = v5p_2x2x4()
+    cost = LinkCostModel.for_generation("v5p")
+    adjacent = score_chip_set(t, {(0, 0, 0), (0, 0, 1)}, cost)
+    same_host_split = score_chip_set(t, {(0, 0, 0), (1, 1, 0)}, cost)
+    cross_host_split = score_chip_set(t, {(0, 0, 0), (0, 0, 3)}, cost)
+    assert adjacent > same_host_split > cross_host_split
+
+
 def test_single_chip_scores_zero():
     t = v5p_2x2x4()
     assert score_chip_set(t, {(0, 0, 0)}) == 0.0

@@ -1,0 +1,14 @@
+"""TPU device plugin: node agent advertising chips to the kubelet and
+injecting visibility env vars at Allocate (reference components 2.4/2.5/2.9,
+design.md:57-86, 237-246)."""
+
+from tputopo.deviceplugin.api import (  # noqa: F401
+    Device,
+    AllocateRequest,
+    AllocateResponse,
+    ContainerAllocateResponse,
+    DeviceSpec,
+    FakeKubelet,
+)
+from tputopo.deviceplugin.plugin import TpuDevicePlugin  # noqa: F401
+from tputopo.deviceplugin.reporter import node_annotations_for_probe, node_object_for_probe  # noqa: F401

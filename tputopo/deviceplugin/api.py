@@ -1,0 +1,113 @@
+"""Device-plugin API types + in-process kubelet transport.
+
+Python-side types for the contract in ``deviceplugin.proto`` (the kubelet
+device-plugin gRPC shape the reference design uses, design.md:57-59).  The
+transport is pluggable: :class:`FakeKubelet` drives the same Register /
+ListAndWatch / Allocate state machine in-process, which is how the whole
+node-agent plane tests without a cluster (SURVEY.md §4.4 — kind/envtest is
+only needed for the final real-kubelet leg).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+
+
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+API_VERSION = "v1beta1"
+
+
+@dataclass(frozen=True)
+class Device:
+    id: str           # global chip coordinate string, e.g. "0,0,1"
+    health: str = HEALTHY
+
+
+@dataclass(frozen=True)
+class DeviceSpec:
+    container_path: str
+    host_path: str
+    permissions: str = "rw"
+
+
+@dataclass
+class ContainerAllocateResponse:
+    envs: dict[str, str] = field(default_factory=dict)
+    devices: list[DeviceSpec] = field(default_factory=list)
+
+
+@dataclass
+class AllocateRequest:
+    container_device_ids: list[list[str]]
+
+
+@dataclass
+class AllocateResponse:
+    container_responses: list[ContainerAllocateResponse]
+
+
+@dataclass(frozen=True)
+class RegisterRequest:
+    version: str
+    endpoint: str
+    resource_name: str
+
+
+class FakeKubelet:
+    """In-process stand-in for the kubelet side of the device-plugin API.
+
+    Mirrors kubelet behavior the plugin depends on: accepts Register, pulls
+    the ListAndWatch stream into a device inventory, and forwards Allocate
+    calls.  Exposes that inventory to tests/extender fixtures.
+    """
+
+    def __init__(self) -> None:
+        self.registrations: list[RegisterRequest] = []
+        self.devices: dict[str, Device] = {}
+        self._plugins: dict[str, "object"] = {}  # resource -> plugin
+        self._updates: queue.Queue = queue.Queue()
+        self._lock = threading.Lock()
+
+    # -- Registration service ----------------------------------------------
+
+    def register(self, req: RegisterRequest, plugin) -> None:
+        if req.version != API_VERSION:
+            raise ValueError(
+                f"unsupported device-plugin API version {req.version!r}"
+            )
+        with self._lock:
+            self.registrations.append(req)
+            self._plugins[req.resource_name] = plugin
+        # kubelet immediately opens the ListAndWatch stream:
+        for resp in plugin.list_and_watch_once():
+            self._consume(resp)
+
+    def _consume(self, devices: list[Device]) -> None:
+        with self._lock:
+            self.devices = {d.id: d for d in devices}
+        self._updates.put(devices)
+
+    def notify_devices(self, devices: list[Device]) -> None:
+        """Plugin pushes an updated device list (health change etc.)."""
+        self._consume(devices)
+
+    # -- scheduling-side views ----------------------------------------------
+
+    def allocatable(self, resource: str) -> int:
+        with self._lock:
+            if resource not in self._plugins:
+                return 0
+            return sum(1 for d in self.devices.values() if d.health == HEALTHY)
+
+    def allocate(self, resource: str, device_ids: list[str]) -> AllocateResponse:
+        with self._lock:
+            plugin = self._plugins.get(resource)
+            if plugin is None:
+                raise KeyError(f"no device plugin registered for {resource}")
+            unknown = [d for d in device_ids if d not in self.devices]
+        if unknown:
+            raise ValueError(f"unknown device ids {unknown}")
+        return plugin.allocate(AllocateRequest(container_device_ids=[device_ids]))

@@ -1,0 +1,187 @@
+"""The TPU device plugin.
+
+Rebuild of reference component 2.4 (design.md:57-86, 237-246; flow steps
+①②⑥⑦ of imgs/gpu_topology_on_k8s.png):
+
+1. At init, probe local topology through the discovery shim (the NVML-init
+   analog, design.md:57-59) and publish node annotations (component 2.5).
+2. Register with the kubelet and advertise one device per local chip via
+   ListAndWatch, with health (the ``isUsed``/health stream, design.md:84-86).
+3. At Allocate, honor the scheduler extender's chip choice recorded in the
+   pod's ``tpu.dev/chip-group`` annotation (the reference reads
+   ``ALIYUN_COM_GPU_GROUP`` the same way, flow ⑥), inject the visibility
+   environment (``TPU_VISIBLE_CHIPS`` — the ``NVIDIA_VISIBLE_DEVICES``
+   analog, design.md:239) plus device mounts, and confirm the optimistic
+   handshake: ``tpu.dev/assigned`` -> "true" with a fresh assume-time
+   (design.md:241-246).
+
+No custom container runtime is needed (reference component 2.15 analog):
+chips reach containers via device-file mounts + env, which the standard
+runtime honors.
+"""
+
+from __future__ import annotations
+
+import time
+
+from tputopo.deviceplugin import api
+from tputopo.deviceplugin.reporter import node_annotations_for_probe
+from tputopo.discovery.shim import HostProbe, probe_host
+from tputopo.k8s import objects as ko
+from tputopo.k8s.fakeapi import Conflict, FakeApiServer, NotFound
+
+
+def coord_id(coord) -> str:
+    return ",".join(str(x) for x in coord)
+
+
+class TpuDevicePlugin:
+    def __init__(self, node_name: str, slice_id: str,
+                 kubelet: api.FakeKubelet, api_server: FakeApiServer,
+                 probe: HostProbe | None = None,
+                 clock=time.time) -> None:
+        self.node_name = node_name
+        self.slice_id = slice_id
+        self.kubelet = kubelet
+        self.api_server = api_server
+        self.probe = probe if probe is not None else probe_host()
+        if not self.probe.ok:
+            raise RuntimeError(f"topology probe failed: {self.probe.error}")
+        self.clock = clock
+        self._health: dict[str, str] = {
+            coord_id(c["coords"]): api.HEALTHY for c in self.probe.chips
+        }
+        self._device_paths: dict[str, str] = {
+            coord_id(c["coords"]): c.get("device_path", "")
+            for c in self.probe.chips
+        }
+        self._local_ids: dict[str, int] = {
+            coord_id(c["coords"]): c["local_id"] for c in self.probe.chips
+        }
+
+    # ---- bring-up (SURVEY.md §3.1) ----------------------------------------
+
+    def start(self) -> None:
+        """Publish topology annotations, then register with the kubelet."""
+        anns = node_annotations_for_probe(self.probe, self.slice_id)
+        try:
+            self.api_server.patch_annotations("nodes", self.node_name, anns)
+        except NotFound:
+            from tputopo.deviceplugin.reporter import node_object_for_probe
+            self.api_server.create(
+                "nodes",
+                node_object_for_probe(self.probe, self.node_name, self.slice_id),
+            )
+        self.kubelet.register(
+            api.RegisterRequest(
+                version=api.API_VERSION,
+                endpoint=f"tputopo-{self.node_name}.sock",
+                resource_name=ko.RESOURCE_CHIPS,
+            ),
+            self,
+        )
+
+    # ---- device-plugin service --------------------------------------------
+
+    def list_and_watch_once(self) -> list[list[api.Device]]:
+        """One ListAndWatch frame: the current device list."""
+        return [self.devices()]
+
+    def devices(self) -> list[api.Device]:
+        return [api.Device(id=cid, health=h) for cid, h in sorted(self._health.items())]
+
+    def set_health(self, chip_id: str, healthy: bool) -> None:
+        """Flip a chip's health and push a ListAndWatch update — the failure
+        detection surface (SURVEY.md §5.3: device health is the only
+        resilience stream the reference defines)."""
+        if chip_id not in self._health:
+            raise KeyError(f"unknown chip {chip_id}")
+        self._health[chip_id] = api.HEALTHY if healthy else api.UNHEALTHY
+        self.kubelet.notify_devices(self.devices())
+
+    def allocate(self, req: api.AllocateRequest) -> api.AllocateResponse:
+        responses = []
+        for device_ids in req.container_device_ids:
+            pod = self._find_pending_pod(len(device_ids))
+            if pod is not None:
+                # Honor the extender's choice (flow ⑥): the pod annotation,
+                # not the kubelet's arbitrary pick, is authoritative.
+                group = ko.ann_to_coords(
+                    pod["metadata"]["annotations"][ko.ANN_GROUP])
+                chip_ids = [coord_id(c) for c in group]
+                self._confirm_assignment(pod)
+            else:
+                chip_ids = list(device_ids)
+            responses.append(self._container_response(chip_ids))
+        return api.AllocateResponse(container_responses=responses)
+
+    # ---- internals ---------------------------------------------------------
+
+    def _find_pending_pod(self, n_devices: int) -> dict | None:
+        """Oldest pod on this node still awaiting its Allocate confirm with a
+        matching device count (the reference's assumed-pod lookup, the
+        device-side half of the two-phase handshake)."""
+        pods = self.api_server.list(
+            "pods",
+            lambda p: (
+                p["spec"].get("nodeName") == self.node_name
+                and p["metadata"].get("annotations", {}).get(ko.ANN_ASSIGNED) == "false"
+                and len(ko.ann_to_coords(
+                    p["metadata"]["annotations"].get(ko.ANN_GROUP, ""))) == n_devices
+            ),
+        )
+        if not pods:
+            return None
+        pods.sort(key=lambda p: float(
+            p["metadata"]["annotations"].get(ko.ANN_ASSUME_TIME, "0")))
+        return pods[0]
+
+    def _confirm_assignment(self, pod: dict) -> None:
+        md = pod["metadata"]
+        try:
+            self.api_server.patch_annotations(
+                "pods", md["name"],
+                {ko.ANN_ASSIGNED: "true",
+                 ko.ANN_ASSUME_TIME: str(self.clock())},
+                namespace=md.get("namespace"),
+                expect_version=md.get("resourceVersion"),
+            )
+        except Conflict:
+            # Someone raced us (extender GC or a duplicate Allocate).  The
+            # handshake is optimistic by design (design.md:227-232); re-read
+            # and only fail if the pod is genuinely gone.
+            fresh = self.api_server.get("pods", md["name"], md.get("namespace"))
+            if fresh["metadata"]["annotations"].get(ko.ANN_ASSIGNED) != "true":
+                self.api_server.patch_annotations(
+                    "pods", md["name"],
+                    {ko.ANN_ASSIGNED: "true",
+                     ko.ANN_ASSUME_TIME: str(self.clock())},
+                    namespace=md.get("namespace"),
+                )
+
+    def _container_response(self, chip_ids: list[str]) -> api.ContainerAllocateResponse:
+        local_ids = []
+        devices = []
+        for cid in chip_ids:
+            if cid not in self._local_ids:
+                raise ValueError(
+                    f"chip {cid} is not on node {self.node_name} "
+                    f"(has {sorted(self._local_ids)})"
+                )
+            local_ids.append(self._local_ids[cid])
+            path = self._device_paths.get(cid)
+            if path:
+                devices.append(api.DeviceSpec(
+                    container_path=path, host_path=path, permissions="rw"))
+        envs = {
+            # The NVIDIA_VISIBLE_DEVICES analog (design.md:239): local chip
+            # indices the TPU runtime should expose to this container.
+            "TPU_VISIBLE_CHIPS": ",".join(str(i) for i in sorted(local_ids)),
+            "TPU_CHIPS_PER_HOST_BOUNDS": ",".join(
+                str(b) for b in self.probe.host_bounds),
+            "TPU_WORKER_ID": str(self.probe.worker_id),
+            "TPU_ACCELERATOR_TYPE": self.probe.topology().generation.slice_name(
+                self.probe.topology().num_chips),
+            "TPU_SLICE_TOPOLOGY": "x".join(str(d) for d in self.probe.slice_dims),
+        }
+        return api.ContainerAllocateResponse(envs=envs, devices=devices)

@@ -1,0 +1,52 @@
+"""Node topology reporter: serialize a host probe into node annotations.
+
+Analog of reference component 2.5 (design.md:76-82): the GPU design writes
+one annotation per topology-matrix edge (``GPU_SYS_0_1: Cross CPU socket``);
+a torus is fully described by its shape plus this host's coordinate, so the
+TPU report is a handful of annotations — including a human-readable line,
+preserving the reference's annotations-as-observability posture
+(SURVEY.md §5.5).
+"""
+
+from __future__ import annotations
+
+import json
+
+from tputopo.discovery.shim import HostProbe
+from tputopo.k8s import objects as ko
+from tputopo.topology.model import format_topology
+
+
+def node_annotations_for_probe(probe: HostProbe, slice_id: str) -> dict[str, str]:
+    if not probe.ok:
+        raise ValueError(f"cannot report a failed probe: {probe.error}")
+    topo = probe.topology()
+    return {
+        ko.ANN_TOPOLOGY: format_topology(topo),
+        ko.ANN_HOST_COORD: ",".join(str(x) for x in probe.host_coord),
+        ko.ANN_CHIPS: json.dumps(
+            [{"id": ",".join(str(x) for x in c["coords"]),
+              "local_id": c["local_id"],
+              **({"device_path": c["device_path"]} if "device_path" in c else {})}
+             for c in probe.chips],
+            separators=(",", ":"),
+        ),
+        ko.ANN_SLICE_ID: slice_id,
+        ko.ANN_TOPOLOGY_HUMAN: (
+            f"{topo.describe()}; this host {probe.host_coord} owns "
+            f"{len(probe.chips)} chips "
+            f"{[tuple(c['coords']) for c in probe.chips]}"
+        ),
+    }
+
+
+def node_object_for_probe(probe: HostProbe, node_name: str, slice_id: str) -> dict:
+    """A complete Node object for the fake API server / fixtures: labels for
+    quota classing (Gaia heterogeneous quota, PDF §III.A -> generation
+    label), allocatable chip count, topology annotations."""
+    return ko.make_node(
+        node_name,
+        chips=len(probe.chips),
+        labels={ko.ANN_GENERATION_LABEL: probe.generation},
+        annotations=node_annotations_for_probe(probe, slice_id),
+    )

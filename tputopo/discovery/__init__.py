@@ -1,0 +1,8 @@
+"""Topology discovery: the device plugin's view of the local host.
+
+The rebuild's NVML layer (reference design.md:25-55 reaches NVML through
+cgo; here a C++ shim ``libtputopo.so`` is reached through ctypes, with a
+pure-Python twin for environments where the shim isn't built).
+"""
+
+from tputopo.discovery.shim import HostProbe, probe_host, ensure_native_built  # noqa: F401

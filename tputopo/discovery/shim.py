@@ -1,0 +1,287 @@
+"""ctypes bindings for libtputopo.so plus a pure-Python fallback probe.
+
+Layering mirrors the reference (design.md:51-53: Go device plugin → cgo →
+NVML C library): Python device plugin → ctypes → libtputopo C++ shim.  The
+pure-Python fallback implements identical semantics so dev boxes without a
+compiler still work; tests assert native and fallback agree bit-for-bit
+(the SURVEY.md §4.2 "fake discovery backend" requirement).
+
+Backend selection (both implementations):
+- ``TPUTOPO_FAKE="<gen>:<AxBxC>[@worker]"`` -> fabricated topology (the
+  CPU-emulated twin, BASELINE config 1).
+- else the real TPU runtime environment (``TPU_ACCELERATOR_TYPE``,
+  ``TPU_CHIPS_PER_HOST_BOUNDS``, ``TPU_HOST_BOUNDS``, ``TPU_WORKER_ID``)
+  plus a /dev scan for accelerator device files.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import math
+import os
+import re
+import subprocess
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tputopo.topology.generations import GENERATIONS, get_generation
+from tputopo.topology.model import ChipTopology
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
+_SO_PATH = _NATIVE_DIR / "libtputopo.so"
+
+# TPU_ACCELERATOR_TYPE prefix -> generation name (sync with tputopo.cc).
+_TYPE_PREFIXES = [
+    ("v5litepod", "v5e"),
+    ("v5p", "v5p"),
+    ("v5e", "v5e"),
+    ("v6e", "v6e"),
+    ("v4", "v4"),
+]
+
+
+@dataclass(frozen=True)
+class HostProbe:
+    """One host's discovered place in the slice — the analog of the
+    reference's per-node ``gpuTopology`` matrix (design.md:61-74)."""
+
+    backend: str
+    generation: str
+    slice_dims: tuple[int, ...]
+    host_bounds: tuple[int, ...]
+    worker_id: int
+    host_coord: tuple[int, ...]
+    chips: tuple[dict, ...]  # {"local_id": int, "coords": [..], "device_path": str?}
+    error: str | None = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+    def topology(self) -> ChipTopology:
+        """The global slice topology this host belongs to."""
+        return ChipTopology.build(self.generation, self.slice_dims)
+
+    def local_chip_coords(self) -> list[tuple[int, ...]]:
+        return [tuple(c["coords"]) for c in self.chips]
+
+
+def ensure_native_built(force: bool = False) -> Path | None:
+    """Build libtputopo.so if a toolchain is available; returns the path or
+    None when no compiler exists (the pure-Python fallback then serves)."""
+    if _SO_PATH.exists() and not force:
+        return _SO_PATH
+    try:
+        subprocess.run(
+            ["make", "-s", "libtputopo.so"],
+            cwd=_NATIVE_DIR,
+            check=True,
+            capture_output=True,
+        )
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    return _SO_PATH if _SO_PATH.exists() else None
+
+
+_lib_cache: ctypes.CDLL | None = None
+
+
+def _load_native() -> ctypes.CDLL | None:
+    global _lib_cache
+    if _lib_cache is not None:
+        return _lib_cache
+    if not _SO_PATH.exists():
+        return None
+    try:
+        lib = ctypes.CDLL(str(_SO_PATH))
+    except OSError:
+        return None
+    lib.tputopo_probe.restype = ctypes.c_int
+    lib.tputopo_probe.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.tputopo_version.restype = ctypes.c_char_p
+    _lib_cache = lib
+    return lib
+
+
+def _probe_native(lib: ctypes.CDLL) -> dict:
+    cap = 1 << 16
+    while True:
+        buf = ctypes.create_string_buffer(cap)
+        need = lib.tputopo_probe(buf, cap)
+        if need < cap:
+            return json.loads(buf.value.decode())
+        cap = need + 1
+
+
+# ---- pure-Python twin of the C++ probe --------------------------------------
+
+
+def _parse_dims(s: str) -> tuple[int, ...] | None:
+    if not re.fullmatch(r"\d+([x,X]\d+)*", s):
+        return None
+    return tuple(int(x) for x in re.split(r"[x,X]", s))
+
+
+def _host_coord(worker_id: int, slice_dims, host_bounds) -> tuple[int, ...]:
+    grid = [max(1, s // b) for s, b in zip(slice_dims, host_bounds)]
+    out = [0] * len(grid)
+    rem = worker_id
+    for i in range(len(grid) - 1, -1, -1):
+        out[i] = rem % grid[i]
+        rem //= grid[i]
+    return tuple(out)
+
+
+def _chips_for_host(host_coord, host_bounds, device_paths) -> tuple[dict, ...]:
+    per_host = math.prod(host_bounds)
+    chips = []
+    for idx in range(per_host):
+        local = [0] * len(host_bounds)
+        rem = idx
+        for i in range(len(host_bounds) - 1, -1, -1):
+            local[i] = rem % host_bounds[i]
+            rem //= host_bounds[i]
+        entry = {
+            "local_id": idx,
+            "coords": [h * b + l for h, b, l in zip(host_coord, host_bounds, local)],
+        }
+        if idx < len(device_paths):
+            entry["device_path"] = device_paths[idx]
+        chips.append(entry)
+    return tuple(chips)
+
+
+def _probe_python(env: dict[str, str] | None = None) -> dict:
+    env = dict(os.environ if env is None else env)
+
+    fake = env.get("TPUTOPO_FAKE", "")
+    if fake:
+        worker = 0
+        body = fake
+        if "@" in fake:
+            body, _, wid = fake.partition("@")
+            try:
+                worker = int(wid)
+            except ValueError:
+                worker = 0
+        if ":" not in body:
+            return {"backend": "fake",
+                    "error": f"TPUTOPO_FAKE wants '<gen>:<AxBxC>[@worker]', got '{fake}'"}
+        gen_name, _, dim_s = body.partition(":")
+        if gen_name not in GENERATIONS:
+            return {"backend": "fake",
+                    "error": f"unknown generation '{gen_name}' in TPUTOPO_FAKE"}
+        g = get_generation(gen_name)
+        dims = _parse_dims(dim_s)
+        if dims is None or len(dims) != g.ndims:
+            return {"backend": "fake",
+                    "error": f"bad dims for {gen_name} in TPUTOPO_FAKE (want {g.ndims}-D)"}
+        host_bounds = tuple(min(b, d) for b, d in zip(g.host_bounds, dims))
+        hc = _host_coord(worker, dims, host_bounds)
+        paths = [f"/dev/accel{i}" for i in range(math.prod(host_bounds))]
+        return {
+            "backend": "fake",
+            "generation": g.name,
+            "ndims": g.ndims,
+            "cores_per_chip": g.cores_per_chip,
+            "slice_dims": list(dims),
+            "host_bounds": list(host_bounds),
+            "worker_id": worker,
+            "host_coord": list(hc),
+            "chips": list(_chips_for_host(hc, host_bounds, paths)),
+        }
+
+    accel_type = env.get("TPU_ACCELERATOR_TYPE", "")
+    if not accel_type:
+        return {"backend": "real",
+                "error": "no TPU runtime detected: TPU_ACCELERATOR_TYPE unset "
+                         "and TPUTOPO_FAKE not provided"}
+    gen_name = None
+    for prefix, name in sorted(_TYPE_PREFIXES, key=lambda p: -len(p[0])):
+        if accel_type.startswith(prefix):
+            gen_name = name
+            break
+    if gen_name is None:
+        return {"backend": "real",
+                "error": f"unrecognized TPU_ACCELERATOR_TYPE '{accel_type}'"}
+    g = get_generation(gen_name)
+    host_bounds = list(g.host_bounds)
+    hb = _parse_dims(env.get("TPU_CHIPS_PER_HOST_BOUNDS", ""))
+    if hb and len(hb) == g.ndims:
+        host_bounds = list(hb)
+
+    cores = 0
+    if "-" in accel_type:
+        try:
+            cores = int(accel_type.rsplit("-", 1)[1])
+        except ValueError:
+            cores = 0
+    chips = cores // g.cores_per_chip if g.cores_per_chip else cores
+
+    slice_dims = [1] * g.ndims
+    hosts = _parse_dims(env.get("TPU_HOST_BOUNDS", ""))
+    if hosts and len(hosts) == g.ndims:
+        slice_dims = [h * b for h, b in zip(hosts, host_bounds)]
+    elif chips > 0:
+        per_host = math.prod(host_bounds)
+        if chips <= per_host:
+            slice_dims = [1] * g.ndims
+            slice_dims[0] = chips
+        else:
+            slice_dims = list(host_bounds)
+            slice_dims[-1] *= chips // per_host
+
+    wid_s = env.get("TPU_WORKER_ID", "") or env.get("CLOUD_TPU_TASK_ID", "")
+    worker = int(wid_s) if wid_s.isdigit() else 0
+
+    paths = sorted(
+        f"/dev/{n}" for n in os.listdir("/dev")
+        if n.startswith("accel") or n.startswith("vfio")
+    ) if os.path.isdir("/dev") else []
+
+    hc = _host_coord(worker, slice_dims, host_bounds)
+    return {
+        "backend": "real",
+        "generation": g.name,
+        "ndims": g.ndims,
+        "cores_per_chip": g.cores_per_chip,
+        "slice_dims": slice_dims,
+        "host_bounds": host_bounds,
+        "worker_id": worker,
+        "host_coord": list(hc),
+        "chips": list(_chips_for_host(hc, host_bounds, paths)),
+    }
+
+
+def _to_host_probe(d: dict) -> HostProbe:
+    if "error" in d:
+        return HostProbe(
+            backend=d.get("backend", "?"), generation="", slice_dims=(),
+            host_bounds=(), worker_id=0, host_coord=(), chips=(),
+            error=d["error"],
+        )
+    return HostProbe(
+        backend=d["backend"],
+        generation=d["generation"],
+        slice_dims=tuple(d["slice_dims"]),
+        host_bounds=tuple(d["host_bounds"]),
+        worker_id=d["worker_id"],
+        host_coord=tuple(d["host_coord"]),
+        chips=tuple(d["chips"]),
+    )
+
+
+def probe_host(prefer_native: bool = True, build: bool = False) -> HostProbe:
+    """Probe this host's TPU topology.
+
+    Uses the native shim when present (``build=True`` compiles it on demand),
+    else the pure-Python twin.  Both honor ``TPUTOPO_FAKE``.
+    """
+    if build:
+        ensure_native_built()
+    if prefer_native:
+        lib = _load_native()
+        if lib is not None:
+            return _to_host_probe(_probe_native(lib))
+    return _to_host_probe(_probe_python())

@@ -1,0 +1,18 @@
+"""Minimal Kubernetes object model + in-memory API server test double.
+
+The reference keeps all durable state in Kubernetes objects — topology in
+node annotations (design.md:76-82), assignments in pod annotations
+(design.md:223-234) — and rebuilds everything else from the API server
+(SURVEY.md §5.4 statelessness posture).  This package gives the framework
+that state plane: dict-shaped Node/Pod objects matching the real API
+surface, and a FakeApiServer with patch/bind/watch semantics so the whole
+stack tests without a cluster (SURVEY.md §4.3-4.4).
+"""
+
+from tputopo.k8s.objects import (  # noqa: F401
+    Annotations,
+    make_node,
+    make_pod,
+    pod_requested_chips,
+)
+from tputopo.k8s.fakeapi import FakeApiServer, Conflict, NotFound  # noqa: F401

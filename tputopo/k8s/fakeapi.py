@@ -1,0 +1,143 @@
+"""In-memory Kubernetes API server double.
+
+Stands in for the API server + etcd (reference component 2.16: Gaia persists
+assignments in etcd, PDF §III.C step 5; the design keeps them in pod
+annotations, design.md:223-234).  Implements just what the framework's
+control flows use: typed object store, strategic-merge-style metadata
+patches with optimistic concurrency (resourceVersion), pod binding, and a
+simple event list for test assertions.
+
+Thread-safe: the extender HTTP server and device-plugin confirm leg hit it
+concurrently (the bind-vs-allocate race the handshake exists for,
+SURVEY.md §3.3 note).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Any, Callable, Iterable
+
+
+class NotFound(KeyError):
+    pass
+
+
+class Conflict(RuntimeError):
+    """resourceVersion mismatch — the optimistic-concurrency signal."""
+
+
+def _key(namespace: str | None, name: str) -> tuple[str, str]:
+    return (namespace or "", name)
+
+
+class FakeApiServer:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._objects: dict[str, dict[tuple[str, str], dict]] = {
+            "nodes": {},
+            "pods": {},
+        }
+        self._rv = 0
+        self.events: list[dict] = []
+
+    # ---- helpers ----------------------------------------------------------
+
+    def _bump(self, obj: dict) -> None:
+        self._rv += 1
+        obj["metadata"]["resourceVersion"] = str(self._rv)
+
+    def _store(self, kind: str) -> dict[tuple[str, str], dict]:
+        return self._objects[kind]
+
+    # ---- CRUD -------------------------------------------------------------
+
+    def create(self, kind: str, obj: dict) -> dict:
+        with self._lock:
+            md = obj["metadata"]
+            k = _key(md.get("namespace"), md["name"])
+            store = self._store(kind)
+            if k in store:
+                raise Conflict(f"{kind} {k} already exists")
+            copy_ = copy.deepcopy(obj)
+            self._bump(copy_)
+            store[k] = copy_
+            return copy.deepcopy(copy_)
+
+    def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
+        with self._lock:
+            try:
+                return copy.deepcopy(self._store(kind)[_key(namespace, name)])
+            except KeyError:
+                raise NotFound(f"{kind} {namespace}/{name}") from None
+
+    def list(self, kind: str, selector: Callable[[dict], bool] | None = None) -> list[dict]:
+        with self._lock:
+            out = [copy.deepcopy(o) for o in self._store(kind).values()]
+        if selector:
+            out = [o for o in out if selector(o)]
+        return sorted(out, key=lambda o: (o["metadata"].get("namespace", ""),
+                                          o["metadata"]["name"]))
+
+    def delete(self, kind: str, name: str, namespace: str | None = None) -> None:
+        with self._lock:
+            try:
+                del self._store(kind)[_key(namespace, name)]
+            except KeyError:
+                raise NotFound(f"{kind} {namespace}/{name}") from None
+
+    # ---- metadata patches (the handshake's transport) ----------------------
+
+    def patch_annotations(self, kind: str, name: str, patch: dict[str, str | None],
+                          namespace: str | None = None,
+                          expect_version: str | None = None) -> dict:
+        """Merge ``patch`` into metadata.annotations (None deletes a key).
+
+        ``expect_version`` enables compare-and-swap: the optimistic token the
+        two-phase ASSUME/ASSIGNED handshake relies on (design.md:227-246).
+        """
+        with self._lock:
+            try:
+                obj = self._store(kind)[_key(namespace, name)]
+            except KeyError:
+                raise NotFound(f"{kind} {namespace}/{name}") from None
+            if expect_version is not None and \
+                    obj["metadata"].get("resourceVersion") != expect_version:
+                raise Conflict(
+                    f"{kind} {name}: resourceVersion {expect_version} is stale"
+                )
+            anns = obj["metadata"].setdefault("annotations", {})
+            for k, v in patch.items():
+                if v is None:
+                    anns.pop(k, None)
+                else:
+                    anns[k] = str(v)
+            self._bump(obj)
+            self.events.append({"type": "patch", "kind": kind, "name": name,
+                                "patch": dict(patch)})
+            return copy.deepcopy(obj)
+
+    # ---- binding (the extender's bind verb target) -------------------------
+
+    def bind_pod(self, name: str, node_name: str, namespace: str | None = None) -> dict:
+        with self._lock:
+            try:
+                pod = self._store("pods")[_key(namespace, name)]
+            except KeyError:
+                raise NotFound(f"pod {namespace}/{name}") from None
+            if pod["spec"].get("nodeName"):
+                raise Conflict(f"pod {name} already bound to {pod['spec']['nodeName']}")
+            pod["spec"]["nodeName"] = node_name
+            pod["status"]["phase"] = "Scheduled"
+            self._bump(pod)
+            self.events.append({"type": "bind", "name": name, "node": node_name})
+            return copy.deepcopy(pod)
+
+    # ---- convenience for tests --------------------------------------------
+
+    def pods_on_node(self, node_name: str) -> list[dict]:
+        return self.list("pods", lambda p: p["spec"].get("nodeName") == node_name)
+
+    def add_nodes(self, nodes: Iterable[dict]) -> None:
+        for n in nodes:
+            self.create("nodes", n)

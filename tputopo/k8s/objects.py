@@ -1,0 +1,113 @@
+"""Kubernetes object helpers and the framework's annotation vocabulary.
+
+The reference's cluster-state contract (design.md:76-86, 223-246) carried
+one resource name (with a documented drift between ``aliyun.com/gpu`` and
+``aliyun.com/gpu-count`` — SURVEY.md §5 "Resource-name drift"; we fix it by
+defining exactly one) and two annotation families: per-node topology and the
+three-field optimistic assignment handshake on pods.  This module is the
+single source of truth for those names in the rebuild.
+
+Objects are plain dicts shaped like real Kubernetes API objects (apiVersion/
+kind/metadata/spec/status) so extender HTTP payloads and fixtures read like
+the real thing.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+# -- The one resource name (fixes the reference's aliyun.com/gpu vs
+#    aliyun.com/gpu-count drift, design.md:86,105 vs :135,149).
+RESOURCE_CHIPS = "tpu.dev/chips"
+
+# -- Node annotations (analog of GPU_<ABBR>_<i>_<j>, design.md:76-82; a
+#    torus is described by shape + host coordinate, not per-edge entries).
+ANN_TOPOLOGY = "tpu.dev/topology"          # e.g. "v5p:2x2x4:wrap=000"
+ANN_HOST_COORD = "tpu.dev/host-coord"      # e.g. "0,0,1" (host grid coords)
+ANN_CHIPS = "tpu.dev/chip-coords"          # JSON list of this node's chip coords
+ANN_SLICE_ID = "tpu.dev/slice-id"          # ICI domain id; nodes sharing it share a torus
+ANN_TOPOLOGY_HUMAN = "tpu.dev/topology-human"  # human-readable observability surface
+ANN_GENERATION_LABEL = "tpu.dev/generation"    # node label for quota classing
+                                               # (Gaia heterogeneous quota, PDF §III.A)
+
+# -- Pod annotations: the optimistic assignment handshake
+#    (design.md:227-232: ALIYUN_COM_GPU_GROUP / ASSUME_TIME / ASSIGNED).
+ANN_GROUP = "tpu.dev/chip-group"           # assigned chip coords, e.g. "0,0,0;0,1,0"
+ANN_ASSUME_TIME = "tpu.dev/assume-time"    # unix seconds, stamped at bind
+ANN_ASSIGNED = "tpu.dev/assigned"          # "false" at bind -> "true" at Allocate
+ANN_GANG_ID = "tpu.dev/gang-id"            # job-level token for gang scheduling
+ANN_PREDICTED_GBPS = "tpu.dev/predicted-allreduce-gbps"  # decision record
+
+Annotations = dict[str, str]
+
+
+def make_node(name: str, *, chips: int = 0, labels: Annotations | None = None,
+              annotations: Annotations | None = None) -> dict[str, Any]:
+    """A Node object advertising ``chips`` units of RESOURCE_CHIPS."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {
+            "name": name,
+            "labels": dict(labels or {}),
+            "annotations": dict(annotations or {}),
+        },
+        "status": {
+            "allocatable": {RESOURCE_CHIPS: str(chips)},
+            "capacity": {RESOURCE_CHIPS: str(chips)},
+        },
+    }
+
+
+def make_pod(name: str, *, namespace: str = "default", chips: int = 0,
+             labels: Annotations | None = None,
+             annotations: Annotations | None = None,
+             node_name: str | None = None) -> dict[str, Any]:
+    """A Pod requesting ``chips`` units of RESOURCE_CHIPS in one container."""
+    resources = {"limits": {RESOURCE_CHIPS: str(chips)},
+                 "requests": {RESOURCE_CHIPS: str(chips)}} if chips else {}
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": dict(labels or {}),
+            "annotations": dict(annotations or {}),
+        },
+        "spec": {
+            "containers": [{"name": "main", "resources": resources}],
+            **({"nodeName": node_name} if node_name else {}),
+        },
+        "status": {"phase": "Pending"},
+    }
+
+
+def pod_requested_chips(pod: dict[str, Any]) -> int:
+    """Total RESOURCE_CHIPS requested across containers (limits take
+    precedence, matching kubelet extended-resource semantics)."""
+    total = 0
+    for c in pod.get("spec", {}).get("containers", []):
+        res = c.get("resources", {})
+        v = res.get("limits", {}).get(RESOURCE_CHIPS) \
+            or res.get("requests", {}).get(RESOURCE_CHIPS)
+        if v is not None:
+            total += int(v)
+    return total
+
+
+def coords_to_ann(coords) -> str:
+    """Serialize chip coords for ANN_GROUP: ``"0,0,0;0,1,0"`` — the analog
+    of the reference's ``ALIYUN_COM_GPU_GROUP: 0,1,2,3`` (design.md:228)."""
+    return ";".join(",".join(str(x) for x in c) for c in coords)
+
+
+def ann_to_coords(s: str) -> list[tuple[int, ...]]:
+    if not s:
+        return []
+    return [tuple(int(x) for x in part.split(",")) for part in s.split(";")]
+
+
+def chips_json(coords_with_paths: list[dict]) -> str:
+    return json.dumps(coords_with_paths, separators=(",", ":"))

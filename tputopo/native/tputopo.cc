@@ -1,0 +1,333 @@
+// libtputopo — native TPU topology discovery shim.
+//
+// The TPU-native equivalent of the reference design's NVML dependency
+// (design.md:25-55: the device plugin queries pairwise GPU P2P link types
+// through NVML's C library at init).  A TPU host exposes its place in the
+// ICI torus through the runtime environment (TPU_ACCELERATOR_TYPE,
+// TPU_CHIPS_PER_HOST_BOUNDS, TPU_HOST_BOUNDS, TPU_WORKER_ID — the same
+// variables libtpu itself consumes) and its chips as /dev/accel* device
+// files, so "discovery" is: read those, derive this host's chip coordinates
+// in the global slice, and emit one JSON document the Go/Python layers
+// consume — the analog of the `nvidia-smi topo -m` matrix
+// (imgs/gpu_topology_on_machine.png) in machine-readable form.
+//
+// Two backends, selected at probe time:
+//   * real: reads the TPU_* environment and scans /dev for accelerator
+//     device files.
+//   * fake: activated by TPUTOPO_FAKE="<gen>:<AxBxC>[@worker]" — fabricates
+//     a host of the requested slice for dev boxes with no TPU attached.
+//     This is the CPU-emulated twin BASELINE config 1 requires.
+//
+// C ABI only (consumed via ctypes; pybind11 is unavailable in this image).
+
+#include <cctype>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <dirent.h>
+
+namespace {
+
+struct Generation {
+  const char* name;         // canonical name, e.g. "v5p"
+  const char* type_prefix;  // TPU_ACCELERATOR_TYPE prefix, e.g. "v5p"
+  int ndims;
+  int cores_per_chip;
+  int host_bounds[3];       // chips per host along each axis
+};
+
+// Must stay in sync with tputopo/topology/generations.py (asserted by
+// tests/test_discovery.py::test_shim_matches_python_generations).
+const Generation kGenerations[] = {
+    {"v4", "v4", 3, 2, {2, 2, 1}},
+    {"v5p", "v5p", 3, 2, {2, 2, 1}},
+    {"v5e", "v5litepod", 2, 1, {4, 2}},
+    {"v5e", "v5e", 2, 1, {4, 2}},
+    {"v6e", "v6e", 2, 1, {4, 2}},
+};
+
+const Generation* FindGenerationByPrefix(const std::string& accel_type) {
+  const Generation* best = nullptr;
+  size_t best_len = 0;
+  for (const auto& g : kGenerations) {
+    size_t len = std::strlen(g.type_prefix);
+    if (accel_type.compare(0, len, g.type_prefix) == 0 && len > best_len) {
+      best = &g;
+      best_len = len;
+    }
+  }
+  return best;
+}
+
+const Generation* FindGenerationByName(const std::string& name) {
+  for (const auto& g : kGenerations) {
+    if (name == g.name) return &g;
+  }
+  return nullptr;
+}
+
+std::string GetEnv(const char* key) {
+  const char* v = std::getenv(key);
+  return v ? std::string(v) : std::string();
+}
+
+// Parse "2,2,1" or "2x2x1" into up to 3 ints; returns count.
+int ParseDims(const std::string& s, int out[3]) {
+  int n = 0;
+  int cur = -1;
+  for (char ch : s) {
+    if (std::isdigit(static_cast<unsigned char>(ch))) {
+      cur = (cur < 0 ? 0 : cur * 10) + (ch - '0');
+    } else if (ch == ',' || ch == 'x' || ch == 'X') {
+      if (cur < 0) return -1;
+      if (n >= 3) return -1;
+      out[n++] = cur;
+      cur = -1;
+    } else {
+      return -1;
+    }
+  }
+  if (cur >= 0) {
+    if (n >= 3) return -1;
+    out[n++] = cur;
+  }
+  return n;
+}
+
+std::vector<std::string> ScanAccelDevices() {
+  std::vector<std::string> out;
+  DIR* d = opendir("/dev");
+  if (!d) return out;
+  while (dirent* e = readdir(d)) {
+    if (std::strncmp(e->d_name, "accel", 5) == 0 ||
+        std::strncmp(e->d_name, "vfio", 4) == 0) {
+      out.push_back(std::string("/dev/") + e->d_name);
+    }
+  }
+  closedir(d);
+  // deterministic order
+  for (size_t i = 0; i + 1 < out.size(); ++i)
+    for (size_t j = i + 1; j < out.size(); ++j)
+      if (out[j] < out[i]) std::swap(out[i], out[j]);
+  return out;
+}
+
+struct Probe {
+  std::string backend;  // "real" | "fake"
+  std::string generation;
+  std::string error;  // non-empty on failure
+  int ndims = 0;
+  int cores_per_chip = 1;
+  int slice_dims[3] = {1, 1, 1};   // global slice, in chips
+  int host_bounds[3] = {1, 1, 1};  // chips per host along each axis
+  int worker_id = 0;
+  std::vector<std::string> device_paths;
+};
+
+// Derive this worker's host coordinate (in hosts) from worker_id, row-major
+// over the host grid (slice_dims / host_bounds).
+void HostCoord(const Probe& p, int out[3]) {
+  int host_grid[3] = {1, 1, 1};
+  for (int i = 0; i < p.ndims; ++i) {
+    host_grid[i] = p.slice_dims[i] / p.host_bounds[i];
+    if (host_grid[i] < 1) host_grid[i] = 1;
+  }
+  int id = p.worker_id;
+  for (int i = p.ndims - 1; i >= 0; --i) {
+    out[i] = id % host_grid[i];
+    id /= host_grid[i];
+  }
+}
+
+bool ProbeFake(Probe* p) {
+  // TPUTOPO_FAKE = "v5p:2x2x4" or "v5p:2x2x4@3" (worker id suffix).
+  std::string spec = GetEnv("TPUTOPO_FAKE");
+  if (spec.empty()) return false;
+  p->backend = "fake";
+  std::string body = spec;
+  size_t at = spec.find('@');
+  if (at != std::string::npos) {
+    body = spec.substr(0, at);
+    p->worker_id = std::atoi(spec.c_str() + at + 1);
+  }
+  size_t colon = body.find(':');
+  if (colon == std::string::npos) {
+    p->error = "TPUTOPO_FAKE wants '<gen>:<AxBxC>[@worker]', got '" + spec + "'";
+    return true;
+  }
+  std::string gen_name = body.substr(0, colon);
+  const Generation* g = FindGenerationByName(gen_name);
+  if (!g) {
+    p->error = "unknown generation '" + gen_name + "' in TPUTOPO_FAKE";
+    return true;
+  }
+  int dims[3];
+  int nd = ParseDims(body.substr(colon + 1), dims);
+  if (nd != g->ndims) {
+    p->error = "bad dims for " + gen_name + " in TPUTOPO_FAKE (want " +
+               std::to_string(g->ndims) + "-D)";
+    return true;
+  }
+  p->generation = g->name;
+  p->ndims = g->ndims;
+  p->cores_per_chip = g->cores_per_chip;
+  for (int i = 0; i < nd; ++i) {
+    p->slice_dims[i] = dims[i];
+    p->host_bounds[i] =
+        g->host_bounds[i] < dims[i] ? g->host_bounds[i] : dims[i];
+  }
+  int chips_per_host = 1;
+  for (int i = 0; i < nd; ++i) chips_per_host *= p->host_bounds[i];
+  for (int i = 0; i < chips_per_host; ++i) {
+    char buf[32];
+    std::snprintf(buf, sizeof buf, "/dev/accel%d", i);
+    p->device_paths.push_back(buf);
+  }
+  return true;
+}
+
+void ProbeReal(Probe* p) {
+  p->backend = "real";
+  std::string accel_type = GetEnv("TPU_ACCELERATOR_TYPE");
+  if (accel_type.empty()) {
+    p->error =
+        "no TPU runtime detected: TPU_ACCELERATOR_TYPE unset and "
+        "TPUTOPO_FAKE not provided";
+    return;
+  }
+  const Generation* g = FindGenerationByPrefix(accel_type);
+  if (!g) {
+    p->error = "unrecognized TPU_ACCELERATOR_TYPE '" + accel_type + "'";
+    return;
+  }
+  p->generation = g->name;
+  p->ndims = g->ndims;
+  p->cores_per_chip = g->cores_per_chip;
+  for (int i = 0; i < g->ndims; ++i) p->host_bounds[i] = g->host_bounds[i];
+
+  // Chip count from the accelerator-type suffix ("v5p-32" => 32 cores).
+  size_t dash = accel_type.rfind('-');
+  int cores = dash == std::string::npos ? 0 : std::atoi(accel_type.c_str() + dash + 1);
+  int chips = p->cores_per_chip > 0 ? cores / p->cores_per_chip : cores;
+
+  // Prefer explicit bounds envs when present (they are authoritative).
+  int tmp[3];
+  std::string hb = GetEnv("TPU_CHIPS_PER_HOST_BOUNDS");
+  if (!hb.empty() && ParseDims(hb, tmp) == p->ndims)
+    for (int i = 0; i < p->ndims; ++i) p->host_bounds[i] = tmp[i];
+  std::string hosts = GetEnv("TPU_HOST_BOUNDS");  // host grid, in hosts
+  if (!hosts.empty() && ParseDims(hosts, tmp) == p->ndims) {
+    for (int i = 0; i < p->ndims; ++i)
+      p->slice_dims[i] = tmp[i] * p->host_bounds[i];
+  } else if (chips > 0) {
+    // Single-host or unknown: assume a host-bounds-shaped slice if it fits.
+    int per_host = 1;
+    for (int i = 0; i < p->ndims; ++i) per_host *= p->host_bounds[i];
+    if (chips <= per_host) {
+      // Lay chips along the first axis of the host box.
+      for (int i = 0; i < p->ndims; ++i) p->slice_dims[i] = 1;
+      p->slice_dims[0] = chips;
+    } else {
+      for (int i = 0; i < p->ndims; ++i) p->slice_dims[i] = p->host_bounds[i];
+      p->slice_dims[p->ndims - 1] *= chips / per_host;
+    }
+  }
+
+  std::string wid = GetEnv("TPU_WORKER_ID");
+  if (wid.empty()) wid = GetEnv("CLOUD_TPU_TASK_ID");
+  p->worker_id = wid.empty() ? 0 : std::atoi(wid.c_str());
+  p->device_paths = ScanAccelDevices();
+}
+
+void AppendDims(std::string* out, const int* dims, int nd) {
+  *out += "[";
+  for (int i = 0; i < nd; ++i) {
+    if (i) *out += ",";
+    *out += std::to_string(dims[i]);
+  }
+  *out += "]";
+}
+
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    if (c == '"' || c == '\\') { out += '\\'; out += c; }
+    else if (c == '\n') out += "\\n";
+    else out += c;
+  }
+  return out;
+}
+
+std::string ProbeToJson(const Probe& p) {
+  std::string out = "{";
+  out += "\"backend\":\"" + p.backend + "\"";
+  if (!p.error.empty()) {
+    out += ",\"error\":\"" + JsonEscape(p.error) + "\"}";
+    return out;
+  }
+  out += ",\"generation\":\"" + p.generation + "\"";
+  out += ",\"ndims\":" + std::to_string(p.ndims);
+  out += ",\"cores_per_chip\":" + std::to_string(p.cores_per_chip);
+  out += ",\"slice_dims\":";
+  AppendDims(&out, p.slice_dims, p.ndims);
+  out += ",\"host_bounds\":";
+  AppendDims(&out, p.host_bounds, p.ndims);
+  out += ",\"worker_id\":" + std::to_string(p.worker_id);
+  int hc[3];
+  HostCoord(p, hc);
+  out += ",\"host_coord\":";
+  AppendDims(&out, hc, p.ndims);
+
+  // Local chips: coordinates of this host's chips in the global slice,
+  // row-major within the host box, paired with device paths when known.
+  out += ",\"chips\":[";
+  int per_host = 1;
+  for (int i = 0; i < p.ndims; ++i) per_host *= p.host_bounds[i];
+  for (int idx = 0; idx < per_host; ++idx) {
+    if (idx) out += ",";
+    int local[3] = {0, 0, 0};
+    int rem = idx;
+    for (int i = p.ndims - 1; i >= 0; --i) {
+      local[i] = rem % p.host_bounds[i];
+      rem /= p.host_bounds[i];
+    }
+    int global[3];
+    for (int i = 0; i < p.ndims; ++i)
+      global[i] = hc[i] * p.host_bounds[i] + local[i];
+    out += "{\"local_id\":" + std::to_string(idx) + ",\"coords\":";
+    AppendDims(&out, global, p.ndims);
+    if (idx < static_cast<int>(p.device_paths.size()))
+      out += ",\"device_path\":\"" + JsonEscape(p.device_paths[idx]) + "\"";
+    out += "}";
+  }
+  out += "]}";
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Probe local TPU topology; writes a JSON document into `out` (NUL
+// terminated).  Returns the number of bytes required (excluding NUL); if the
+// return value >= cap the output was truncated and the caller should retry
+// with a larger buffer.  Never throws.
+int tputopo_probe(char* out, int cap) {
+  Probe p;
+  if (!ProbeFake(&p)) ProbeReal(&p);
+  std::string json = ProbeToJson(p);
+  if (out && cap > 0) {
+    int n = static_cast<int>(json.size());
+    int copy = n < cap - 1 ? n : cap - 1;
+    std::memcpy(out, json.data(), copy);
+    out[copy] = '\0';
+  }
+  return static_cast<int>(json.size());
+}
+
+const char* tputopo_version() { return "tputopo-native 0.1.0"; }
+
+}  // extern "C"

@@ -25,7 +25,7 @@ overridable config (:mod:`tputopo.extender.config`).
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from tputopo.topology.model import ChipTopology, Coord
 
@@ -69,15 +69,20 @@ class LinkCostModel:
     Attributes:
         ici_link_gbps: one-way GB/s of a single ICI link.
         dcn_host_gbps: per-host DCN GB/s.
+        host_dma_gbps: bandwidth between chips on the *same host* that are
+            not ICI-connected within an allocation (traffic staged through
+            host memory / PCIe — the analog of the reference's PHB class,
+            design.md:38-40).  Strictly between ICI and DCN so ranking is
+            total: ICI-contiguous > same-host-split > cross-host-split.
         ici_hop_latency_us: per-hop ICI latency (tiebreak only; ICI is ~1us).
         dcn_latency_us: DCN round-trip latency.
     """
 
     ici_link_gbps: float
     dcn_host_gbps: float
+    host_dma_gbps: float = 64.0  # PCIe Gen5 x16-class; must exceed dcn_host_gbps
     ici_hop_latency_us: float = 1.0
     dcn_latency_us: float = 25.0
-    overrides: dict = field(default_factory=dict)
 
     @staticmethod
     def for_generation(gen_name: str, **overrides) -> "LinkCostModel":

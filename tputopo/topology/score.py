@@ -146,8 +146,14 @@ def score_chip_set(topo: ChipTopology, chips: frozenset[Coord] | set[Coord],
 
     comps = _components(topo, chips)
     if len(comps) > 1:
-        # Disconnected within ICI: the collective must ride DCN between the
-        # components.  Narrowest component's aggregate host DCN pipe bounds it.
+        # Disconnected within the allocation: chips outside the set do not
+        # forward its traffic, so the collective stages through host memory
+        # when every component shares one host (the reference's PHB-class
+        # path, design.md:38-40), else rides DCN between hosts.  Either way
+        # it is far below ICI, preserving the strict preference ordering.
+        hosts = {topo.host_of(c) for c in chips}
+        if len(hosts) == 1:
+            return cost.host_dma_gbps * _ring_factor(n) * 2.0 / n
         narrowest = min(
             len({topo.host_of(c) for c in comp}) for comp in comps
         )
@@ -155,11 +161,7 @@ def score_chip_set(topo: ChipTopology, chips: frozenset[Coord] | set[Coord],
 
     box = _box_of(topo, chips)
     if box is not None:
-        _, dims = box
-        wrap = tuple(
-            topo.wrap[i] and dims[i] == topo.dims[i] for i in range(len(dims))
-        )
-        return sum(_axis_algbw(cost.ici_link_gbps, d, w) for d, w in zip(dims, wrap))
+        return predict_allreduce_gbps(topo, box[1], cost)
 
     min_deg = min(_internal_degree(topo, chips, c) for c in chips)
     return cost.ici_link_gbps * max(min_deg, 1) * _ring_factor(n)
