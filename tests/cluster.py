@@ -1,0 +1,40 @@
+"""Shared test fixture builder: a fake multi-host TPU cluster.
+
+The rebuild's 'staged cluster states as fixtures' strategy (SURVEY.md §4:
+Gaia stages occupancy states of a real cluster; we stage fake topology
+snapshots — many nodes in one process, no kubelet)."""
+
+from __future__ import annotations
+
+import os
+
+from tputopo.deviceplugin import FakeKubelet, TpuDevicePlugin
+from tputopo.discovery.shim import _probe_python, _to_host_probe
+from tputopo.k8s import FakeApiServer
+
+
+def probe_for(spec: str):
+    env = dict(os.environ)
+    env["TPUTOPO_FAKE"] = spec
+    return _to_host_probe(_probe_python(env))
+
+
+def build_cluster(spec: str = "v5p:2x2x4", workers: int = 4,
+                  slice_id: str = "slice-a",
+                  api: FakeApiServer | None = None,
+                  clock=None, node_prefix: str = "node"):
+    """Bring up ``workers`` device plugins for one slice against a fake API
+    server.  Returns (api_server, {node_name: plugin})."""
+    api = api or FakeApiServer()
+    plugins = {}
+    for w in range(workers):
+        probe = probe_for(f"{spec}@{w}")
+        name = f"{node_prefix}-{w}"
+        plugin = TpuDevicePlugin(
+            node_name=name, slice_id=slice_id, kubelet=FakeKubelet(),
+            api_server=api, probe=probe,
+            clock=clock or (lambda: 1000.0),
+        )
+        plugin.start()
+        plugins[name] = plugin
+    return api, plugins

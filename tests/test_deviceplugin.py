@@ -55,7 +55,9 @@ def test_bringup_patches_existing_node():
     api_server.create("nodes", make_node("n1", chips=0, labels={"x": "y"}))
     plugin.start()
     node = api_server.get("nodes", "n1")
-    assert node["metadata"]["labels"] == {"x": "y"}  # preserved
+    # Pre-existing labels preserved AND the quota-classing generation label
+    # lands on the patch path too (real clusters always have the Node first).
+    assert node["metadata"]["labels"] == {"x": "y", ko.ANN_GENERATION_LABEL: "v5p"}
     assert ko.ANN_TOPOLOGY in node["metadata"]["annotations"]
 
 

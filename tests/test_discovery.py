@@ -49,6 +49,11 @@ FAKE_CASES = [
     {"TPUTOPO_FAKE": "nonsense"},
     {"TPUTOPO_FAKE": "v99:2x2"},
     {"TPUTOPO_FAKE": "v5e:2x2x2"},
+    {"TPUTOPO_FAKE": "v5p:2x2x4x"},   # trailing separator -> error in BOTH
+    {"TPUTOPO_FAKE": "v5p:2x2x4@3abc"},  # junk worker id -> 0 in BOTH
+    {"TPUTOPO_FAKE": "v5e:4x4@-1"},      # negative worker id -> 0 in BOTH
+    {"TPU_ACCELERATOR_TYPE": "v5p-32", "TPU_WORKER_ID": "-1",
+     "TPU_HOST_BOUNDS": "1,1,4"},
     {},  # no TPU at all -> clean error
     {"TPU_ACCELERATOR_TYPE": "v5p-32", "TPU_WORKER_ID": "2",
      "TPU_HOST_BOUNDS": "1,1,4", "TPU_CHIPS_PER_HOST_BOUNDS": "2,2,1"},

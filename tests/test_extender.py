@@ -1,0 +1,304 @@
+"""Extender tests: cluster-state rebuild, sort/bind verbs, gang
+all-or-nothing, stale-assumption GC — driving the same flows as the
+reference's §3.2/§3.3 call stacks against staged fixtures."""
+
+import pytest
+
+from tests.cluster import build_cluster
+from tputopo.extender import AssumptionGC, ClusterState, ExtenderConfig, ExtenderScheduler
+from tputopo.extender.scheduler import (
+    BindError,
+    LABEL_GANG_ID,
+    LABEL_GANG_SIZE,
+    MAX_PRIORITY,
+)
+from tputopo.k8s import make_pod
+from tputopo.k8s import objects as ko
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_scheduler(api, clock=None, **cfg):
+    config = ExtenderConfig(**cfg)
+    return ExtenderScheduler(api, config, clock=clock or Clock())
+
+
+def all_nodes(api):
+    return [n["metadata"]["name"] for n in api.list("nodes")]
+
+
+# ---- cluster state ----------------------------------------------------------
+
+def test_state_rebuild_from_annotations():
+    api, _ = build_cluster()
+    state = ClusterState(api).sync()
+    assert set(state.domains) == {"slice-a"}
+    dom = state.domains["slice-a"]
+    assert dom.topology.num_chips == 16
+    assert len(dom.node_by_host) == 4
+    assert len(dom.allocator.free) == 16
+    assert state.free_chips_on_node("node-2") == [(0, 0, 2), (0, 1, 2), (1, 0, 2), (1, 1, 2)]
+
+
+def test_state_counts_confirmed_and_fresh_assumptions():
+    clock = Clock(1000.0)
+    api, _ = build_cluster(clock=clock)
+    api.create("pods", make_pod("p1", chips=2, node_name="node-0", annotations={
+        ko.ANN_GROUP: "0,0,0;0,1,0", ko.ANN_ASSUME_TIME: "990", ko.ANN_ASSIGNED: "false"}))
+    api.create("pods", make_pod("p2", chips=1, node_name="node-1", annotations={
+        ko.ANN_GROUP: "0,0,1", ko.ANN_ASSUME_TIME: "1", ko.ANN_ASSIGNED: "true"}))
+    api.create("pods", make_pod("p3", chips=1, node_name="node-1", annotations={
+        ko.ANN_GROUP: "0,1,1", ko.ANN_ASSUME_TIME: "1", ko.ANN_ASSIGNED: "false"}))
+    state = ClusterState(api, assume_ttl_s=60, clock=clock).sync()
+    dom = state.domains["slice-a"]
+    # p1 fresh assumption + p2 confirmed occupy; p3's expired does not.
+    assert len(dom.allocator.used) == 3
+    assert [pa.pod_name for pa in state.expired] == ["p3"]
+
+
+def test_state_rejects_topology_disagreement():
+    api, _ = build_cluster()
+    api.patch_annotations("nodes", "node-3", {ko.ANN_TOPOLOGY: "v5p:2x2x2:wrap=000"})
+    with pytest.raises(ValueError, match="disagree"):
+        ClusterState(api).sync()
+
+
+# ---- sort -------------------------------------------------------------------
+
+def test_sort_scores_all_nodes_equal_when_empty():
+    api, _ = build_cluster()
+    sched = make_scheduler(api)
+    pod = make_pod("p", chips=2)
+    api.create("pods", pod)
+    scores = sched.sort(pod, all_nodes(api))
+    assert len(scores) == 4
+    assert len({s["Score"] for s in scores}) == 1
+    assert scores[0]["Score"] == MAX_PRIORITY  # adjacent pair == ideal for k=2
+
+
+def test_sort_prefers_tight_node_for_single_chip():
+    api, _ = build_cluster()
+    # node-1 has 3 chips taken -> its last chip is the tight spot.
+    api.create("pods", make_pod("busy", chips=3, node_name="node-1", annotations={
+        ko.ANN_GROUP: "0,0,1;0,1,1;1,0,1", ko.ANN_ASSUME_TIME: "999",
+        ko.ANN_ASSIGNED: "true"}))
+    sched = make_scheduler(api)
+    pod = make_pod("p", chips=1)
+    scores = {s["Host"]: s["Score"] for s in sched.sort(pod, all_nodes(api))}
+    assert scores["node-1"] > scores["node-0"]
+    assert scores["node-1"] > scores["node-2"]
+
+
+def test_sort_zero_when_infeasible():
+    api, _ = build_cluster()
+    sched = make_scheduler(api)
+    pod = make_pod("p", chips=5)  # > 4 chips/host, no gang
+    scores = sched.sort(pod, all_nodes(api))
+    assert all(s["Score"] == 0 for s in scores)
+    nochip = make_pod("p0", chips=0)
+    assert all(s["Score"] == 0 for s in sched.sort(nochip, all_nodes(api)))
+
+
+def test_sort_unknown_node_scores_zero():
+    api, _ = build_cluster()
+    sched = make_scheduler(api)
+    pod = make_pod("p", chips=1)
+    scores = {s["Host"]: s["Score"] for s in sched.sort(pod, ["node-0", "ghost"])}
+    assert scores["ghost"] == 0
+    assert scores["node-0"] > 0
+
+
+# ---- bind -------------------------------------------------------------------
+
+def test_bind_full_handshake():
+    clock = Clock(2000.0)
+    api, _ = build_cluster()
+    sched = make_scheduler(api, clock=clock)
+    api.create("pods", make_pod("train", chips=4))
+    decision = sched.bind("train", "default", "node-2")
+    assert decision["contiguous"] is True
+    assert decision["predicted_allreduce_gbps"] == 400.0
+    pod = api.get("pods", "train", "default")
+    anns = pod["metadata"]["annotations"]
+    assert anns[ko.ANN_GROUP] == "0,0,2;0,1,2;1,0,2;1,1,2"
+    assert anns[ko.ANN_ASSIGNED] == "false"
+    assert anns[ko.ANN_ASSUME_TIME] == "2000.0"
+    assert float(anns[ko.ANN_PREDICTED_GBPS]) == 400.0
+    assert pod["spec"]["nodeName"] == "node-2"
+
+
+def test_bind_respects_existing_occupancy():
+    api, _ = build_cluster()
+    sched = make_scheduler(api)
+    api.create("pods", make_pod("first", chips=3))
+    sched.bind("first", "default", "node-0")
+    api.create("pods", make_pod("second", chips=2))
+    with pytest.raises(BindError, match="no feasible"):
+        sched.bind("second", "default", "node-0")  # only 1 chip left there
+    sched.bind("second", "default", "node-1")  # fine elsewhere
+
+
+def test_bind_errors_are_counted():
+    api, _ = build_cluster()
+    sched = make_scheduler(api)
+    with pytest.raises(BindError, match="not found"):
+        sched.bind("ghost", "default", "node-0")
+    api.create("pods", make_pod("p", chips=1))
+    with pytest.raises(BindError, match="not part of any TPU slice"):
+        sched.bind("p", "default", "ghost-node")
+    assert sched.metrics.counters["bind_errors"] == 2
+
+
+# ---- gang scheduling --------------------------------------------------------
+
+def gang_pod(name, gang_id, size, chips):
+    return make_pod(name, chips=chips, labels={
+        LABEL_GANG_ID: gang_id, LABEL_GANG_SIZE: str(size)})
+
+
+def test_gang_4x4_binds_all_members_contiguously():
+    # BASELINE config 4: 4 x 4-chip DP replicas on v5p-32.
+    clock = Clock(1000.0)
+    api, _ = build_cluster(clock=clock)
+    sched = make_scheduler(api, clock=clock)
+    for i in range(4):
+        api.create("pods", gang_pod(f"dp-{i}", "job-a", 4, 4))
+    bound_nodes = []
+    for i in range(4):
+        pod = api.get("pods", f"dp-{i}", "default")
+        scores = sched.sort(pod, all_nodes(api))
+        best = max(scores, key=lambda s: s["Score"])
+        assert best["Score"] > 0
+        decision = sched.bind(f"dp-{i}", "default", best["Host"])
+        bound_nodes.append(best["Host"])
+        assert decision["gang"] == "job-a"
+        assert decision["contiguous"]
+    assert sorted(bound_nodes) == ["node-0", "node-1", "node-2", "node-3"]
+    # All 16 chips assigned, disjoint.
+    state = ClusterState(api, clock=clock).sync()
+    assert len(state.domains["slice-a"].allocator.used) == 16
+
+
+def test_gang_8chip_2x2x2_slice():
+    # BASELINE config 3: an 8-chip 2x2x2 slice == gang of 2 hosts on v5p.
+    clock = Clock(1000.0)
+    api, _ = build_cluster(clock=clock)
+    sched = make_scheduler(api, clock=clock)
+    for i in range(2):
+        api.create("pods", gang_pod(f"bench-{i}", "bench", 2, 4))
+    for i in range(2):
+        pod = api.get("pods", f"bench-{i}", "default")
+        scores = sched.sort(pod, all_nodes(api))
+        best = max(scores, key=lambda s: s["Score"])
+        sched.bind(f"bench-{i}", "default", best["Host"])
+    state = ClusterState(api, clock=clock).sync()
+    used = state.domains["slice-a"].allocator.used
+    assert len(used) == 8
+    # The union must be a contiguous 2x2x2 box (adjacent hosts chosen).
+    from tputopo.topology.score import score_chip_set
+    dom = state.domains["slice-a"]
+    score = score_chip_set(dom.topology, used, dom.allocator.cost)
+    assert score == pytest.approx(
+        sum([200.0, 200.0, 200.0]), rel=1e-6)  # 2x2x2: three wrapless axes of 2
+
+
+def test_gang_all_or_nothing_binds_nothing_when_infeasible():
+    api, _ = build_cluster()
+    sched = make_scheduler(api)
+    # Occupy one full host: only 3 hosts left for a 4-host gang.
+    api.create("pods", make_pod("squatter", chips=4, node_name="node-0",
+               annotations={ko.ANN_GROUP: "0,0,0;0,1,0;1,0,0;1,1,0",
+                            ko.ANN_ASSUME_TIME: "999", ko.ANN_ASSIGNED: "true"}))
+    for i in range(4):
+        api.create("pods", gang_pod(f"dp-{i}", "job-b", 4, 4))
+    pod = api.get("pods", "dp-0", "default")
+    scores = sched.sort(pod, all_nodes(api))
+    assert all(s["Score"] == 0 for s in scores)
+    with pytest.raises(BindError, match="all-or-nothing"):
+        sched.bind("dp-0", "default", "node-1")
+    # Nothing got annotated.
+    for i in range(4):
+        anns = api.get("pods", f"dp-{i}", "default")["metadata"]["annotations"]
+        assert ko.ANN_GROUP not in anns
+
+
+def test_gang_size_label_required():
+    api, _ = build_cluster()
+    sched = make_scheduler(api)
+    bad = make_pod("p", chips=4, labels={LABEL_GANG_ID: "g"})
+    api.create("pods", bad)
+    with pytest.raises(ValueError, match="gang-size"):
+        sched.sort(bad, all_nodes(api))
+
+
+# ---- GC ---------------------------------------------------------------------
+
+def test_gc_releases_expired_assumption_and_frees_chips():
+    clock = Clock(1000.0)
+    api, _ = build_cluster(clock=clock)
+    sched = make_scheduler(api, clock=clock)
+    api.create("pods", make_pod("stuck", chips=4))
+    sched.bind("stuck", "default", "node-0")
+    # Occupied while fresh:
+    assert len(ClusterState(api, clock=clock).sync().domains["slice-a"].allocator.used) == 4
+    clock.t += 120  # beyond the 60 s TTL, never confirmed
+    gc = AssumptionGC(api, assume_ttl_s=60, clock=clock)
+    released = gc.sweep()
+    assert released == ["default/stuck"]
+    anns = api.get("pods", "stuck", "default")["metadata"]["annotations"]
+    assert ko.ANN_GROUP not in anns and ko.ANN_ASSIGNED not in anns
+    assert len(ClusterState(api, clock=clock).sync().domains["slice-a"].allocator.used) == 0
+
+
+def test_gc_releases_whole_gang_together():
+    clock = Clock(1000.0)
+    api, _ = build_cluster(clock=clock)
+    sched = make_scheduler(api, clock=clock)
+    for i in range(4):
+        api.create("pods", gang_pod(f"dp-{i}", "job-c", 4, 4))
+    # Two members bind, then the job stalls (members 2,3 never arrive).
+    sched.bind("dp-0", "default", "node-0")
+    sched.bind("dp-1", "default", "node-1")
+    clock.t += 120
+    released = AssumptionGC(api, assume_ttl_s=60, clock=clock).sweep()
+    assert sorted(released) == ["default/dp-0", "default/dp-1"]
+
+
+def test_gc_keeps_confirmed_assignments():
+    clock = Clock(1000.0)
+    api, plugins = build_cluster(clock=clock)
+    sched = make_scheduler(api, clock=clock)
+    api.create("pods", make_pod("ok", chips=2))
+    sched.bind("ok", "default", "node-1")
+    # Device plugin confirms (flow ⑥): Allocate flips ASSIGNED.
+    plugins["node-1"].kubelet.allocate(ko.RESOURCE_CHIPS, ["0,0,1", "0,1,1"])
+    clock.t += 9999
+    assert AssumptionGC(api, assume_ttl_s=60, clock=clock).sweep() == []
+    assert len(ClusterState(api, clock=clock).sync().domains["slice-a"].allocator.used) == 2
+
+
+# ---- config -----------------------------------------------------------------
+
+def test_config_roundtrip_and_policy(tmp_path):
+    cfg = ExtenderConfig(assume_ttl_s=30, cost_overrides={"v5p": {"ici_link_gbps": 95.0}})
+    path = tmp_path / "cfg.json"
+    cfg.save(path)
+    loaded = ExtenderConfig.load(path)
+    assert loaded == cfg
+    assert loaded.cost_model("v5p").ici_link_gbps == 95.0
+    assert loaded.cost_model("v5e").ici_link_gbps == 50.0  # defaults intact
+    policy = cfg.policy_json()
+    ext = policy["extenders"][0]
+    assert ext["prioritizeVerb"] == "sort" and ext["bindVerb"] == "bind"
+    assert "filterVerb" not in ext  # deliberately no Filter (design.md:115-117)
+    assert ext["ignorable"] is False
+    with pytest.raises(ValueError, match="unknown config keys"):
+        path2 = tmp_path / "bad.json"
+        path2.write_text('{"bogus": 1}')
+        ExtenderConfig.load(path2)

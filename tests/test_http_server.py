@@ -1,0 +1,123 @@
+"""HTTP extender tests: drive the real socket with urllib, the way
+kube-scheduler would (SURVEY.md §4.3 — the API is plain HTTP+JSON)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.cluster import build_cluster
+from tputopo.extender import ExtenderConfig, ExtenderHTTPServer, ExtenderScheduler
+from tputopo.k8s import make_pod
+
+
+@pytest.fixture()
+def server():
+    api, _ = build_cluster()
+    config = ExtenderConfig()
+    sched = ExtenderScheduler(api, config)
+    srv = ExtenderHTTPServer(sched, config, port=0).start()  # ephemeral port
+    yield api, srv
+    srv.stop()
+
+
+def post(srv, path, payload):
+    host, port = srv.address
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def get(srv, path):
+    host, port = srv.address
+    with urllib.request.urlopen(f"http://{host}:{port}{path}", timeout=5) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_sort_and_bind_over_http(server):
+    api, srv = server
+    api.create("pods", make_pod("web-train", chips=4))
+    pod = api.get("pods", "web-train", "default")
+
+    status, scores = post(srv, "/tputopo-scheduler/sort",
+                          {"Pod": pod, "NodeNames": ["node-0", "node-1"]})
+    assert status == 200
+    assert {s["Host"] for s in scores} == {"node-0", "node-1"}
+    assert all(s["Score"] > 0 for s in scores)
+
+    status, result = post(srv, "/tputopo-scheduler/bind",
+                          {"PodName": "web-train", "PodNamespace": "default",
+                           "Node": "node-1"})
+    assert status == 200 and result["Error"] == ""
+    bound = api.get("pods", "web-train", "default")
+    assert bound["spec"]["nodeName"] == "node-1"
+
+
+def test_sort_accepts_full_node_items(server):
+    api, srv = server
+    api.create("pods", make_pod("p", chips=1))
+    pod = api.get("pods", "p", "default")
+    nodes = {"Items": api.list("nodes")}
+    status, scores = post(srv, "/tputopo-scheduler/sort",
+                          {"Pod": pod, "Nodes": nodes})
+    assert status == 200 and len(scores) == 4
+
+
+def test_bind_failure_reports_error_string(server):
+    api, srv = server
+    status, result = post(srv, "/tputopo-scheduler/bind",
+                          {"PodName": "ghost", "PodNamespace": "default",
+                           "Node": "node-0"})
+    assert status == 200
+    assert "not found" in result["Error"]
+
+
+def test_malformed_requests_get_400(server):
+    api, srv = server
+    status = None
+    try:
+        post(srv, "/tputopo-scheduler/sort", {"NodeNames": []})  # no Pod
+    except urllib.error.HTTPError as e:
+        status = e.code
+        body = json.loads(e.read())
+        assert "Pod" in body["error"]
+    assert status == 400
+    try:
+        post(srv, "/tputopo-scheduler/bind", {"PodName": "x"})
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    try:
+        post(srv, "/tputopo-scheduler/nope", {})
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_health_metrics_state_policy(server):
+    api, srv = server
+    assert get(srv, "/healthz") == (200, "ok\n")
+
+    api.create("pods", make_pod("p", chips=2))
+    pod = api.get("pods", "p", "default")
+    post(srv, "/tputopo-scheduler/sort", {"Pod": pod, "NodeNames": ["node-0"]})
+    post(srv, "/tputopo-scheduler/bind",
+         {"PodName": "p", "PodNamespace": "default", "Node": "node-0"})
+
+    _, metrics = get(srv, "/metrics")
+    assert "tputopo_extender_sort_requests_total 1" in metrics
+    assert "tputopo_extender_bind_success_total 1" in metrics
+    assert "tputopo_extender_sort_latency_p50_ms" in metrics
+
+    _, state_raw = get(srv, "/state")
+    state = json.loads(state_raw)
+    assert state["fragmentation"]["slice-a"]["used_chips"] == 2
+    assert state["decisions"][-1]["pod"] == "default/p"
+
+    _, policy_raw = get(srv, "/policy")
+    policy = json.loads(policy_raw)
+    assert policy["extenders"][0]["prioritizeVerb"] == "sort"

@@ -66,6 +66,11 @@ class TpuDevicePlugin:
         anns = node_annotations_for_probe(self.probe, self.slice_id)
         try:
             self.api_server.patch_annotations("nodes", self.node_name, anns)
+            # Real clusters always have a pre-existing Node (kubelet creates
+            # it); the quota-classing label must land on this path too.
+            self.api_server.patch_labels(
+                "nodes", self.node_name,
+                {ko.ANN_GENERATION_LABEL: self.probe.generation})
         except NotFound:
             from tputopo.deviceplugin.reporter import node_object_for_probe
             self.api_server.create(
@@ -103,15 +108,25 @@ class TpuDevicePlugin:
         responses = []
         for device_ids in req.container_device_ids:
             pod = self._find_pending_pod(len(device_ids))
+            chip_ids = list(device_ids)
             if pod is not None:
                 # Honor the extender's choice (flow ⑥): the pod annotation,
                 # not the kubelet's arbitrary pick, is authoritative.
                 group = ko.ann_to_coords(
                     pod["metadata"]["annotations"][ko.ANN_GROUP])
-                chip_ids = [coord_id(c) for c in group]
-                self._confirm_assignment(pod)
-            else:
-                chip_ids = list(device_ids)
+                candidate = [coord_id(c) for c in group]
+                # Validate locality BEFORE confirming: confirming first and
+                # then failing would set ASSIGNED=true on a pod whose
+                # container never starts, which the TTL GC (which only
+                # releases unconfirmed assumptions) could never reclaim.
+                foreign = [c for c in candidate if c not in self._local_ids]
+                if foreign:
+                    raise ValueError(
+                        f"pod {pod['metadata']['name']} chip-group names "
+                        f"chips {foreign} not on node {self.node_name}"
+                    )
+                if self._confirm_assignment(pod):
+                    chip_ids = candidate
             responses.append(self._container_response(chip_ids))
         return api.AllocateResponse(container_responses=responses)
 
@@ -136,28 +151,35 @@ class TpuDevicePlugin:
             p["metadata"]["annotations"].get(ko.ANN_ASSUME_TIME, "0")))
         return pods[0]
 
-    def _confirm_assignment(self, pod: dict) -> None:
+    def _confirm_assignment(self, pod: dict) -> bool:
+        """CAS-confirm the assignment.  Returns False when the assignment no
+        longer stands (GC released it between lookup and confirm) — the
+        caller must then NOT hand out the released chip group."""
         md = pod["metadata"]
+        patch = {ko.ANN_ASSIGNED: "true", ko.ANN_ASSUME_TIME: str(self.clock())}
         try:
             self.api_server.patch_annotations(
-                "pods", md["name"],
-                {ko.ANN_ASSIGNED: "true",
-                 ko.ANN_ASSUME_TIME: str(self.clock())},
+                "pods", md["name"], patch,
                 namespace=md.get("namespace"),
                 expect_version=md.get("resourceVersion"),
             )
+            return True
         except Conflict:
-            # Someone raced us (extender GC or a duplicate Allocate).  The
-            # handshake is optimistic by design (design.md:227-232); re-read
-            # and only fail if the pod is genuinely gone.
+            # Someone raced us.  Re-read: if the GROUP annotation survived,
+            # the assignment still stands (e.g. an unrelated metadata write
+            # bumped the version) — confirm on the fresh version.  If GROUP
+            # is gone, the GC released the assignment; confirming would
+            # resurrect ASSIGNED=true on a group-less pod and double-book
+            # the chips to whoever the extender hands them next.
             fresh = self.api_server.get("pods", md["name"], md.get("namespace"))
-            if fresh["metadata"]["annotations"].get(ko.ANN_ASSIGNED) != "true":
+            anns = fresh["metadata"]["annotations"]
+            if ko.ANN_GROUP not in anns:
+                return False
+            if anns.get(ko.ANN_ASSIGNED) != "true":
                 self.api_server.patch_annotations(
-                    "pods", md["name"],
-                    {ko.ANN_ASSIGNED: "true",
-                     ko.ANN_ASSUME_TIME: str(self.clock())},
-                    namespace=md.get("namespace"),
+                    "pods", md["name"], patch, namespace=md.get("namespace"),
                 )
+            return True
 
     def _container_response(self, chip_ids: list[str]) -> api.ContainerAllocateResponse:
         local_ids = []

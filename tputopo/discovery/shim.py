@@ -161,10 +161,7 @@ def _probe_python(env: dict[str, str] | None = None) -> dict:
         body = fake
         if "@" in fake:
             body, _, wid = fake.partition("@")
-            try:
-                worker = int(wid)
-            except ValueError:
-                worker = 0
+            worker = int(wid) if wid.isdigit() else 0
         if ":" not in body:
             return {"backend": "fake",
                     "error": f"TPUTOPO_FAKE wants '<gen>:<AxBxC>[@worker]', got '{fake}'"}

@@ -1,0 +1,16 @@
+"""Scheduler extender: HTTP ``sort`` + ``bind`` behind the kube-scheduler,
+plus cluster state, gang planning, assumption GC, config, and metrics.
+
+Rebuild of reference components 2.6-2.9 (design.md:88-121: Prioritize verb
+"sort", Bind verb "bind", no Filter verb by design — count feasibility stays
+with the default scheduler, design.md:115-117) with the TPU-native selector
+and scorer underneath, gang scheduling for multi-pod jobs (SURVEY.md §7
+"gang scheduling semantics"), and the stale-assumption GC the reference's
+optimistic handshake implies (SURVEY.md §5.2-5.3).
+"""
+
+from tputopo.extender.config import ExtenderConfig  # noqa: F401
+from tputopo.extender.state import ClusterState, SliceDomain  # noqa: F401
+from tputopo.extender.scheduler import ExtenderScheduler  # noqa: F401
+from tputopo.extender.gc import AssumptionGC  # noqa: F401
+from tputopo.extender.server import ExtenderHTTPServer  # noqa: F401

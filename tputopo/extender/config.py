@@ -1,0 +1,75 @@
+"""Extender configuration.
+
+The reference's single config artifact is the kube-scheduler Policy JSON
+registering the extender (design.md:92-113), and its one unfinished config
+surface is the bandwidth-weight table (design.md:47 "TODO").  This module
+closes both: one config file carries the extender wiring *and* explicit
+per-generation cost overrides, and :func:`ExtenderConfig.policy_json`
+emits the Policy stanza for the kube-scheduler.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tputopo.k8s.objects import RESOURCE_CHIPS
+from tputopo.topology.cost import LinkCostModel
+
+
+@dataclass
+class ExtenderConfig:
+    url_prefix: str = "/tputopo-scheduler"
+    port: int = 32743  # same port the reference chose (design.md:98)
+    assume_ttl_s: float = 60.0  # stale-assumption GC horizon (§5.2)
+    resource_name: str = RESOURCE_CHIPS
+    # Per-generation LinkCostModel field overrides, e.g.
+    # {"v5p": {"ici_link_gbps": 95.0, "dcn_host_gbps": 42.0}} — the explicit,
+    # measured replacement for the reference's TODO weight table.
+    cost_overrides: dict[str, dict[str, float]] = field(default_factory=dict)
+
+    def cost_model(self, generation: str) -> LinkCostModel:
+        return LinkCostModel.for_generation(
+            generation, **self.cost_overrides.get(generation, {})
+        )
+
+    # ---- file round-trip ---------------------------------------------------
+
+    @staticmethod
+    def load(path: str | Path) -> "ExtenderConfig":
+        data = json.loads(Path(path).read_text())
+        known = set(ExtenderConfig.__dataclass_fields__)
+        unknown = set(data) - known
+        if unknown:
+            raise ValueError(f"unknown config keys {sorted(unknown)}; known {sorted(known)}")
+        return ExtenderConfig(**data)
+
+    def save(self, path: str | Path) -> None:
+        Path(path).write_text(json.dumps(self.__dict__, indent=2) + "\n")
+
+    # ---- kube-scheduler registration (design.md:92-113) --------------------
+
+    def policy_json(self, host: str = "127.0.0.1") -> dict:
+        """The kube-scheduler Policy stanza registering this extender —
+        field-for-field the shape the reference specifies (design.md:92-113):
+        Prioritize verb "sort", Bind verb "bind", deliberately no Filter verb
+        (design.md:115-117), nodeCacheCapable, fail-closed ignorable=false
+        (design.md:109, SURVEY.md §5.3)."""
+        return {
+            "kind": "Policy",
+            "apiVersion": "v1",
+            "extenders": [
+                {
+                    "urlPrefix": f"http://{host}:{self.port}{self.url_prefix}",
+                    "prioritizeVerb": "sort",
+                    "bindVerb": "bind",
+                    "enableHttps": False,
+                    "nodeCacheCapable": True,
+                    "managedResources": [
+                        {"name": self.resource_name, "ignoredByScheduler": True}
+                    ],
+                    "ignorable": False,
+                }
+            ],
+        }

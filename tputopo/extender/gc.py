@@ -1,0 +1,77 @@
+"""Stale-assumption garbage collector.
+
+The reference's two-phase handshake (bind stamps ASSUME_TIME + ASSIGNED=false;
+Allocate confirms, design.md:223-246) leaves one failure mode open: a pod
+bound but never started (node died, image pull stuck).  SURVEY.md §5.2-5.3
+prescribes a GC that releases devices whose assumption is older than a TTL
+and never confirmed.  :class:`ClusterState` already *ignores* expired
+assumptions when computing occupancy; this sweeper makes the release
+durable and observable by clearing the scheduling annotations on the pod —
+generalized to the job level (the all-or-nothing token, SURVEY.md §7 "gang
+scheduling semantics"): when any member of a gang expires, every *still
+unconfirmed* member is released with it.  Confirmed members have running
+containers; reclaiming their chips is a job-controller decision (delete the
+pods), not a scheduler-side annotation wipe — the sweeper surfaces such
+gangs in :attr:`stranded_gangs` instead of double-booking their chips.
+"""
+
+from __future__ import annotations
+
+import time
+
+from tputopo.k8s import objects as ko
+from tputopo.k8s.fakeapi import FakeApiServer, NotFound
+from tputopo.extender.state import ClusterState
+
+
+class AssumptionGC:
+    def __init__(self, api_server: FakeApiServer, assume_ttl_s: float = 60.0,
+                 clock=time.time) -> None:
+        self.api = api_server
+        self.assume_ttl_s = assume_ttl_s
+        self.clock = clock
+        self.released: list[str] = []  # pod names released, for observability
+        # Gangs with confirmed members whose unconfirmed members expired —
+        # they hold chips but can never complete; a job controller must act.
+        self.stranded_gangs: list[str] = []
+
+    def sweep(self) -> list[str]:
+        """One pass: clear assignments for expired assumptions (and their
+        whole gangs).  Returns the pod names released this pass."""
+        state = ClusterState(self.api, assume_ttl_s=self.assume_ttl_s,
+                             clock=self.clock).sync()
+        victims: dict[tuple[str, str], None] = {}
+        gangs: set[str] = set()
+        for pa in state.expired:
+            victims[(pa.namespace, pa.pod_name)] = None
+            if pa.gang_id:
+                gangs.add(pa.gang_id)
+        # Gang expansion: release every still-unconfirmed member of an
+        # expired gang together (a partial gang holds chips a complete gang
+        # needs); confirmed members are running — flag, don't release.
+        stranded: set[str] = set()
+        if gangs:
+            for dom in state.domains.values():
+                for pa in dom.assignments:
+                    if pa.gang_id in gangs:
+                        if pa.assigned:
+                            stranded.add(pa.gang_id)
+                        else:
+                            victims[(pa.namespace, pa.pod_name)] = None
+        self.stranded_gangs.extend(sorted(stranded))
+        del self.stranded_gangs[:-100]
+        released = []
+        for ns, name in victims:
+            try:
+                self.api.patch_annotations(
+                    "pods", name,
+                    {ko.ANN_GROUP: None, ko.ANN_ASSUME_TIME: None,
+                     ko.ANN_ASSIGNED: None, ko.ANN_PREDICTED_GBPS: None},
+                    namespace=ns,
+                )
+                released.append(f"{ns}/{name}")
+            except NotFound:
+                continue  # pod deleted meanwhile — already released
+        self.released.extend(released)
+        del self.released[:-500]
+        return released

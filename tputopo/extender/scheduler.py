@@ -1,0 +1,320 @@
+"""Extender scheduling logic: the ``sort`` and ``bind`` verbs.
+
+Control flow mirrors the reference hot loop (SURVEY.md §3.2): per feasible
+node, parse cluster state -> select best chip combo -> score; the scheduler
+picks the max-score node and calls ``bind``, which re-runs the selector on
+the winner, stamps the three-field assignment handshake onto the pod
+(design.md:223-234: GROUP / ASSUME_TIME / ASSIGNED=false), and binds.
+
+TPU-native departures from the reference, per SURVEY.md §5/§7:
+
+- Scores are predicted all-reduce GB/s normalized to the domain ideal
+  (direction bug fixed: higher == better).
+- A pod's chips must live on its node (a pod runs on one host), so jobs
+  larger than one host are *gangs*: pods sharing ``tpu.dev/gang-id`` with a
+  ``tpu.dev/gang-size`` count.  Gang placement plans one replica per host
+  over a host-grid torus, preferring a contiguous host box so the combined
+  chip set is ICI-contiguous (BASELINE configs 3-5).  All-or-nothing is
+  enforced at bind (the extender has no Filter verb by design,
+  design.md:115-117): an infeasible gang binds nothing, and members that
+  already hold assumptions expire together via the gang-aware TTL GC.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from tputopo.k8s import objects as ko
+from tputopo.k8s.fakeapi import Conflict, FakeApiServer, NotFound
+from tputopo.extender.config import ExtenderConfig
+from tputopo.extender.state import ClusterState, SliceDomain
+from tputopo.topology.model import ChipTopology, Coord
+from tputopo.topology.score import predict_allreduce_gbps, score_chip_set
+from tputopo.topology.slices import Allocator, Placement, enumerate_shapes
+
+# Gang metadata lives in labels (selectable) with annotation fallback.
+LABEL_GANG_ID = "tpu.dev/gang-id"
+LABEL_GANG_SIZE = "tpu.dev/gang-size"
+
+MAX_PRIORITY = 10  # kube-scheduler extender priority ceiling
+
+
+class BindError(RuntimeError):
+    pass
+
+
+@dataclass
+class Metrics:
+    counters: dict[str, int] = field(default_factory=dict)
+    latencies_ms: dict[str, list[float]] = field(default_factory=dict)
+
+    def inc(self, name: str, by: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + by
+
+    def observe_ms(self, name: str, ms: float) -> None:
+        self.latencies_ms.setdefault(name, []).append(ms)
+
+    def p50_ms(self, name: str) -> float | None:
+        xs = sorted(self.latencies_ms.get(name, []))
+        return xs[len(xs) // 2] if xs else None
+
+
+def _gang_of(pod: dict) -> tuple[str, int] | None:
+    md = pod.get("metadata", {})
+    meta = {**md.get("annotations", {}), **md.get("labels", {})}
+    gid = meta.get(LABEL_GANG_ID)
+    if not gid:
+        return None
+    try:
+        size = int(meta.get(LABEL_GANG_SIZE, "0"))
+    except ValueError:
+        size = 0
+    if size < 1:
+        raise ValueError(f"gang {gid!r} needs a positive {LABEL_GANG_SIZE} label")
+    return gid, size
+
+
+class ExtenderScheduler:
+    def __init__(self, api_server: FakeApiServer,
+                 config: ExtenderConfig | None = None,
+                 clock=time.time) -> None:
+        self.api = api_server
+        self.config = config or ExtenderConfig()
+        self.clock = clock
+        self.metrics = Metrics()
+        self.decisions: list[dict] = []  # recent decision records (observability)
+
+    def _state(self) -> ClusterState:
+        return ClusterState(
+            self.api,
+            cost_for_generation=self.config.cost_model,
+            assume_ttl_s=self.config.assume_ttl_s,
+            clock=self.clock,
+        ).sync()
+
+    # ---- sort (Prioritize) -------------------------------------------------
+
+    def sort(self, pod: dict, node_names: list[str]) -> list[dict]:
+        """Score candidate nodes for a pod; [{"Host": ..., "Score": 0-10}].
+
+        The reference's per-node loop (design.md:119: best combo per node,
+        then the score formula — with the direction fixed, SURVEY.md §5).
+        """
+        t0 = time.perf_counter()
+        self.metrics.inc("sort_requests")
+        state = self._state()
+        k = ko.pod_requested_chips(pod)
+        gang = _gang_of(pod)
+        gang_ctx = None
+        if k > 0 and gang is not None:
+            # One plan per sort request — the plan depends only on state and
+            # the gang, never on the candidate node being scored.
+            gang_ctx = self._gang_context(state, gang, k)
+        out = []
+        for name in node_names:
+            score = 0
+            if k > 0:
+                if gang is not None:
+                    score = self._score_gang_node(gang_ctx, name)
+                else:
+                    score = self._score_node(state, k, name)
+            out.append({"Host": name, "Score": score})
+        self.metrics.observe_ms("sort", (time.perf_counter() - t0) * 1e3)
+        return out
+
+    def _score_node(self, state: ClusterState, k: int, node_name: str) -> int:
+        dom = state.domain_of_node(node_name)
+        if dom is None:
+            return 0
+        node_free = frozenset(state.free_chips_on_node(node_name))
+        if len(node_free) < k:
+            return 0
+        placement = dom.allocator.find(k, node_free)
+        if placement is None:
+            return 0
+        if k == 1:
+            # Anti-fragmentation quality: fewer free neighbors around the
+            # chosen chip is better (Singular policy, Gaia PDF Alg. 3).
+            chip = placement.chips[0]
+            free_all = dom.allocator.free
+            degree = max(1, len(dom.topology.neighbors(chip)))
+            free_n = sum(1 for n in dom.topology.neighbors(chip) if n in free_all)
+            return max(1, round(MAX_PRIORITY * (1 - free_n / (degree + 1))))
+        ideal = self._ideal_gbps(dom, k)
+        if ideal <= 0:
+            return 1
+        frac = min(1.0, placement.score_gbps / ideal)
+        return max(1, round(MAX_PRIORITY * frac))
+
+    def _ideal_gbps(self, dom: SliceDomain, k: int) -> float:
+        shapes = enumerate_shapes(dom.topology, k, dom.allocator.cost)
+        if not shapes:
+            return dom.allocator.cost.ici_link_gbps  # blob-only request size
+        return predict_allreduce_gbps(dom.topology, shapes[0].dims,
+                                      dom.allocator.cost)
+
+    # ---- gang planning -----------------------------------------------------
+
+    def _gang_members(self, gang_id: str) -> list[dict]:
+        return self.api.list(
+            "pods",
+            lambda p: ({**p["metadata"].get("annotations", {}),
+                        **p["metadata"].get("labels", {})}
+                       ).get(LABEL_GANG_ID) == gang_id,
+        )
+
+    def _plan_gang(self, state: ClusterState, dom: SliceDomain,
+                   replicas: int, k: int,
+                   exclude_nodes: set[str]) -> dict[str, Placement] | None:
+        """Plan ``replicas`` single-node k-chip placements, preferring a
+        contiguous box on the host grid so the union is ICI-contiguous
+        (SURVEY.md §7: Link-scheduler analog in 3D).  Returns
+        {node_name: placement} or None when the gang cannot fit."""
+        topo = dom.topology
+        hb = topo.generation.host_bounds
+        grid_dims = tuple(max(1, d // b) for d, b in zip(topo.dims, hb))
+        host_grid = ChipTopology(topo.generation, grid_dims, topo.wrap)
+
+        candidate: dict[Coord, Placement] = {}
+        for host, node_name in dom.node_by_host.items():
+            if node_name in exclude_nodes:
+                continue
+            node_free = frozenset(state.free_chips_on_node(node_name))
+            if len(node_free) < k:
+                continue
+            p = dom.allocator.find(k, node_free)
+            if p is not None:
+                candidate[host] = p
+
+        if len(candidate) < replicas:
+            return None
+        host_alloc = Allocator(host_grid, dom.allocator.cost)
+        host_alloc.mark_used([h for h in host_grid.chips if h not in candidate])
+        hosts = host_alloc.find(replicas)
+        if hosts is None:
+            return None
+        return {dom.node_by_host[h]: candidate[h] for h in hosts.chips}
+
+    def _gang_context(self, state: ClusterState, gang: tuple[str, int],
+                      k: int) -> tuple[SliceDomain | None, dict[str, Placement] | None]:
+        """Remaining-member plan for a gang, given already-bound members."""
+        gang_id, size = gang
+        members = self._gang_members(gang_id)
+        bound = [p for p in members if p["spec"].get("nodeName")]
+        remaining = size - len(bound)
+        if remaining <= 0:
+            return None, None
+        dom_ids = {d.slice_id for p in bound
+                   if (d := state.domain_of_node(p["spec"]["nodeName"])) is not None}
+        if len(dom_ids) > 1:
+            # Members already straddle ICI domains — such a gang can never
+            # be contiguous; refuse to extend it (its assumptions will age
+            # out via the GC).  Cross-domain gangs over DCN are a deliberate
+            # non-goal for now: the scorer can rank them
+            # (predict_multidomain_allreduce_gbps) but the planner won't
+            # produce them.
+            return None, None
+        exclude = {p["spec"]["nodeName"] for p in bound}
+        search = ([state.domains[next(iter(dom_ids))]] if dom_ids
+                  else list(state.domains.values()))
+        for dom in search:
+            plan = self._plan_gang(state, dom, remaining, k, exclude)
+            if plan is not None:
+                return dom, plan
+        return None, None
+
+    def _score_gang_node(self, gang_ctx, node_name: str) -> int:
+        dom, plan = gang_ctx if gang_ctx is not None else (None, None)
+        if plan is None or node_name not in plan:
+            return 0
+        # Rank member nodes in host-grid (row-major coordinate) order, NOT
+        # node-name order: binding must march through the planned host box
+        # compactly so the hosts still free for later members remain a
+        # connected region (lexicographic "node-1" < "node-10" < "node-2"
+        # ordering fragments the grid mid-gang).
+        ordered = sorted(plan, key=lambda n: dom.host_by_node[n])
+        rank = ordered.index(node_name)
+        return max(1, MAX_PRIORITY - rank)
+
+    # ---- bind --------------------------------------------------------------
+
+    def bind(self, pod_name: str, namespace: str, node_name: str) -> dict:
+        """The bind verb (design.md:119, 223-234): re-run selection on the
+        winning node, stamp the assignment handshake, bind the pod."""
+        t0 = time.perf_counter()
+        self.metrics.inc("bind_requests")
+        try:
+            pod = self.api.get("pods", pod_name, namespace)
+        except NotFound:
+            self.metrics.inc("bind_errors")
+            raise BindError(f"pod {namespace}/{pod_name} not found") from None
+        state = self._state()
+        k = ko.pod_requested_chips(pod)
+        if k <= 0:
+            self.metrics.inc("bind_errors")
+            raise BindError(f"pod {pod_name} requests no {self.config.resource_name}")
+        dom = state.domain_of_node(node_name)
+        if dom is None:
+            self.metrics.inc("bind_errors")
+            raise BindError(f"node {node_name} is not part of any TPU slice")
+
+        gang = _gang_of(pod)
+        gang_id = None
+        if gang is not None:
+            gang_id = gang[0]
+            plan_dom, plan = self._gang_context(state, gang, k)
+            if plan is None:
+                self.metrics.inc("bind_gang_infeasible")
+                raise BindError(
+                    f"gang {gang_id!r} cannot fit ({gang[1]} x {k} chips) — "
+                    "binding nothing (all-or-nothing)"
+                )
+            if node_name not in plan:
+                self.metrics.inc("bind_gang_wrong_node")
+                raise BindError(
+                    f"node {node_name} is not in gang {gang_id!r}'s plan "
+                    f"(planned: {sorted(plan)})"
+                )
+            placement = plan[node_name]
+        else:
+            node_free = frozenset(state.free_chips_on_node(node_name))
+            placement = dom.allocator.find(k, node_free)
+            if placement is None:
+                self.metrics.inc("bind_errors")
+                raise BindError(
+                    f"no feasible {k}-chip placement on {node_name} "
+                    f"({len(node_free)} free)"
+                )
+
+        now = self.clock()
+        anns = {
+            ko.ANN_GROUP: ko.coords_to_ann(placement.chips),
+            ko.ANN_ASSUME_TIME: str(now),
+            ko.ANN_ASSIGNED: "false",
+            ko.ANN_PREDICTED_GBPS: f"{placement.score_gbps:.3f}",
+        }
+        if gang_id is not None:
+            anns[ko.ANN_GANG_ID] = gang_id
+        try:
+            self.api.patch_annotations("pods", pod_name, anns, namespace)
+            self.api.bind_pod(pod_name, node_name, namespace)
+        except (Conflict, NotFound) as e:
+            self.metrics.inc("bind_errors")
+            raise BindError(f"bind race on {pod_name}: {e}") from e
+
+        decision = {
+            "pod": f"{namespace}/{pod_name}",
+            "node": node_name,
+            "slice": dom.slice_id,
+            "chips": [list(c) for c in placement.chips],
+            "contiguous": placement.is_contiguous_box,
+            "predicted_allreduce_gbps": placement.score_gbps,
+            "gang": gang_id,
+            "time": now,
+        }
+        self.decisions.append(decision)
+        del self.decisions[:-200]
+        self.metrics.inc("bind_success")
+        self.metrics.observe_ms("bind", (time.perf_counter() - t0) * 1e3)
+        return decision

@@ -1,0 +1,202 @@
+"""HTTP front-end for the extender — the process kube-scheduler talks to.
+
+Verb shapes follow the kube-scheduler extender contract the reference
+registers (design.md:92-113): POST ``<prefix>/sort`` (Prioritize) takes the
+pod plus candidate nodes and returns a host-priority list; POST
+``<prefix>/bind`` takes {PodName, PodNamespace, Node} and returns
+{"Error": ""} on success.  ``nodeCacheCapable: true`` (design.md:102) means
+sort receives node *names*; topology comes from the extender's own cluster
+state, never from a node round-trip.
+
+Extras beyond the reference (SURVEY.md §5.1/§5.5 prescriptions): /healthz,
+Prometheus-format /metrics with per-verb latency, and /state exposing the
+fragmentation report and recent decision records.  Fail-closed posture
+(ignorable=false, design.md:109): errors return non-2xx with a reason, so
+scheduling of managed pods fails loudly rather than silently degrading.
+
+Stdlib http.server only — this image has no Flask/grpcio, and a scheduler
+extender needs nothing more.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tputopo.extender.config import ExtenderConfig
+from tputopo.extender.scheduler import BindError, ExtenderScheduler
+
+
+class _Handler(BaseHTTPRequestHandler):
+    scheduler: ExtenderScheduler  # set by server factory
+    config: ExtenderConfig
+
+    # ---- plumbing ----------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # quiet; metrics cover observability
+        pass
+
+    def _send_json(self, code: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, code: int, text: str) -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self):
+        n = int(self.headers.get("Content-Length", "0"))
+        raw = self.rfile.read(n) if n else b""
+        if not raw:
+            raise ValueError("empty request body")
+        return json.loads(raw)
+
+    # ---- routes ------------------------------------------------------------
+
+    def do_POST(self) -> None:
+        prefix = self.config.url_prefix
+        try:
+            if self.path == f"{prefix}/sort":
+                self._handle_sort()
+            elif self.path == f"{prefix}/bind":
+                self._handle_bind()
+            else:
+                self._send_json(404, {"error": f"unknown path {self.path}"})
+        except (ValueError, KeyError, json.JSONDecodeError) as e:
+            self.scheduler.metrics.inc("bad_requests")
+            self._send_json(400, {"error": str(e)})
+
+    def do_GET(self) -> None:
+        if self.path == "/healthz":
+            self._send_text(200, "ok\n")
+        elif self.path == "/metrics":
+            self._send_text(200, self._render_metrics())
+        elif self.path == "/state":
+            state = self.scheduler._state()
+            self._send_json(200, {
+                "fragmentation": state.fragmentation_report(),
+                "decisions": self.scheduler.decisions[-20:],
+            })
+        elif self.path == "/policy":
+            self._send_json(200, self.config.policy_json())
+        else:
+            self._send_json(404, {"error": f"unknown path {self.path}"})
+
+    def _handle_sort(self) -> None:
+        req = self._read_json()
+        pod = req.get("Pod")
+        if pod is None:
+            raise ValueError("sort request needs a Pod")
+        node_names = req.get("NodeNames")
+        if node_names is None:
+            items = (req.get("Nodes") or {}).get("Items") or []
+            node_names = [n["metadata"]["name"] for n in items]
+        self._send_json(200, self.scheduler.sort(pod, list(node_names)))
+
+    def _handle_bind(self) -> None:
+        req = self._read_json()
+        for field in ("PodName", "PodNamespace", "Node"):
+            if field not in req:
+                raise ValueError(f"bind request needs {field}")
+        try:
+            self.scheduler.bind(req["PodName"], req["PodNamespace"], req["Node"])
+            self._send_json(200, {"Error": ""})
+        except BindError as e:
+            # Non-empty Error => kube-scheduler treats the bind as failed and
+            # requeues the pod; with ignorable=false nothing silently binds.
+            self._send_json(200, {"Error": str(e)})
+
+    def _render_metrics(self) -> str:
+        m = self.scheduler.metrics
+        lines = []
+        for name, v in sorted(m.counters.items()):
+            lines.append(f"tputopo_extender_{name}_total {v}")
+        for verb in sorted(m.latencies_ms):
+            p50 = m.p50_ms(verb)
+            if p50 is not None:
+                lines.append(f"tputopo_extender_{verb}_latency_p50_ms {p50:.3f}")
+        return "\n".join(lines) + "\n"
+
+
+class ExtenderHTTPServer:
+    """Owns the ThreadingHTTPServer; start()/stop() for tests and main()."""
+
+    def __init__(self, scheduler: ExtenderScheduler,
+                 config: ExtenderConfig | None = None,
+                 host: str = "127.0.0.1", port: int | None = None) -> None:
+        self.config = config or scheduler.config
+        handler = type("Handler", (_Handler,), {
+            "scheduler": scheduler, "config": self.config,
+        })
+        self.httpd = ThreadingHTTPServer(
+            (host, self.config.port if port is None else port), handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self.httpd.server_address[:2]
+
+    def start(self) -> "ExtenderHTTPServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="tputopo-extender", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def main() -> None:  # pragma: no cover - thin CLI wrapper
+    import argparse
+
+    from tputopo.k8s.fakeapi import FakeApiServer
+
+    ap = argparse.ArgumentParser(description="tputopo scheduler extender")
+    ap.add_argument("--config", help="path to ExtenderConfig JSON")
+    ap.add_argument("--port", type=int, default=None)
+    args = ap.parse_args()
+    config = ExtenderConfig.load(args.config) if args.config else ExtenderConfig()
+    if args.port is not None:
+        config.port = args.port
+    # Standalone mode serves against an empty in-memory API (for smoke tests
+    # and /policy generation); in-cluster deployments wire a real API client.
+    api_server = FakeApiServer()
+    scheduler = ExtenderScheduler(api_server, config)
+    server = ExtenderHTTPServer(scheduler, config)
+
+    from tputopo.extender.gc import AssumptionGC
+
+    gc = AssumptionGC(api_server, assume_ttl_s=config.assume_ttl_s)
+    stop = threading.Event()
+
+    def gc_loop() -> None:
+        while not stop.wait(max(1.0, config.assume_ttl_s / 2)):
+            released = gc.sweep()
+            if released:
+                print(f"gc: released stale assumptions for {released}")
+
+    threading.Thread(target=gc_loop, name="tputopo-gc", daemon=True).start()
+    print(f"tputopo extender listening on {server.address} "
+          f"(prefix {config.url_prefix}, gc every {config.assume_ttl_s / 2:.0f}s)")
+    server.start()
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        stop.set()
+        server.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,160 @@
+"""Cluster state: the extender's in-memory world, rebuilt from the API
+server on demand.
+
+Keeps the reference's statelessness posture (SURVEY.md §5.4: "a restarted
+extender rebuilds its world from the API server; no private state files"):
+every sync reads node annotations (topology, component 2.5's output) and pod
+annotations (assignments, component 2.9's output) and reconstructs
+per-ICI-domain allocators.  An assumption older than the TTL that was never
+confirmed does not count as occupancy — that is the GC semantics the
+two-phase handshake needs (design.md:227-246; SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from tputopo.k8s import objects as ko
+from tputopo.k8s.fakeapi import FakeApiServer
+from tputopo.topology.cost import LinkCostModel
+from tputopo.topology.model import ChipTopology, Coord, parse_topology
+from tputopo.topology.slices import Allocator
+
+
+@dataclass
+class PodAssignment:
+    pod_name: str
+    namespace: str
+    node_name: str
+    chips: list[Coord]
+    assigned: bool
+    assume_time: float
+    gang_id: str | None
+
+
+@dataclass
+class SliceDomain:
+    """One ICI domain: a set of nodes sharing a torus (same slice-id)."""
+
+    slice_id: str
+    topology: ChipTopology
+    allocator: Allocator
+    node_by_host: dict[Coord, str] = field(default_factory=dict)   # host coord -> node name
+    host_by_node: dict[str, Coord] = field(default_factory=dict)
+    chips_by_node: dict[str, list[Coord]] = field(default_factory=dict)
+    assignments: list[PodAssignment] = field(default_factory=list)
+
+    def node_of_chip(self, chip: Coord) -> str | None:
+        host = self.topology.host_of(chip)
+        return self.node_by_host.get(host)
+
+
+class ClusterState:
+    def __init__(self, api_server: FakeApiServer, *,
+                 cost_for_generation=None, assume_ttl_s: float = 60.0,
+                 clock=time.time) -> None:
+        self.api = api_server
+        self.assume_ttl_s = assume_ttl_s
+        self.clock = clock
+        self._cost_for_generation = cost_for_generation or (
+            lambda gen: LinkCostModel.for_generation(gen))
+        self.domains: dict[str, SliceDomain] = {}
+        self.expired: list[PodAssignment] = []  # assumptions the TTL voided
+
+    # ---- sync (SURVEY.md §3.2: parse annotations -> in-memory model) -------
+
+    def sync(self) -> "ClusterState":
+        self.domains = {}
+        self.expired = []
+        for node in self.api.list("nodes"):
+            anns = node["metadata"].get("annotations", {})
+            if ko.ANN_TOPOLOGY not in anns or ko.ANN_SLICE_ID not in anns:
+                continue  # not a TPU node
+            slice_id = anns[ko.ANN_SLICE_ID]
+            topo = parse_topology(anns[ko.ANN_TOPOLOGY])
+            dom = self.domains.get(slice_id)
+            if dom is None:
+                cost = self._cost_for_generation(topo.generation.name)
+                dom = SliceDomain(
+                    slice_id=slice_id, topology=topo,
+                    allocator=Allocator(topo, cost),
+                )
+                self.domains[slice_id] = dom
+            elif dom.topology != topo:
+                raise ValueError(
+                    f"nodes of slice {slice_id!r} disagree on topology: "
+                    f"{dom.topology.describe()} vs {topo.describe()}"
+                )
+            name = node["metadata"]["name"]
+            host = tuple(int(x) for x in anns[ko.ANN_HOST_COORD].split(","))
+            dom.node_by_host[host] = name
+            dom.host_by_node[name] = host
+            import json as _json
+            dom.chips_by_node[name] = [
+                tuple(int(x) for x in c["id"].split(","))
+                for c in _json.loads(anns.get(ko.ANN_CHIPS, "[]"))
+            ]
+
+        now = self.clock()
+        for pod in self.api.list("pods"):
+            anns = pod["metadata"].get("annotations", {})
+            group = anns.get(ko.ANN_GROUP)
+            node_name = pod["spec"].get("nodeName")
+            if not group or not node_name:
+                continue
+            assigned = anns.get(ko.ANN_ASSIGNED) == "true"
+            assume_time = float(anns.get(ko.ANN_ASSUME_TIME, "0"))
+            pa = PodAssignment(
+                pod_name=pod["metadata"]["name"],
+                namespace=pod["metadata"].get("namespace", "default"),
+                node_name=node_name,
+                chips=ko.ann_to_coords(group),
+                assigned=assigned,
+                assume_time=assume_time,
+                gang_id=anns.get(ko.ANN_GANG_ID),
+            )
+            dom = self._domain_of_node(node_name)
+            if dom is None:
+                continue
+            if not assigned and now - assume_time > self.assume_ttl_s:
+                # Stale assumption: bind happened but Allocate never confirmed
+                # within the TTL — the chips are NOT occupied (SURVEY.md §5.2).
+                self.expired.append(pa)
+                continue
+            dom.assignments.append(pa)
+            dom.allocator.mark_used(pa.chips)
+        return self
+
+    def _domain_of_node(self, node_name: str) -> SliceDomain | None:
+        for dom in self.domains.values():
+            if node_name in dom.host_by_node:
+                return dom
+        return None
+
+    # ---- views -------------------------------------------------------------
+
+    def domain_of_node(self, node_name: str) -> SliceDomain | None:
+        return self._domain_of_node(node_name)
+
+    def free_chips_on_node(self, node_name: str) -> list[Coord]:
+        dom = self._domain_of_node(node_name)
+        if dom is None:
+            return []
+        free = dom.allocator.free
+        return [c for c in dom.chips_by_node.get(node_name, []) if c in free]
+
+    def fragmentation_report(self) -> dict:
+        """Observability: per-domain free/used and largest free box — the
+        analog of Gaia's fragment-node bookkeeping (PDF §III.B)."""
+        out = {}
+        for sid, dom in self.domains.items():
+            largest = dom.allocator.largest_free_box()
+            out[sid] = {
+                "topology": dom.topology.describe(),
+                "free_chips": len(dom.allocator.free),
+                "used_chips": len(dom.allocator.used),
+                "largest_free_box": list(largest[1]) if largest else None,
+                "expired_assumptions": len(self.expired),
+            }
+        return out

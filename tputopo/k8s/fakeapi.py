@@ -117,6 +117,23 @@ class FakeApiServer:
                                 "patch": dict(patch)})
             return copy.deepcopy(obj)
 
+    def patch_labels(self, kind: str, name: str, patch: dict[str, str | None],
+                     namespace: str | None = None) -> dict:
+        """Merge ``patch`` into metadata.labels (None deletes a key)."""
+        with self._lock:
+            try:
+                obj = self._store(kind)[_key(namespace, name)]
+            except KeyError:
+                raise NotFound(f"{kind} {namespace}/{name}") from None
+            labels = obj["metadata"].setdefault("labels", {})
+            for k, v in patch.items():
+                if v is None:
+                    labels.pop(k, None)
+                else:
+                    labels[k] = str(v)
+            self._bump(obj)
+            return copy.deepcopy(obj)
+
     # ---- binding (the extender's bind verb target) -------------------------
 
     def bind_pod(self, name: str, node_name: str, namespace: str | None = None) -> dict:

@@ -74,7 +74,9 @@ std::string GetEnv(const char* key) {
   return v ? std::string(v) : std::string();
 }
 
-// Parse "2,2,1" or "2x2x1" into up to 3 ints; returns count.
+// Parse "2,2,1" or "2x2x1" into up to 3 ints; returns count, or -1 on any
+// malformed input (leading/trailing/doubled separators, non-digits) — must
+// stay exactly as strict as the pure-Python twin's regex (shim.py).
 int ParseDims(const std::string& s, int out[3]) {
   int n = 0;
   int cur = -1;
@@ -90,11 +92,22 @@ int ParseDims(const std::string& s, int out[3]) {
       return -1;
     }
   }
-  if (cur >= 0) {
-    if (n >= 3) return -1;
-    out[n++] = cur;
-  }
+  if (cur < 0) return -1;  // empty input or trailing separator
+  if (n >= 3) return -1;
+  out[n++] = cur;
   return n;
+}
+
+// Strict non-negative integer parse; anything else (including "3abc" and
+// "-1") yields the fallback 0, matching the Python twin.
+int ParseWorkerId(const std::string& s) {
+  if (s.empty()) return 0;
+  int v = 0;
+  for (char ch : s) {
+    if (!std::isdigit(static_cast<unsigned char>(ch))) return 0;
+    v = v * 10 + (ch - '0');
+  }
+  return v;
 }
 
 std::vector<std::string> ScanAccelDevices() {
@@ -151,7 +164,7 @@ bool ProbeFake(Probe* p) {
   size_t at = spec.find('@');
   if (at != std::string::npos) {
     body = spec.substr(0, at);
-    p->worker_id = std::atoi(spec.c_str() + at + 1);
+    p->worker_id = ParseWorkerId(spec.substr(at + 1));
   }
   size_t colon = body.find(':');
   if (colon == std::string::npos) {
@@ -238,7 +251,7 @@ void ProbeReal(Probe* p) {
 
   std::string wid = GetEnv("TPU_WORKER_ID");
   if (wid.empty()) wid = GetEnv("CLOUD_TPU_TASK_ID");
-  p->worker_id = wid.empty() ? 0 : std::atoi(wid.c_str());
+  p->worker_id = ParseWorkerId(wid);
   p->device_paths = ScanAccelDevices();
 }
 

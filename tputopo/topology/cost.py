@@ -72,8 +72,12 @@ class LinkCostModel:
         host_dma_gbps: bandwidth between chips on the *same host* that are
             not ICI-connected within an allocation (traffic staged through
             host memory / PCIe — the analog of the reference's PHB class,
-            design.md:38-40).  Strictly between ICI and DCN so ranking is
-            total: ICI-contiguous > same-host-split > cross-host-split.
+            design.md:38-40).  ICI-contiguous placements strictly dominate
+            any split; among splits, single-host splits score this staging
+            bandwidth while cross-host splits score their (narrowest) DCN
+            attachment — a many-host split can legitimately aggregate
+            enough NICs to out-score one host's PCIe, so the guaranteed
+            ordering is contiguous > split, not a total order over splits.
         ici_hop_latency_us: per-hop ICI latency (tiebreak only; ICI is ~1us).
         dcn_latency_us: DCN round-trip latency.
     """

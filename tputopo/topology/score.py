@@ -173,24 +173,31 @@ def predict_multidomain_allreduce_gbps(
 ) -> float:
     """Score a chip set spanning several ICI domains (nodes/slices).
 
-    Cross-domain traffic rides DCN; the collective is bottlenecked by the
-    narrowest domain's aggregate DCN attachment.  Within-domain bandwidth
-    only matters if it is (pathologically) below the DCN bound.
+    Units match :func:`score_chip_set`: *per-chip* all-reduce algorithm
+    bandwidth.  Cross-domain traffic rides DCN; during the inter-domain
+    phase the whole payload crosses the narrowest domain's aggregate DCN
+    attachment, shared by that domain's chips — so the per-chip DCN share
+    is ``dcn_host_gbps * hosts / chips`` of the narrowest domain, scaled by
+    the D-domain ring factor.  This keeps DCN-spanning placements strictly
+    below any ICI-contiguous placement (the SYS-vs-NVLink ordering the
+    reference encodes with marks, design.md:33-44).
     """
     if not domains:
         raise ValueError("no domains")
     if len(domains) == 1:
         topo, chips = domains[0]
         return score_chip_set(topo, chips, cost)
-    dcn_bound = min(
-        cost.dcn_host_gbps * len({t.host_of(c) for c in chips})
+    d = len(domains)
+    per_chip_dcn = min(
+        cost.dcn_host_gbps * len({t.host_of(c) for c in chips}) / len(chips)
         for t, chips in domains
+        if chips
     )
     ici_bound = min(
         score_chip_set(t, chips, cost) if len(chips) > 1 else float("inf")
         for t, chips in domains
     )
-    return min(dcn_bound, ici_bound)
+    return min(per_chip_dcn * d / (2.0 * (d - 1)), ici_bound)
 
 
 def explain_chip_set(topo: ChipTopology, chips: frozenset[Coord] | set[Coord],
