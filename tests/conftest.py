@@ -17,3 +17,9 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Some images pin a hardware platform through a sitecustomize hook that runs
+# before this file and ignores JAX_PLATFORMS; jax.config wins over both.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

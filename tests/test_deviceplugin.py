@@ -98,7 +98,8 @@ def test_allocate_without_pending_pod_uses_kubelet_ids():
 def test_allocate_oldest_pending_pod_wins():
     plugin, kubelet, api_server = make_plugin()
     plugin.start()
-    for name, t, group in [("new", "2000", "0,0,1"), ("old", "100", "0,1,1")]:
+    # Both assumptions live (within the 60 s TTL of clock=1000): oldest wins.
+    for name, t, group in [("new", "990", "0,0,1"), ("old", "950", "0,1,1")]:
         api_server.create("pods", make_pod(name, chips=1, node_name="n1",
                           annotations={ko.ANN_GROUP: group,
                                        ko.ANN_ASSUME_TIME: t,
@@ -108,6 +109,39 @@ def test_allocate_oldest_pending_pod_wins():
         ko.ANN_ASSIGNED] == "true"
     assert api_server.get("pods", "new", "default")["metadata"]["annotations"][
         ko.ANN_ASSIGNED] == "false"
+
+
+def test_allocate_skips_expired_assumption():
+    """An assumption older than the TTL must not be confirmed by a late
+    Allocate — the extender already treats those chips as free and may have
+    re-promised them (the bind-vs-allocate race, SURVEY.md §5.2)."""
+    plugin, kubelet, api_server = make_plugin()
+    plugin.start()
+    api_server.create("pods", make_pod("stale", chips=1, node_name="n1",
+                      annotations={ko.ANN_GROUP: "0,0,1",
+                                   ko.ANN_ASSUME_TIME: "100",  # 900 s old
+                                   ko.ANN_ASSIGNED: "false"}))
+    resp = kubelet.allocate(ko.RESOURCE_CHIPS, ["1,1,1"])
+    # Stale pod NOT confirmed; kubelet ids honored (chip 1,1,1 is unreserved
+    # because the only annotation holding it... holds 0,0,1, which is stale).
+    assert api_server.get("pods", "stale", "default")["metadata"][
+        "annotations"][ko.ANN_ASSIGNED] == "false"
+    assert resp.container_responses[0].envs["TPU_VISIBLE_CHIPS"] == "3"
+
+
+def test_allocate_refuses_kubelet_ids_reserved_by_live_assumption():
+    """The kubelet's arbitrary pick must not raid chips a still-valid
+    assignment reserves for another pod."""
+    plugin, kubelet, api_server = make_plugin()
+    plugin.start()
+    api_server.create("pods", make_pod("holder", chips=2, node_name="n1",
+                      annotations={ko.ANN_GROUP: "0,0,1;0,1,1",
+                                   ko.ANN_ASSUME_TIME: "990",
+                                   ko.ANN_ASSIGNED: "false"}))
+    # Request size 1 doesn't match holder's group (2), so no pending pod is
+    # found — the fallback must still respect holder's reservation.
+    with pytest.raises(ValueError, match="reserved"):
+        kubelet.allocate(ko.RESOURCE_CHIPS, ["0,0,1"])
 
 
 def test_health_flip_propagates_to_kubelet():

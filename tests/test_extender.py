@@ -302,3 +302,68 @@ def test_config_roundtrip_and_policy(tmp_path):
         path2 = tmp_path / "bad.json"
         path2.write_text('{"bogus": 1}')
         ExtenderConfig.load(path2)
+
+
+# ---- code-review regressions: overlap tolerance & namespace-scoped gangs ----
+
+def test_state_tolerates_overlapping_chip_groups():
+    """Two pods claiming the same chips must not wedge sync(): first claimant
+    keeps them, the second lands in state.conflicts, and every verb (and the
+    GC, which also syncs) stays serviceable."""
+    clock = Clock(1000.0)
+    api, _ = build_cluster(clock=clock)
+    # Older assignment wins the chips (sync processes in assume-time order).
+    for name, t in (("first", "980"), ("dupe", "990")):
+        api.create("pods", make_pod(name, chips=2, node_name="node-0", annotations={
+            ko.ANN_GROUP: "0,0,0;0,1,0", ko.ANN_ASSUME_TIME: t,
+            ko.ANN_ASSIGNED: "true"}))
+    state = ClusterState(api, clock=clock).sync()
+    dom = state.domains["slice-a"]
+    assert len(dom.allocator.used) == 2
+    assert [pa.pod_name for pa in state.conflicts] == ["dupe"]
+    report = state.fragmentation_report()["slice-a"]
+    assert report["conflicting_assignments"] == ["default/dupe"]
+    # Verbs still work on the poisoned cluster.
+    sched = make_scheduler(api, clock=clock)
+    api.create("pods", make_pod("next", chips=1))
+    scores = sched.sort(api.get("pods", "next", "default"), all_nodes(api))
+    assert any(s["Score"] > 0 for s in scores)
+
+
+def test_state_tolerates_out_of_slice_chips():
+    clock = Clock(1000.0)
+    api, _ = build_cluster(clock=clock)
+    api.create("pods", make_pod("bogus", chips=1, node_name="node-0", annotations={
+        ko.ANN_GROUP: "9,9,9", ko.ANN_ASSUME_TIME: "990", ko.ANN_ASSIGNED: "true"}))
+    state = ClusterState(api, clock=clock).sync()
+    assert [pa.pod_name for pa in state.conflicts] == ["bogus"]
+    assert len(state.domains["slice-a"].allocator.used) == 0
+
+
+def test_gangs_are_namespace_scoped():
+    """Same gang id in two namespaces = two independent gangs (a fully bound
+    gang 'train' in ns A must not block ns B's gang 'train')."""
+    clock = Clock(1000.0)
+    api, _ = build_cluster(clock=clock)
+    sched = make_scheduler(api, clock=clock)
+    for i in range(2):
+        api.create("pods", gang_pod(f"a-{i}", "train", 2, 4))
+    for i in range(2):
+        pod = api.get("pods", f"a-{i}", "default")
+        scores = sched.sort(pod, all_nodes(api))
+        best = max(scores, key=lambda s: (s["Score"], s["Host"]))
+        assert best["Score"] > 0
+        sched.bind(f"a-{i}", "default", best["Host"])
+    # Namespace team-b reuses the gang id; it must schedule independently.
+    for i in range(2):
+        p = gang_pod(f"b-{i}", "train", 2, 4)
+        p["metadata"]["namespace"] = "team-b"
+        api.create("pods", p)
+    for i in range(2):
+        pod = api.get("pods", f"b-{i}", "team-b")
+        scores = sched.sort(pod, all_nodes(api))
+        best = max(scores, key=lambda s: (s["Score"], s["Host"]))
+        assert best["Score"] > 0, f"ns-b gang blocked by ns-a: {scores}"
+        sched.bind(f"b-{i}", "team-b", best["Host"])
+    state = ClusterState(api, clock=clock).sync()
+    assert len(state.domains["slice-a"].allocator.used) == 16

@@ -39,11 +39,16 @@ class TpuDevicePlugin:
     def __init__(self, node_name: str, slice_id: str,
                  kubelet: api.FakeKubelet, api_server: FakeApiServer,
                  probe: HostProbe | None = None,
+                 assume_ttl_s: float = 60.0,
                  clock=time.time) -> None:
         self.node_name = node_name
         self.slice_id = slice_id
         self.kubelet = kubelet
         self.api_server = api_server
+        # Must match the extender's TTL (ExtenderConfig.assume_ttl_s): an
+        # assumption the extender already treats as expired must not be
+        # confirmed late — the chips may have been re-promised.
+        self.assume_ttl_s = assume_ttl_s
         self.probe = probe if probe is not None else probe_host()
         if not self.probe.ok:
             raise RuntimeError(f"topology probe failed: {self.probe.error}")
@@ -125,22 +130,52 @@ class TpuDevicePlugin:
                         f"pod {pod['metadata']['name']} chip-group names "
                         f"chips {foreign} not on node {self.node_name}"
                     )
-                if self._confirm_assignment(pod):
-                    chip_ids = candidate
+                if not self._confirm_assignment(pod):
+                    # The GC released the assignment between lookup and
+                    # confirm.  Fail the Allocate (kubelet retries the pod)
+                    # rather than silently handing out chips that may now
+                    # belong to another pod's still-valid group.
+                    raise ValueError(
+                        f"assignment for pod {pod['metadata']['name']} was "
+                        "released mid-allocate; refusing unreserved chips"
+                    )
+                chip_ids = candidate
+            else:
+                # No pending assignment (an unmanaged pod): the kubelet's
+                # arbitrary pick must not raid chips other pods' still-valid
+                # groups reserve.
+                reserved = self._reserved_chip_ids()
+                clash = sorted(set(chip_ids) & reserved)
+                if clash:
+                    raise ValueError(
+                        f"kubelet-picked chips {clash} are reserved by "
+                        "pending assignments on this node"
+                    )
             responses.append(self._container_response(chip_ids))
         return api.AllocateResponse(container_responses=responses)
 
     # ---- internals ---------------------------------------------------------
 
+    def _is_live_assumption(self, pod: dict) -> bool:
+        """Unconfirmed AND not past the TTL the extender also applies."""
+        anns = pod["metadata"].get("annotations", {})
+        if anns.get(ko.ANN_ASSIGNED) != "false" or ko.ANN_GROUP not in anns:
+            return False
+        assume_time = float(anns.get(ko.ANN_ASSUME_TIME, "0"))
+        return self.clock() - assume_time <= self.assume_ttl_s
+
     def _find_pending_pod(self, n_devices: int) -> dict | None:
         """Oldest pod on this node still awaiting its Allocate confirm with a
         matching device count (the reference's assumed-pod lookup, the
-        device-side half of the two-phase handshake)."""
+        device-side half of the two-phase handshake).  Expired assumptions
+        are skipped: the extender no longer counts them as occupancy, so a
+        late Allocate must not resurrect them onto possibly re-promised
+        chips."""
         pods = self.api_server.list(
             "pods",
             lambda p: (
                 p["spec"].get("nodeName") == self.node_name
-                and p["metadata"].get("annotations", {}).get(ko.ANN_ASSIGNED) == "false"
+                and self._is_live_assumption(p)
                 and len(ko.ann_to_coords(
                     p["metadata"]["annotations"].get(ko.ANN_GROUP, ""))) == n_devices
             ),
@@ -151,10 +186,27 @@ class TpuDevicePlugin:
             p["metadata"]["annotations"].get(ko.ANN_ASSUME_TIME, "0")))
         return pods[0]
 
+    def _reserved_chip_ids(self) -> set[str]:
+        """Chip ids reserved by any live (unexpired, unconfirmed) assignment
+        or confirmed assignment on this node."""
+        reserved: set[str] = set()
+        for p in self.api_server.list(
+            "pods", lambda p: p["spec"].get("nodeName") == self.node_name
+        ):
+            anns = p["metadata"].get("annotations", {})
+            if ko.ANN_GROUP not in anns:
+                continue
+            if anns.get(ko.ANN_ASSIGNED) == "true" or self._is_live_assumption(p):
+                reserved.update(
+                    coord_id(c) for c in ko.ann_to_coords(anns[ko.ANN_GROUP]))
+        return reserved
+
     def _confirm_assignment(self, pod: dict) -> bool:
         """CAS-confirm the assignment.  Returns False when the assignment no
-        longer stands (GC released it between lookup and confirm) — the
-        caller must then NOT hand out the released chip group."""
+        longer stands (GC released it, or the TTL passed, between lookup and
+        confirm) — the caller must then NOT hand out the released chip group."""
+        if not self._is_live_assumption(pod):
+            return False
         md = pod["metadata"]
         patch = {ko.ANN_ASSIGNED: "true", ko.ANN_ASSUME_TIME: str(self.clock())}
         try:
@@ -176,6 +228,8 @@ class TpuDevicePlugin:
             if ko.ANN_GROUP not in anns:
                 return False
             if anns.get(ko.ANN_ASSIGNED) != "true":
+                if not self._is_live_assumption(fresh):
+                    return False  # expired while we raced — do not resurrect
                 self.api_server.patch_annotations(
                     "pods", md["name"], patch, namespace=md.get("namespace"),
                 )

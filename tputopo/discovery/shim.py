@@ -161,7 +161,7 @@ def _probe_python(env: dict[str, str] | None = None) -> dict:
         body = fake
         if "@" in fake:
             body, _, wid = fake.partition("@")
-            worker = int(wid) if wid.isdigit() else 0
+            worker = int(wid) if (wid.isascii() and wid.isdigit()) else 0
         if ":" not in body:
             return {"backend": "fake",
                     "error": f"TPUTOPO_FAKE wants '<gen>:<AxBxC>[@worker]', got '{fake}'"}
@@ -230,7 +230,7 @@ def _probe_python(env: dict[str, str] | None = None) -> dict:
             slice_dims[-1] *= chips // per_host
 
     wid_s = env.get("TPU_WORKER_ID", "") or env.get("CLOUD_TPU_TASK_ID", "")
-    worker = int(wid_s) if wid_s.isdigit() else 0
+    worker = int(wid_s) if (wid_s.isascii() and wid_s.isdigit()) else 0
 
     paths = sorted(
         f"/dev/{n}" for n in os.listdir("/dev")

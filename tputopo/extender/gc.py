@@ -41,11 +41,11 @@ class AssumptionGC:
         state = ClusterState(self.api, assume_ttl_s=self.assume_ttl_s,
                              clock=self.clock).sync()
         victims: dict[tuple[str, str], None] = {}
-        gangs: set[str] = set()
+        gangs: set[tuple[str, str]] = set()  # (namespace, gang_id)
         for pa in state.expired:
             victims[(pa.namespace, pa.pod_name)] = None
             if pa.gang_id:
-                gangs.add(pa.gang_id)
+                gangs.add((pa.namespace, pa.gang_id))
         # Gang expansion: release every still-unconfirmed member of an
         # expired gang together (a partial gang holds chips a complete gang
         # needs); confirmed members are running — flag, don't release.
@@ -53,9 +53,9 @@ class AssumptionGC:
         if gangs:
             for dom in state.domains.values():
                 for pa in dom.assignments:
-                    if pa.gang_id in gangs:
+                    if pa.gang_id and (pa.namespace, pa.gang_id) in gangs:
                         if pa.assigned:
-                            stranded.add(pa.gang_id)
+                            stranded.add(f"{pa.namespace}/{pa.gang_id}")
                         else:
                             victims[(pa.namespace, pa.pod_name)] = None
         self.stranded_gangs.extend(sorted(stranded))

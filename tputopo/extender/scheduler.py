@@ -60,7 +60,9 @@ class Metrics:
         return xs[len(xs) // 2] if xs else None
 
 
-def _gang_of(pod: dict) -> tuple[str, int] | None:
+def _gang_of(pod: dict) -> tuple[str, str, int] | None:
+    """(namespace, gang_id, size) — gang identity is namespace-scoped so
+    same-named gangs in different namespaces never merge."""
     md = pod.get("metadata", {})
     meta = {**md.get("annotations", {}), **md.get("labels", {})}
     gid = meta.get(LABEL_GANG_ID)
@@ -72,7 +74,7 @@ def _gang_of(pod: dict) -> tuple[str, int] | None:
         size = 0
     if size < 1:
         raise ValueError(f"gang {gid!r} needs a positive {LABEL_GANG_SIZE} label")
-    return gid, size
+    return md.get("namespace", "default"), gid, size
 
 
 class ExtenderScheduler:
@@ -156,12 +158,15 @@ class ExtenderScheduler:
 
     # ---- gang planning -----------------------------------------------------
 
-    def _gang_members(self, gang_id: str) -> list[dict]:
+    def _gang_members(self, namespace: str, gang_id: str) -> list[dict]:
         return self.api.list(
             "pods",
-            lambda p: ({**p["metadata"].get("annotations", {}),
-                        **p["metadata"].get("labels", {})}
-                       ).get(LABEL_GANG_ID) == gang_id,
+            lambda p: (
+                p["metadata"].get("namespace", "default") == namespace
+                and ({**p["metadata"].get("annotations", {}),
+                      **p["metadata"].get("labels", {})}
+                     ).get(LABEL_GANG_ID) == gang_id
+            ),
         )
 
     def _plan_gang(self, state: ClusterState, dom: SliceDomain,
@@ -196,11 +201,11 @@ class ExtenderScheduler:
             return None
         return {dom.node_by_host[h]: candidate[h] for h in hosts.chips}
 
-    def _gang_context(self, state: ClusterState, gang: tuple[str, int],
+    def _gang_context(self, state: ClusterState, gang: tuple[str, str, int],
                       k: int) -> tuple[SliceDomain | None, dict[str, Placement] | None]:
         """Remaining-member plan for a gang, given already-bound members."""
-        gang_id, size = gang
-        members = self._gang_members(gang_id)
+        namespace, gang_id, size = gang
+        members = self._gang_members(namespace, gang_id)
         bound = [p for p in members if p["spec"].get("nodeName")]
         remaining = size - len(bound)
         if remaining <= 0:
@@ -262,12 +267,12 @@ class ExtenderScheduler:
         gang = _gang_of(pod)
         gang_id = None
         if gang is not None:
-            gang_id = gang[0]
+            gang_id = gang[1]
             plan_dom, plan = self._gang_context(state, gang, k)
             if plan is None:
                 self.metrics.inc("bind_gang_infeasible")
                 raise BindError(
-                    f"gang {gang_id!r} cannot fit ({gang[1]} x {k} chips) — "
+                    f"gang {gang_id!r} cannot fit ({gang[2]} x {k} chips) — "
                     "binding nothing (all-or-nothing)"
                 )
             if node_name not in plan:

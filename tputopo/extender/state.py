@@ -12,6 +12,7 @@ two-phase handshake needs (design.md:227-246; SURVEY.md §5.2).
 
 from __future__ import annotations
 
+import json
 import time
 from dataclasses import dataclass, field
 
@@ -61,12 +62,18 @@ class ClusterState:
             lambda gen: LinkCostModel.for_generation(gen))
         self.domains: dict[str, SliceDomain] = {}
         self.expired: list[PodAssignment] = []  # assumptions the TTL voided
+        # Assignments whose chip groups overlap an earlier pod's (double-book
+        # races, hand-written annotations) or name chips outside the slice.
+        # Sync must tolerate them — a poisoned annotation would otherwise
+        # wedge every verb AND the GC that could clean it up.
+        self.conflicts: list[PodAssignment] = []
 
     # ---- sync (SURVEY.md §3.2: parse annotations -> in-memory model) -------
 
     def sync(self) -> "ClusterState":
         self.domains = {}
         self.expired = []
+        self.conflicts = []
         for node in self.api.list("nodes"):
             anns = node["metadata"].get("annotations", {})
             if ko.ANN_TOPOLOGY not in anns or ko.ANN_SLICE_ID not in anns:
@@ -90,14 +97,22 @@ class ClusterState:
             host = tuple(int(x) for x in anns[ko.ANN_HOST_COORD].split(","))
             dom.node_by_host[host] = name
             dom.host_by_node[name] = host
-            import json as _json
             dom.chips_by_node[name] = [
                 tuple(int(x) for x in c["id"].split(","))
-                for c in _json.loads(anns.get(ko.ANN_CHIPS, "[]"))
+                for c in json.loads(anns.get(ko.ANN_CHIPS, "[]"))
             ]
 
         now = self.clock()
-        for pod in self.api.list("pods"):
+        pods = sorted(
+            self.api.list("pods"),
+            key=lambda p: (
+                float(p["metadata"].get("annotations", {})
+                      .get(ko.ANN_ASSUME_TIME, "0")),
+                p["metadata"].get("namespace", "default"),
+                p["metadata"]["name"],
+            ),
+        )
+        for pod in pods:
             anns = pod["metadata"].get("annotations", {})
             group = anns.get(ko.ANN_GROUP)
             node_name = pod["spec"].get("nodeName")
@@ -123,7 +138,15 @@ class ClusterState:
                 self.expired.append(pa)
                 continue
             dom.assignments.append(pa)
-            dom.allocator.mark_used(pa.chips)
+            valid = set(dom.topology.chips)
+            fresh = [c for c in dict.fromkeys(pa.chips)
+                     if c in valid and c not in dom.allocator.used]
+            if len(fresh) != len(pa.chips):
+                # Overlap or out-of-slice chips: first pod keeps the chips,
+                # later claimants are flagged (fragmentation_report surfaces
+                # them; the operator or job controller resolves).
+                self.conflicts.append(pa)
+            dom.allocator.mark_used(fresh)
         return self
 
     def _domain_of_node(self, node_name: str) -> SliceDomain | None:
@@ -156,5 +179,8 @@ class ClusterState:
                 "used_chips": len(dom.allocator.used),
                 "largest_free_box": list(largest[1]) if largest else None,
                 "expired_assumptions": len(self.expired),
+                "conflicting_assignments": [
+                    f"{pa.namespace}/{pa.pod_name}" for pa in self.conflicts
+                ],
             }
         return out

@@ -1,0 +1,29 @@
+"""Acceptance workloads: the JAX jobs the scheduler places.
+
+The reference validates placement quality by running ML training inside the
+scheduled containers (Gaia PDF §IV Exp.6: MNIST on Caffe/PyTorch/TF over the
+allocated GPUs).  The TPU-native analog here is twofold:
+
+- :mod:`tputopo.workloads.collective` — a pjit/shard_map all-reduce
+  microbenchmark, the direct measurement of the north-star metric
+  (BASELINE.md: ICI all-reduce GB/s of the scheduled slice vs ideal).
+- :mod:`tputopo.workloads.model` / :mod:`tputopo.workloads.train` — a
+  Llama-style decoder-only LM with a full sharded training step (DP x TP
+  x optional SP over a `jax.sharding.Mesh`), the BASELINE.json north-star
+  workload ("4-replica Llama-3-8B JAX job onto a v5p-32").
+
+:mod:`tputopo.workloads.sharding` is the bridge between the scheduler and
+JAX: it turns a scheduled slice shape (a `Placement` from
+:mod:`tputopo.topology.slices`) into a named device mesh whose axes ride the
+ICI torus axes the slice was allocated on.
+"""
+
+from tputopo.workloads.model import ModelConfig, init_params, forward
+from tputopo.workloads.sharding import MeshPlan, build_mesh, plan_mesh
+from tputopo.workloads.train import TrainState, make_train_state, train_step
+
+__all__ = [
+    "ModelConfig", "init_params", "forward",
+    "MeshPlan", "build_mesh", "plan_mesh",
+    "TrainState", "make_train_state", "train_step",
+]

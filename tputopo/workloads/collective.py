@@ -1,0 +1,133 @@
+"""pjit/shard_map all-reduce microbenchmark — the acceptance workload.
+
+The direct measurement of the north-star metric (BASELINE.md: "ICI
+all-reduce GB/s of scheduled slice vs ideal"), and the rebuild's analog of
+Gaia's MNIST acceptance experiment (PDF §IV Exp.6).  A container scheduled
+by the extender runs this over the chips it was handed; the reported
+algorithm bandwidth is directly comparable to the scorer's prediction
+(:func:`tputopo.topology.score.predict_allreduce_gbps`) — closing the loop
+the reference left open (its bandwidth-weight table was an unresolved TODO,
+design.md:47).
+
+Conventions match NCCL-tests so numbers are recognizable:
+  algbw = payload_bytes / time          (what the user's gradient feels)
+  busbw = algbw * 2 * (n - 1) / n      (per-link wire pressure)
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+
+@dataclass(frozen=True)
+class AllReduceResult:
+    n_devices: int
+    payload_mb: float
+    time_ms: float          # median of timed iterations
+    algbw_gbps: float
+    busbw_gbps: float
+
+    def to_dict(self) -> dict:
+        return {
+            "n_devices": self.n_devices,
+            "payload_mb": round(self.payload_mb, 3),
+            "time_ms": round(self.time_ms, 4),
+            "algbw_gbps": round(self.algbw_gbps, 3),
+            "busbw_gbps": round(self.busbw_gbps, 3),
+        }
+
+
+def measure_allreduce(devices=None, payload_mb: float = 8.0,
+                      iters: int = 20, warmup: int = 3,
+                      dtype=jnp.float32) -> AllReduceResult:
+    """Time a psum all-reduce across ``devices`` (default: all local).
+
+    The payload lives sharded across devices (as a gradient would); one
+    step is a full all-reduce returning the replicated sum.  Uses a 1-D
+    mesh — on a contiguous torus slice XLA decomposes this into per-axis
+    rings itself, which is exactly the behavior the scorer models.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    mesh = Mesh(np.asarray(devices), ("all",))
+    itemsize = jnp.dtype(dtype).itemsize
+    elems = max(n, int(payload_mb * 1e6) // itemsize // n * n)
+    x = jnp.arange(elems, dtype=jnp.uint32).astype(dtype)
+    x = jax.device_put(x, NamedSharding(mesh, P("all")))
+
+    @jax.jit
+    def allreduce_sum(v):
+        # shard_map psum formulation — the collective cannot be elided.
+        f = shard_map(lambda s: jax.lax.psum(s, "all"), mesh=mesh,
+                      in_specs=P("all"), out_specs=P(None))
+        return f(v)
+
+    for _ in range(warmup):
+        allreduce_sum(x).block_until_ready()
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        allreduce_sum(x).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    t = statistics.median(times)
+    payload_bytes = elems * itemsize
+    algbw = payload_bytes / t / 1e9
+    return AllReduceResult(
+        n_devices=n,
+        payload_mb=payload_bytes / 1e6,
+        time_ms=t * 1e3,
+        algbw_gbps=algbw,
+        busbw_gbps=algbw * 2.0 * (n - 1) / n if n > 1 else algbw,
+    )
+
+
+def measure_axis_allreduce(plan, axis: str, payload_mb: float = 8.0,
+                           iters: int = 10, warmup: int = 2,
+                           dtype=jnp.float32) -> AllReduceResult:
+    """All-reduce over ONE logical axis of a MeshPlan (e.g. the dp gradient
+    ring), other axes held as independent replicas — what a DP x TP training
+    step actually does each step."""
+    mesh = plan.mesh
+    n = plan.axes.get(axis, 1)
+    itemsize = jnp.dtype(dtype).itemsize
+    total = max(plan.n_devices, int(payload_mb * 1e6) // itemsize)
+    total = total // plan.n_devices * plan.n_devices
+    x = jnp.arange(total, dtype=jnp.uint32).astype(dtype)
+    all_axes = tuple(a for a in mesh.axis_names)
+    x = jax.device_put(x, NamedSharding(mesh, P(all_axes)))
+
+    @jax.jit
+    def step(v):
+        f = shard_map(lambda s: jax.lax.psum(s, axis), mesh=mesh,
+                      in_specs=P(all_axes), out_specs=P(all_axes))
+        return f(v)
+
+    for _ in range(warmup):
+        step(x).block_until_ready()
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        step(x).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    t = statistics.median(times)
+    per_device_bytes = total // plan.n_devices * itemsize
+    payload_bytes = per_device_bytes * n  # ring payload within one axis group
+    algbw = payload_bytes / t / 1e9
+    return AllReduceResult(
+        n_devices=n, payload_mb=payload_bytes / 1e6, time_ms=t * 1e3,
+        algbw_gbps=algbw,
+        busbw_gbps=algbw * 2.0 * (n - 1) / n if n > 1 else algbw,
+    )

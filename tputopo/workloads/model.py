@@ -1,0 +1,196 @@
+"""Llama-style decoder-only LM in pure JAX — the flagship workload.
+
+The reference proves its placement wins with training jobs inside the
+scheduled containers (Gaia PDF §IV Exp.6); the BASELINE.json north star
+names "a 4-replica Llama-3-8B JAX job onto a v5p-32" as the acceptance
+workload.  This module is that workload, written TPU-first:
+
+- bfloat16 compute over float32 params: matmuls land on the MXU at its
+  native precision, the optimizer state stays exact.
+- one `lax.scan` over stacked layer params: the transformer block is traced
+  and compiled once regardless of depth — no Python-loop unrolling, O(1)
+  compile time in layers.
+- static shapes everywhere; the causal mask is built from `iota` inside the
+  traced function (no host-side materialization).
+- RMSNorm / RoPE / GQA / SwiGLU, the Llama-3 block structure.
+
+Sharding is *not* hardcoded here: the forward pass applies logical
+activation constraints via :func:`tputopo.workloads.sharding.constrain`,
+which resolves to the mesh axes chosen by the scheduler-driven mesh plan
+(or to no-ops on a single device).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from tputopo.workloads.sharding import constrain
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Llama-family hyperparameters.
+
+    ``llama3_8b()`` matches the north-star model; ``tiny()`` is the
+    CI/CPU-mesh twin (same code path, toy shapes).
+    """
+
+    vocab_size: int = 256
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    d_ff: int = 256
+    max_seq: int = 128
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def tiny(**kw) -> "ModelConfig":
+        return ModelConfig(**kw)
+
+    @staticmethod
+    def llama3_8b() -> "ModelConfig":
+        return ModelConfig(
+            vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, d_ff=14336, max_seq=8192,
+        )
+
+
+def init_params(config: ModelConfig, key: jax.Array) -> dict:
+    """Parameter pytree; per-layer tensors stacked on a leading layer axis
+    so the forward pass can `lax.scan` over depth."""
+    c = config
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def norm_init(shape):
+        return jnp.ones(shape, jnp.float32)
+
+    def dense_init(key, shape, fan_in):
+        scale = 1.0 / math.sqrt(fan_in)
+        return jax.random.normal(key, shape, jnp.float32) * scale
+
+    L, D, H, KV, Hd, F = c.n_layers, c.d_model, c.n_heads, c.n_kv_heads, c.head_dim, c.d_ff
+    ks = jax.random.split(k_layers, 7)
+    layers = {
+        "attn_norm": norm_init((L, D)),
+        "wq": dense_init(ks[0], (L, D, H * Hd), D),
+        "wk": dense_init(ks[1], (L, D, KV * Hd), D),
+        "wv": dense_init(ks[2], (L, D, KV * Hd), D),
+        "wo": dense_init(ks[3], (L, H * Hd, D), H * Hd),
+        "mlp_norm": norm_init((L, D)),
+        "w_gate": dense_init(ks[4], (L, D, F), D),
+        "w_up": dense_init(ks[5], (L, D, F), D),
+        "w_down": dense_init(ks[6], (L, F, D), F),
+    }
+    return {
+        "embed": dense_init(k_embed, (c.vocab_size, D), D),
+        "layers": layers,
+        "final_norm": norm_init((D,)),
+        "lm_head": dense_init(k_head, (D, c.vocab_size), D),
+    }
+
+
+def _rmsnorm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    dt = x.dtype
+    x32 = x.astype(jnp.float32)
+    x32 = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * weight).astype(dt)
+
+
+def _rope_tables(config: ModelConfig, seq: int) -> tuple[jax.Array, jax.Array]:
+    half = config.head_dim // 2
+    freqs = config.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(seq, dtype=jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)  # each [S, Hd/2]
+
+
+def _apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [B, S, N, Hd] -> rotated, pairing (even, odd) feature halves."""
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1).astype(dt)
+
+
+def _attention(x: jax.Array, p: dict, config: ModelConfig,
+               cos: jax.Array, sin: jax.Array) -> jax.Array:
+    c = config
+    B, S, D = x.shape
+    q = (x @ p["wq"].astype(x.dtype)).reshape(B, S, c.n_heads, c.head_dim)
+    k = (x @ p["wk"].astype(x.dtype)).reshape(B, S, c.n_kv_heads, c.head_dim)
+    v = (x @ p["wv"].astype(x.dtype)).reshape(B, S, c.n_kv_heads, c.head_dim)
+    q = _apply_rope(q, cos, sin)
+    k = _apply_rope(k, cos, sin)
+    # Expand KV groups to full head count BEFORE the TP constraint: KV heads
+    # may be fewer than the tp degree, and sharding the narrow tensor forces
+    # a full rematerialization at the repeat.
+    group = c.n_heads // c.n_kv_heads
+    if group > 1:
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    # heads are sharded over TP; batch over DP.
+    q = constrain(q, "dp", None, "tp", None)
+    k = constrain(k, "dp", None, "tp", None)
+    v = constrain(v, "dp", None, "tp", None)
+
+    scale = 1.0 / math.sqrt(c.head_dim)
+    logits = jnp.einsum("bqnh,bknh->bnqk", q, k) * scale
+    # Causal mask from iota — traced, static-shape, no host materialization.
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+    logits = jnp.where(k_pos <= q_pos, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
+    out = jnp.einsum("bnqk,bknh->bqnh", probs, v).reshape(B, S, c.n_heads * c.head_dim)
+    return out @ p["wo"].astype(x.dtype)
+
+
+def _mlp(x: jax.Array, p: dict) -> jax.Array:
+    gate = jax.nn.silu(x @ p["w_gate"].astype(x.dtype))
+    up = x @ p["w_up"].astype(x.dtype)
+    h = constrain(gate * up, "dp", None, "tp")
+    return h @ p["w_down"].astype(x.dtype)
+
+
+def forward(params: dict, tokens: jax.Array, config: ModelConfig) -> jax.Array:
+    """Token ids [B, S] -> logits [B, S, vocab] (float32).
+
+    One scan over stacked layers; activations carried in ``compute_dtype``.
+    """
+    c = config
+    S = tokens.shape[1]
+    cos, sin = _rope_tables(c, S)
+    x = params["embed"].astype(c.compute_dtype)[tokens]
+    x = constrain(x, "dp", "sp", None)
+
+    def block(x, layer):
+        h = x + constrain(
+            _attention(_rmsnorm(x, layer["attn_norm"], c.norm_eps), layer, c, cos, sin),
+            "dp", "sp", None)
+        out = h + constrain(
+            _mlp(_rmsnorm(h, layer["mlp_norm"], c.norm_eps), layer),
+            "dp", "sp", None)
+        return out, None
+
+    x, _ = jax.lax.scan(block, x, params["layers"])
+    x = _rmsnorm(x, params["final_norm"], c.norm_eps)
+    logits = x.astype(jnp.float32) @ params["lm_head"]
+    return constrain(logits, "dp", "sp", None)
+
+
+@partial(jax.jit, static_argnums=2)
+def forward_jit(params: dict, tokens: jax.Array, config: ModelConfig) -> jax.Array:
+    return forward(params, tokens, config)

@@ -1,0 +1,190 @@
+"""Scheduler → JAX bridge: turn a scheduled slice into a named device mesh.
+
+This is the seam the whole framework exists for (BASELINE.json north star):
+the extender allocates a *contiguous* slice shape (e.g. 2x2x4 on a v5p-32)
+precisely so that a `jax.sharding.Mesh` laid over those chips runs its
+collectives at line-rate ICI.  The reference leaves this to the workload
+("the ML framework inside does its own data-parallel training over the
+devices it was handed", SURVEY.md §1 L5); here the contract is explicit:
+
+- the physical mesh axes are the slice's torus axes (row-major, matching
+  `ChipTopology.chips` order and the `TPU_VISIBLE_CHIPS` device order the
+  device plugin injects);
+- the logical axes (``dp``/``sp``/``tp``) are grouped onto physical axes
+  with ``tp`` innermost, so tensor-parallel collectives — the chattiest —
+  ride single contiguous torus rings, ``dp`` outermost so data-parallel
+  gradient all-reduces span whole replica blocks.
+
+Activation sharding inside model code goes through :func:`constrain`, which
+resolves logical axis names against the *active* plan — so the same forward
+function runs unsharded on one chip (dev box), on an 8-device CPU mesh
+(CI), or DP x SP x TP on a real slice, with zero code changes.
+"""
+
+from __future__ import annotations
+
+import math
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "sp", "tp")  # data, sequence, tensor — outermost to innermost
+
+
+@dataclass
+class MeshPlan:
+    """A device mesh plus the logical-axis sizes laid over it."""
+
+    mesh: Mesh
+    axes: dict[str, int] = field(default_factory=dict)
+
+    @property
+    def n_devices(self) -> int:
+        return math.prod(self.mesh.devices.shape)
+
+    def spec(self, *names: str | None) -> P:
+        """PartitionSpec from logical names, dropping axes of size 1."""
+        return P(*(n if n is not None and self.axes.get(n, 1) > 1 else None
+                   for n in names))
+
+    def sharding(self, *names: str | None) -> NamedSharding:
+        return NamedSharding(self.mesh, self.spec(*names))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+
+_ACTIVE: MeshPlan | None = None
+
+
+@contextmanager
+def activate(plan: MeshPlan):
+    """Make ``plan`` the target of :func:`constrain` within the block."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = plan
+    try:
+        with plan.mesh:
+            yield plan
+    finally:
+        _ACTIVE = prev
+
+
+def active_plan() -> MeshPlan | None:
+    return _ACTIVE
+
+
+def constrain(x: jax.Array, *names: str | None) -> jax.Array:
+    """Logical activation-sharding constraint; no-op when no plan is active.
+
+    ``names`` has one entry per array axis (a logical axis name or None).
+    Names the active plan doesn't use (size 1) degrade to None, so model
+    code states its *intent* once and runs under any parallelism degree.
+    """
+    plan = _ACTIVE
+    if plan is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, plan.sharding(*names))
+
+
+def plan_mesh(n_devices: int, *, tp: int | None = None, sp: int | None = None,
+              heads: int | None = None) -> dict[str, int]:
+    """Choose (dp, sp, tp) sizes for ``n_devices``.
+
+    Policy: tensor parallelism up to the host boundary (4 chips on v5p — TP
+    traffic is per-token and latency-bound, keep it on the shortest rings),
+    bounded by the head count it must divide; remaining factor goes to DP;
+    SP only on explicit request (long-context runs).
+    """
+    if tp is None:
+        tp = 1
+        for cand in (4, 2):
+            if n_devices % cand == 0 and (heads is None or heads % cand == 0):
+                tp = cand
+                break
+    if n_devices % tp:
+        raise ValueError(f"tp={tp} does not divide {n_devices} devices")
+    rest = n_devices // tp
+    if sp is None:
+        sp = 1
+    if rest % sp:
+        raise ValueError(f"sp={sp} does not divide {rest} remaining devices")
+    return {"dp": rest // sp, "sp": sp, "tp": tp}
+
+
+def build_mesh(axes: dict[str, int], devices=None) -> MeshPlan:
+    """Build the Mesh for logical ``axes`` (sizes, keys from AXES).
+
+    Device order: the scheduler hands a contiguous slice whose chips appear
+    in row-major torus order (both in `ChipTopology.chips` and in the
+    `TPU_VISIBLE_CHIPS` env the device plugin injects — reporter.py), and
+    `jax.devices()` enumerates them in that same order on a TPU host.  On
+    real TPU we let `mesh_utils.create_device_mesh` optimize the assignment
+    against the physical coords; elsewhere (CPU CI) row-major reshape is
+    exact by construction.
+    """
+    if devices is None:
+        devices = jax.devices()
+    shape = tuple(axes.get(a, 1) for a in AXES)
+    if math.prod(shape) != len(devices):
+        raise ValueError(f"axes {axes} need {math.prod(shape)} devices, "
+                         f"got {len(devices)}")
+    if devices and devices[0].platform == "tpu":
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    else:
+        dev_array = np.asarray(devices).reshape(shape)
+    return MeshPlan(mesh=Mesh(dev_array, AXES), axes=dict(axes))
+
+
+def mesh_for_slice(slice_dims: tuple[int, ...], devices=None,
+                   **plan_kw) -> MeshPlan:
+    """Mesh over a scheduled slice of shape ``slice_dims`` — what a workload
+    container calls after the extender placed it (its devices *are* the
+    slice, in row-major order)."""
+    n = math.prod(slice_dims)
+    return build_mesh(plan_mesh(n, **plan_kw), devices=devices)
+
+
+# ---- parameter shardings ----------------------------------------------------
+
+def param_specs(plan: MeshPlan) -> dict:
+    """Megatron-style TP layout for the model.py parameter pytree.
+
+    Attention qkv projections and MLP up/gate split their output features
+    over ``tp`` (column parallel); wo and w_down split input features (row
+    parallel), so each block needs exactly one psum, which XLA inserts at
+    the constrained boundary.  The lm_head splits the vocab.  Stacked layer
+    tensors carry a leading (unsharded) layer axis for the scan.
+    """
+    s = plan.spec
+    return {
+        "embed": s(None, None),
+        "layers": {
+            "attn_norm": s(None, None),
+            "wq": s(None, None, "tp"),
+            "wk": s(None, None, "tp"),
+            "wv": s(None, None, "tp"),
+            "wo": s(None, "tp", None),
+            "mlp_norm": s(None, None),
+            "w_gate": s(None, None, "tp"),
+            "w_up": s(None, None, "tp"),
+            "w_down": s(None, "tp", None),
+        },
+        "final_norm": s(None),
+        "lm_head": s(None, "tp"),
+    }
+
+
+def param_shardings(plan: MeshPlan) -> dict:
+    return jax.tree.map(lambda spec: NamedSharding(plan.mesh, spec),
+                        param_specs(plan),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def batch_sharding(plan: MeshPlan) -> NamedSharding:
+    """Token batches: batch over dp, sequence over sp."""
+    return plan.sharding("dp", "sp")

@@ -1,0 +1,124 @@
+"""Full training step for the flagship LM, sharded over a MeshPlan.
+
+The complete DP x SP x TP step — forward, next-token cross-entropy, grads,
+AdamW update — compiled as ONE jitted function over the scheduler-provided
+mesh.  XLA inserts the collectives implied by the shardings (psum of row-
+parallel block outputs inside the layer, reduce-scatter/all-reduce of grads
+across ``dp``), and because the extender placed the slice contiguously they
+all ride ICI rings — that is the framework's whole value proposition
+measured end to end (BASELINE.md north star).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tputopo.workloads import sharding as shardlib
+from tputopo.workloads.model import ModelConfig, forward, init_params
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1) -> optax.GradientTransformation:
+    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay)
+
+
+def make_train_state(config: ModelConfig, key: jax.Array,
+                     lr: float = 3e-4) -> TrainState:
+    params = init_params(config, key)
+    opt = make_optimizer(lr)
+    return TrainState(params=params, opt_state=opt.init(params),
+                      step=jnp.zeros((), jnp.int32))
+
+
+def loss_fn(params: Any, tokens: jax.Array, config: ModelConfig) -> jax.Array:
+    """Next-token cross-entropy over [B, S] token ids (last position dropped)."""
+    logits = forward(params, tokens, config)  # [B, S, V] f32
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def train_step(state: TrainState, tokens: jax.Array, config: ModelConfig,
+               lr: float = 3e-4) -> tuple[TrainState, jax.Array]:
+    """One optimizer step; jit-able as-is (config/lr static via closure)."""
+    loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, config)
+    opt = make_optimizer(lr)
+    updates, opt_state = opt.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params=params, opt_state=opt_state,
+                      step=state.step + 1), loss
+
+
+def state_shardings(plan: shardlib.MeshPlan, config: ModelConfig,
+                    lr: float = 3e-4) -> TrainState:
+    """NamedSharding pytree for the full TrainState: params per the
+    Megatron-style layout, AdamW moments mirroring the params they track,
+    scalars replicated."""
+    pshard = shardlib.param_shardings(plan)
+
+    def fix(node):
+        if isinstance(node, optax.ScaleByAdamState):
+            return optax.ScaleByAdamState(
+                count=plan.replicated(), mu=pshard, nu=pshard)
+        return jax.tree.map(lambda _: plan.replicated(), node)
+
+    dummy_opt = make_optimizer(lr).init(
+        jax.eval_shape(partial(init_params, config), jax.random.key(0)))
+    opt_shard = jax.tree.map(
+        fix, dummy_opt, is_leaf=lambda n: isinstance(n, optax.ScaleByAdamState))
+    return TrainState(params=pshard, opt_state=opt_shard,
+                      step=plan.replicated())
+
+
+def make_sharded_train_step(plan: shardlib.MeshPlan, config: ModelConfig,
+                            lr: float = 3e-4):
+    """Compile train_step with explicit in/out shardings over ``plan``.
+
+    Params (and therefore AdamW moments, which mirror the param pytree)
+    shard per :func:`tputopo.workloads.sharding.param_specs`; batches shard
+    batch-over-dp, sequence-over-sp.  Donates the state buffers.
+    """
+    shardings = state_shardings(plan, config, lr)
+
+    def step_fn(state: TrainState, tokens: jax.Array):
+        with shardlib.activate(plan):
+            return train_step(state, tokens, config, lr)
+
+    return jax.jit(
+        step_fn,
+        in_shardings=(shardings, shardlib.batch_sharding(plan)),
+        out_shardings=(shardings, plan.replicated()),
+        donate_argnums=(0,),
+    )
+
+
+def make_sharded_state(plan: shardlib.MeshPlan, config: ModelConfig,
+                       key: jax.Array, lr: float = 3e-4) -> TrainState:
+    """Initialize TrainState directly into its sharded layout (jitted init
+    with explicit out_shardings, so no host-side full materialization and
+    no accidental replication of the optimizer moments)."""
+    shardings = state_shardings(plan, config, lr)
+
+    @partial(jax.jit, out_shardings=shardings)
+    def init():
+        params = init_params(config, key)
+        opt_state = make_optimizer(lr).init(params)
+        return TrainState(params=params, opt_state=opt_state,
+                          step=jnp.zeros((), jnp.int32))
+
+    with plan.mesh:
+        return init()
