@@ -367,3 +367,19 @@ def test_gangs_are_namespace_scoped():
         sched.bind(f"b-{i}", "team-b", best["Host"])
     state = ClusterState(api, clock=clock).sync()
     assert len(state.domains["slice-a"].allocator.used) == 16
+
+
+def test_state_tolerates_malformed_assume_time():
+    """A hand-written bad assume-time must not crash sync — it reads as 0
+    (long expired) and the pod's assumption simply doesn't count."""
+    clock = Clock(1000.0)
+    api, _ = build_cluster(clock=clock)
+    api.create("pods", make_pod("badtime", chips=1, node_name="node-0", annotations={
+        ko.ANN_GROUP: "0,0,0", ko.ANN_ASSUME_TIME: "not-a-number",
+        ko.ANN_ASSIGNED: "false"}))
+    # Also a pod with a bad time and NO group/node: must not break the sort.
+    api.create("pods", make_pod("unbound", chips=1, annotations={
+        ko.ANN_ASSUME_TIME: "garbage"}))
+    state = ClusterState(api, clock=clock).sync()
+    assert len(state.domains["slice-a"].allocator.used) == 0
+    assert [pa.pod_name for pa in state.expired] == ["badtime"]

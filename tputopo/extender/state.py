@@ -34,6 +34,16 @@ class PodAssignment:
     gang_id: str | None
 
 
+def _assume_time_of(pod: dict) -> float:
+    """Annotation timestamp, 0.0 when absent or malformed — a hand-written
+    bad value must never crash sync (it just reads as long-expired)."""
+    raw = pod["metadata"].get("annotations", {}).get(ko.ANN_ASSUME_TIME, "0")
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        return 0.0
+
+
 @dataclass
 class SliceDomain:
     """One ICI domain: a set of nodes sharing a torus (same slice-id)."""
@@ -45,6 +55,7 @@ class SliceDomain:
     host_by_node: dict[str, Coord] = field(default_factory=dict)
     chips_by_node: dict[str, list[Coord]] = field(default_factory=dict)
     assignments: list[PodAssignment] = field(default_factory=list)
+    conflicts: list[PodAssignment] = field(default_factory=list)
 
     def node_of_chip(self, chip: Coord) -> str | None:
         host = self.topology.host_of(chip)
@@ -103,11 +114,12 @@ class ClusterState:
             ]
 
         now = self.clock()
+        valid_chips = {sid: set(dom.topology.chips)
+                       for sid, dom in self.domains.items()}
         pods = sorted(
             self.api.list("pods"),
             key=lambda p: (
-                float(p["metadata"].get("annotations", {})
-                      .get(ko.ANN_ASSUME_TIME, "0")),
+                _assume_time_of(p),
                 p["metadata"].get("namespace", "default"),
                 p["metadata"]["name"],
             ),
@@ -119,7 +131,7 @@ class ClusterState:
             if not group or not node_name:
                 continue
             assigned = anns.get(ko.ANN_ASSIGNED) == "true"
-            assume_time = float(anns.get(ko.ANN_ASSUME_TIME, "0"))
+            assume_time = _assume_time_of(pod)
             pa = PodAssignment(
                 pod_name=pod["metadata"]["name"],
                 namespace=pod["metadata"].get("namespace", "default"),
@@ -138,7 +150,7 @@ class ClusterState:
                 self.expired.append(pa)
                 continue
             dom.assignments.append(pa)
-            valid = set(dom.topology.chips)
+            valid = valid_chips[dom.slice_id]
             fresh = [c for c in dict.fromkeys(pa.chips)
                      if c in valid and c not in dom.allocator.used]
             if len(fresh) != len(pa.chips):
@@ -146,6 +158,7 @@ class ClusterState:
                 # later claimants are flagged (fragmentation_report surfaces
                 # them; the operator or job controller resolves).
                 self.conflicts.append(pa)
+                dom.conflicts.append(pa)
             dom.allocator.mark_used(fresh)
         return self
 
@@ -180,7 +193,7 @@ class ClusterState:
                 "largest_free_box": list(largest[1]) if largest else None,
                 "expired_assumptions": len(self.expired),
                 "conflicting_assignments": [
-                    f"{pa.namespace}/{pa.pod_name}" for pa in self.conflicts
+                    f"{pa.namespace}/{pa.pod_name}" for pa in dom.conflicts
                 ],
             }
         return out

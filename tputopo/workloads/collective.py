@@ -83,7 +83,10 @@ def measure_allreduce(devices=None, payload_mb: float = 8.0,
         allreduce_sum(x).block_until_ready()
         times.append(time.perf_counter() - t0)
     t = statistics.median(times)
-    payload_bytes = elems * itemsize
+    # NCCL-tests convention: algbw = per-rank buffer bytes / time.  The
+    # global array is sharded, so the all-reduced per-rank buffer holds
+    # elems/n elements — NOT the full elems.
+    payload_bytes = elems // n * itemsize
     algbw = payload_bytes / t / 1e9
     return AllReduceResult(
         n_devices=n,
@@ -123,8 +126,8 @@ def measure_axis_allreduce(plan, axis: str, payload_mb: float = 8.0,
         step(x).block_until_ready()
         times.append(time.perf_counter() - t0)
     t = statistics.median(times)
-    per_device_bytes = total // plan.n_devices * itemsize
-    payload_bytes = per_device_bytes * n  # ring payload within one axis group
+    # Per-rank buffer within the reduced axis group (NCCL-tests algbw).
+    payload_bytes = total // plan.n_devices * itemsize
     algbw = payload_bytes / t / 1e9
     return AllReduceResult(
         n_devices=n, payload_mb=payload_bytes / 1e6, time_ms=t * 1e3,
