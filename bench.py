@@ -40,8 +40,10 @@ def bench_scheduler(repeats: int = 5) -> dict:
     from tests.cluster import build_cluster
     from tputopo.extender.config import ExtenderConfig
     from tputopo.extender.scheduler import ExtenderScheduler
+    from tputopo.extender.state import ClusterState
     from tputopo.k8s import make_pod
-    from tputopo.topology.score import score_chip_set
+    from tputopo.topology.score import predict_allreduce_gbps
+    from tputopo.topology.slices import enumerate_shapes
 
     lat_ms: list[float] = []
     quality: list[float] = []
@@ -50,6 +52,17 @@ def bench_scheduler(repeats: int = 5) -> dict:
         api, _ = build_cluster(spec="v5p:4x4x4", workers=16)
         sched = ExtenderScheduler(api, ExtenderConfig())
         nodes = [n["metadata"]["name"] for n in api.list("nodes")]
+
+        # True ideal bandwidth per request size: best box shape of volume k
+        # on the empty torus (what the scheduler itself calls ideal).
+        dom = ClusterState(api).sync().domains["slice-a"]
+        ideal_for = {
+            k: predict_allreduce_gbps(
+                dom.topology,
+                enumerate_shapes(dom.topology, k, dom.allocator.cost)[0].dims,
+                dom.allocator.cost)
+            for k in (2, 4)
+        }
 
         # Pod mix: the BASELINE configs' request sizes — singles, ICI pairs,
         # 4-chip host slices, and a 4x4-chip DP gang.
@@ -83,14 +96,13 @@ def bench_scheduler(repeats: int = 5) -> dict:
             if k > 1:
                 if not decision["contiguous"]:
                     raise SystemExit(f"bench: non-contiguous placement for {name}")
-                from tputopo.extender.state import ClusterState
-                state = ClusterState(api).sync()
-                dom = state.domains[decision["slice"]]
-                ideal = max(
-                    score_chip_set(dom.topology, frozenset(
-                        dom.topology.chips[:k]), dom.allocator.cost),
-                    decision["predicted_allreduce_gbps"])
-                quality.append(decision["predicted_allreduce_gbps"] / ideal)
+                q = decision["predicted_allreduce_gbps"] / ideal_for[k]
+                if q < 1.0:
+                    raise SystemExit(
+                        f"bench: {name} placed at {q:.2f} of ideal bandwidth "
+                        f"({decision['predicted_allreduce_gbps']} vs "
+                        f"{ideal_for[k]} GB/s)")
+                quality.append(q)
             if name.startswith("gang-"):
                 gang_chips.extend(tuple(c) for c in decision["chips"])
 

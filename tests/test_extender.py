@@ -383,3 +383,19 @@ def test_state_tolerates_malformed_assume_time():
     state = ClusterState(api, clock=clock).sync()
     assert len(state.domains["slice-a"].allocator.used) == 0
     assert [pa.pod_name for pa in state.expired] == ["badtime"]
+
+
+def test_state_nonfinite_assume_time_reads_as_expired():
+    """'nan'/'inf' assume-times must not occupy chips forever: they parse
+    as 0 (long expired) so the GC can release them."""
+    clock = Clock(1000.0)
+    api, _ = build_cluster(clock=clock)
+    for name, t in (("nanpod", "nan"), ("infpod", "inf")):
+        api.create("pods", make_pod(name, chips=1, node_name="node-0", annotations={
+            ko.ANN_GROUP: "0,0,0" if name == "nanpod" else "0,1,0",
+            ko.ANN_ASSUME_TIME: t, ko.ANN_ASSIGNED: "false"}))
+    state = ClusterState(api, clock=clock).sync()
+    assert len(state.domains["slice-a"].allocator.used) == 0
+    assert sorted(pa.pod_name for pa in state.expired) == ["infpod", "nanpod"]
+    gc = AssumptionGC(api, assume_ttl_s=60, clock=clock)
+    assert sorted(gc.sweep()) == ["default/infpod", "default/nanpod"]

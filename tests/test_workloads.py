@@ -125,3 +125,33 @@ def test_allreduce_microbench_runs():
     d = res.to_dict()
     assert set(d) == {"n_devices", "payload_mb", "time_ms", "algbw_gbps",
                       "busbw_gbps"}
+
+
+def test_validate_slice_reports_efficiency():
+    """The predicted-vs-measured loop runs end to end on the CPU mesh: the
+    report carries both numbers and a finite efficiency (absolute parity is
+    a hardware acceptance criterion, not a CPU CI one)."""
+    from tputopo.workloads.validate import validate_slice
+
+    report = validate_slice("v5e:4x2", payload_mb=0.5, iters=3)
+    d = report.to_dict()
+    assert d["predicted_gbps"] > 0
+    assert d["measured_gbps"] > 0
+    assert 0 < d["efficiency"] < 1e6
+
+
+def test_calibrate_cost_model_roundtrips():
+    """Calibration must make the model reproduce the measured number
+    exactly — the closing of the reference's open weight-table TODO."""
+    from tputopo.topology.model import parse_topology
+    from tputopo.topology.score import predict_allreduce_gbps
+    from tputopo.workloads.validate import calibrate_cost_model
+
+    topo = parse_topology("v5p:2x2x4:wrap=000")
+    measured = 123.4
+    cal = calibrate_cost_model(topo, measured)
+    assert predict_allreduce_gbps(topo, topo.dims, cal) == pytest.approx(measured)
+
+    single = parse_topology("v5p:1x1x1:wrap=000")
+    with pytest.raises(ValueError, match="no multi-chip axis"):
+        calibrate_cost_model(single, 10.0)

@@ -1,0 +1,74 @@
+"""Device-plugin CLI: probe the local host and emit what would be published.
+
+``python -m tputopo.deviceplugin`` runs the discovery shim (native
+libtputopo.so when built, pure-Python twin otherwise) and prints the node
+annotations + device list the plugin registers with the kubelet — the
+dry-run half of the bring-up flow (SURVEY.md §3.1).  Use
+``TPUTOPO_FAKE="v5p:2x2x4@0"`` on a box without TPUs.
+
+In-cluster serving wires :class:`tputopo.deviceplugin.plugin.TpuDevicePlugin`
+to the kubelet's device-plugin socket; the transport in this repo is the
+in-process :class:`tputopo.deviceplugin.api.FakeKubelet` (the image has no
+grpcio — see deviceplugin/api.py for the gRPC surface to bind).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        prog="tputopo-device-plugin",
+        description="TPU topology discovery + node-annotation dry run")
+    ap.add_argument("--node-name", default="local")
+    ap.add_argument("--slice-id", default="slice-local")
+    ap.add_argument("--native", action="store_true",
+                    help="require the native libtputopo.so probe (no fallback)")
+    ap.add_argument("--serve", action="store_true",
+                    help="keep running, re-probing device health every "
+                         "--interval seconds (in-cluster mode)")
+    ap.add_argument("--interval", type=float, default=30.0)
+    args = ap.parse_args()
+
+    from tputopo.discovery import shim
+    from tputopo.deviceplugin.reporter import node_annotations_for_probe
+
+    if args.native:
+        if shim.ensure_native_built() is None:
+            print("error: native libtputopo.so unavailable and --native given",
+                  file=sys.stderr)
+            return 2
+    probe = shim.probe_host()
+    if not probe.ok:
+        print(f"error: {probe.error}", file=sys.stderr)
+        return 1
+    out = {
+        "backend": probe.backend,
+        "node": args.node_name,
+        "annotations": node_annotations_for_probe(probe, args.slice_id),
+        "devices": [c for c in probe.chips],
+    }
+    print(json.dumps(out, indent=2))
+    if args.serve:
+        # In-cluster serving loop: re-probe on an interval so device-file
+        # disappearance surfaces as a health flip.  The kubelet gRPC leg
+        # binds through deviceplugin/api.py's transport surface; this image
+        # carries no grpcio, so the loop is the health heartbeat scaffold.
+        import time
+        while True:
+            time.sleep(args.interval)
+            fresh = shim.probe_host()
+            if not fresh.ok:
+                print(f"probe degraded: {fresh.error}", file=sys.stderr)
+            elif fresh.chips != probe.chips:
+                print(json.dumps({"event": "topology-changed",
+                                  "devices": list(fresh.chips)}))
+                probe = fresh
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,6 @@
+"""``python -m tputopo.extender`` — run the scheduler-extender HTTP server."""
+
+from tputopo.extender.server import main
+
+if __name__ == "__main__":
+    main()

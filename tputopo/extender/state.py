@@ -13,6 +13,7 @@ two-phase handshake needs (design.md:227-246; SURVEY.md §5.2).
 from __future__ import annotations
 
 import json
+import math
 import time
 from dataclasses import dataclass, field
 
@@ -36,12 +37,15 @@ class PodAssignment:
 
 def _assume_time_of(pod: dict) -> float:
     """Annotation timestamp, 0.0 when absent or malformed — a hand-written
-    bad value must never crash sync (it just reads as long-expired)."""
+    bad value must never crash sync (it just reads as long-expired).
+    Non-finite values (nan/inf) count as malformed: nan would bypass the
+    TTL comparison forever and inf would occupy chips eternally."""
     raw = pod["metadata"].get("annotations", {}).get(ko.ANN_ASSUME_TIME, "0")
     try:
-        return float(raw)
+        val = float(raw)
     except (TypeError, ValueError):
         return 0.0
+    return val if math.isfinite(val) else 0.0
 
 
 @dataclass
@@ -56,6 +60,7 @@ class SliceDomain:
     chips_by_node: dict[str, list[Coord]] = field(default_factory=dict)
     assignments: list[PodAssignment] = field(default_factory=list)
     conflicts: list[PodAssignment] = field(default_factory=list)
+    expired: list[PodAssignment] = field(default_factory=list)
 
     def node_of_chip(self, chip: Coord) -> str | None:
         host = self.topology.host_of(chip)
@@ -148,6 +153,7 @@ class ClusterState:
                 # Stale assumption: bind happened but Allocate never confirmed
                 # within the TTL — the chips are NOT occupied (SURVEY.md §5.2).
                 self.expired.append(pa)
+                dom.expired.append(pa)
                 continue
             dom.assignments.append(pa)
             valid = valid_chips[dom.slice_id]
@@ -191,7 +197,7 @@ class ClusterState:
                 "free_chips": len(dom.allocator.free),
                 "used_chips": len(dom.allocator.used),
                 "largest_free_box": list(largest[1]) if largest else None,
-                "expired_assumptions": len(self.expired),
+                "expired_assumptions": len(dom.expired),
                 "conflicting_assignments": [
                     f"{pa.namespace}/{pa.pod_name}" for pa in dom.conflicts
                 ],

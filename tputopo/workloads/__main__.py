@@ -1,0 +1,99 @@
+"""``python -m tputopo.workloads`` — in-container acceptance workload.
+
+This is what runs inside a pod the extender scheduled (the rebuild's analog
+of Gaia's MNIST acceptance containers, PDF §IV Exp.6).  Two subcommands:
+
+- ``allreduce``: measure all-reduce over the chips this container was
+  handed and compare against the cost model's prediction for the slice
+  topology in the injected env (``TPU_SLICE_TOPOLOGY`` — reporter.py).
+  Exit code 1 when efficiency falls below ``--min-efficiency``.
+- ``train``: run N sharded training steps of the flagship LM over the
+  local devices (mesh planned from the device count).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def cmd_allreduce(args) -> int:
+    from tputopo.workloads.validate import validate_slice
+
+    spec = args.topology or os.environ.get("TPU_SLICE_TOPOLOGY")
+    gen = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    if spec and ":" not in spec and gen:
+        # Allocate-injected env carries bare dims ("2x2x4"); prepend the
+        # generation from the accelerator type ("v5p-32" -> "v5p").
+        spec = f"{gen.split('-')[0]}:{spec}"
+    if not spec:
+        print("error: no --topology and no TPU_SLICE_TOPOLOGY env",
+              file=sys.stderr)
+        return 2
+    report = validate_slice(spec, payload_mb=args.payload_mb, iters=args.iters)
+    print(json.dumps(report.to_dict()))
+    if args.min_efficiency and report.efficiency < args.min_efficiency:
+        print(f"FAIL: efficiency {report.efficiency:.3f} < "
+              f"{args.min_efficiency}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_train(args) -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tputopo.workloads.model import ModelConfig
+    from tputopo.workloads.sharding import mesh_for_slice
+    from tputopo.workloads.train import make_sharded_state, make_sharded_train_step
+
+    n = jax.device_count()
+    config = ModelConfig(vocab_size=2048, d_model=256, n_layers=4, n_heads=8,
+                         n_kv_heads=4, d_ff=512, max_seq=args.seq)
+    plan = mesh_for_slice((n,), heads=config.n_heads)
+    state = make_sharded_state(plan, config, jax.random.key(0))
+    step = make_sharded_train_step(plan, config)
+    rng = np.random.default_rng(0)
+    batch = max(plan.axes["dp"], args.batch // max(1, plan.axes["dp"])
+                * plan.axes["dp"])
+    # Fixed batch: the convergence check is memorization, which must always
+    # reduce loss — fresh random batches each step need not.
+    tokens = jnp.asarray(rng.integers(0, config.vocab_size, (batch, args.seq)))
+    losses = []
+    for _ in range(args.steps):
+        state, loss = step(state, tokens)
+        losses.append(float(loss))
+    print(json.dumps({
+        "devices": n, "mesh": plan.axes, "steps": args.steps,
+        "first_loss": round(losses[0], 4), "last_loss": round(losses[-1], 4),
+    }))
+    return 0 if losses[-1] < losses[0] else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="tputopo-workload")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("allreduce", help="measure vs predicted all-reduce")
+    p.add_argument("--topology", help="slice spec, e.g. v5p:2x2x4 "
+                                      "(default: injected env)")
+    p.add_argument("--payload-mb", type=float, default=16.0)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--min-efficiency", type=float, default=0.0)
+    p.set_defaults(fn=cmd_allreduce)
+
+    p = sub.add_parser("train", help="sharded LM training steps")
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=128)
+    p.set_defaults(fn=cmd_train)
+
+    args = ap.parse_args()
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

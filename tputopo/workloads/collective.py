@@ -34,9 +34,9 @@ except ImportError:  # pragma: no cover - older jax
 @dataclass(frozen=True)
 class AllReduceResult:
     n_devices: int
-    payload_mb: float
+    payload_mb: float       # global (sharded) array size, as requested
     time_ms: float          # median of timed iterations
-    algbw_gbps: float
+    algbw_gbps: float       # per-rank buffer bytes / time (NCCL-tests algbw)
     busbw_gbps: float
 
     def to_dict(self) -> dict:
@@ -86,11 +86,10 @@ def measure_allreduce(devices=None, payload_mb: float = 8.0,
     # NCCL-tests convention: algbw = per-rank buffer bytes / time.  The
     # global array is sharded, so the all-reduced per-rank buffer holds
     # elems/n elements — NOT the full elems.
-    payload_bytes = elems // n * itemsize
-    algbw = payload_bytes / t / 1e9
+    algbw = (elems // n * itemsize) / t / 1e9
     return AllReduceResult(
         n_devices=n,
-        payload_mb=payload_bytes / 1e6,
+        payload_mb=elems * itemsize / 1e6,
         time_ms=t * 1e3,
         algbw_gbps=algbw,
         busbw_gbps=algbw * 2.0 * (n - 1) / n if n > 1 else algbw,
@@ -127,10 +126,9 @@ def measure_axis_allreduce(plan, axis: str, payload_mb: float = 8.0,
         times.append(time.perf_counter() - t0)
     t = statistics.median(times)
     # Per-rank buffer within the reduced axis group (NCCL-tests algbw).
-    payload_bytes = total // plan.n_devices * itemsize
-    algbw = payload_bytes / t / 1e9
+    algbw = (total // plan.n_devices * itemsize) / t / 1e9
     return AllReduceResult(
-        n_devices=n, payload_mb=payload_bytes / 1e6, time_ms=t * 1e3,
+        n_devices=n, payload_mb=total * itemsize / 1e6, time_ms=t * 1e3,
         algbw_gbps=algbw,
         busbw_gbps=algbw * 2.0 * (n - 1) / n if n > 1 else algbw,
     )

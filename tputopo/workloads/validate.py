@@ -1,0 +1,93 @@
+"""Predicted-vs-measured all-reduce validation and cost-model calibration.
+
+The reference never closed its own loop: the design left the link
+bandwidth-weight table as an unresolved TODO ("带宽权值", design.md:47), so
+its scores were rank-orderings with no physical unit.  This module closes
+it for the TPU rebuild (SURVEY.md §7 "honest bandwidth model"):
+
+- :func:`validate_slice` runs the real psum microbenchmark
+  (:mod:`tputopo.workloads.collective`) over the devices a scheduled slice
+  handed to this container and compares the measured algorithm bandwidth
+  against :func:`tputopo.topology.score.predict_allreduce_gbps` for the
+  slice shape — the BASELINE.md acceptance number ("scheduled slice vs
+  ideal").
+- :func:`calibrate_cost_model` backs a per-link GB/s out of a measured
+  all-reduce so deployments can replace the public-spec defaults in
+  :mod:`tputopo.topology.generations` with measured reality (via
+  ExtenderConfig's cost-table override).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+from tputopo.topology.cost import LinkCostModel
+from tputopo.topology.model import ChipTopology, parse_topology
+from tputopo.topology.score import predict_allreduce_gbps
+from tputopo.workloads.collective import AllReduceResult, measure_allreduce
+
+
+@dataclass(frozen=True)
+class ValidationReport:
+    topology: str
+    predicted_gbps: float
+    measured: AllReduceResult
+
+    @property
+    def measured_gbps(self) -> float:
+        return self.measured.algbw_gbps
+
+    @property
+    def efficiency(self) -> float:
+        """measured / predicted — 1.0 means the model is honest; the
+        BASELINE acceptance wants the *scheduled* slice to match the ideal
+        directly-requested slice, i.e. equal efficiency on both."""
+        return self.measured_gbps / self.predicted_gbps if self.predicted_gbps else 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "topology": self.topology,
+            "predicted_gbps": round(self.predicted_gbps, 3),
+            "measured_gbps": round(self.measured_gbps, 3),
+            "efficiency": round(self.efficiency, 4),
+            **{f"measured_{k}": v for k, v in self.measured.to_dict().items()},
+        }
+
+
+def validate_slice(topo: ChipTopology | str, devices=None,
+                   payload_mb: float = 16.0, iters: int = 10) -> ValidationReport:
+    """Measure the all-reduce of the local devices (the slice a scheduled
+    container was handed) and compare with the model's prediction for the
+    slice shape.  ``topo`` is the slice topology — on a scheduled pod,
+    parse it from the injected ``TPU_SLICE_TOPOLOGY``/``TPU_ACCELERATOR_TYPE``
+    env (reporter.py)."""
+    if isinstance(topo, str):
+        topo = parse_topology(topo)
+    cost = LinkCostModel.for_generation(topo.generation.name)
+    predicted = predict_allreduce_gbps(topo, topo.dims, cost)
+    measured = measure_allreduce(devices=devices, payload_mb=payload_mb,
+                                 iters=iters)
+    return ValidationReport(
+        topology=topo.describe(),
+        predicted_gbps=predicted,
+        measured=measured,
+    )
+
+
+def calibrate_cost_model(topo: ChipTopology, measured_algbw_gbps: float) -> LinkCostModel:
+    """Back out the per-link GB/s that makes the model reproduce a measured
+    all-reduce exactly, keeping the rest of the cost table.
+
+    The box model is linear in ``ici_link_gbps``
+    (:func:`predict_allreduce_gbps` sums per-axis ring terms scaled by it),
+    so calibration is one division.  Feed the result into ExtenderConfig's
+    cost override to schedule with measured numbers — the fix for the
+    reference's unresolved weight-table TODO (design.md:47).
+    """
+    base = LinkCostModel.for_generation(topo.generation.name)
+    unit = predict_allreduce_gbps(topo, topo.dims, base) / base.ici_link_gbps
+    if unit <= 0:
+        raise ValueError(
+            f"topology {topo.describe()} has no multi-chip axis to calibrate on")
+    return dataclasses.replace(base, ici_link_gbps=measured_algbw_gbps / unit)
