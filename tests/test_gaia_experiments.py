@@ -1,0 +1,124 @@
+"""Gaia-paper experiment analogs (reference PDF §IV, Tables I/III/IV):
+determinism-by-repetition from staged occupancy fixtures.
+
+The reference's evaluation ran each allocation 500x and asserted the choice
+distribution — ties may split, but invalid choices must be 0 (SURVEY.md §4).
+On the torus the policies are deterministic by construction, so the
+repetition check asserts a single-outcome distribution; the staged fixtures
+mirror the paper's hand-drawn occupancy states (PDF Fig. 7-9) translated to
+ICI geometry.
+"""
+
+from collections import Counter
+
+from tputopo.topology.model import parse_topology
+from tputopo.topology.slices import Allocator
+
+REPS = 500
+
+
+def staged_allocator(spec: str, used: list[tuple]) -> Allocator:
+    alloc = Allocator(parse_topology(spec))
+    if used:
+        alloc.mark_used(used)
+    return alloc
+
+
+def test_exp1_single_chip_lands_on_lowest_impact_chip():
+    """Exp.1 analog (Table I): on a partially used host, every 1-chip
+    request must land on a chip adjacent to the used block (Singular,
+    Gaia Alg. 3) — never on a chip that splits the free region."""
+    # v5e 4x2 host: left column pair used.
+    used = [(0, 0), (0, 1)]
+    outcomes = Counter()
+    for _ in range(REPS):
+        alloc = staged_allocator("v5e:4x2:wrap=00", used)
+        p = alloc.find(1)
+        outcomes[p.chips[0]] += 1
+    # (1,0)/(1,1) touch the used block (1 free neighbor after packing);
+    # picking (2,*) or (3,*) would strand fragments: must never happen.
+    assert sum(outcomes[c] for c in [(1, 0), (1, 1)]) == REPS, outcomes
+    invalid = [c for c in outcomes if c[0] >= 2]
+    assert not invalid, f"invalid anti-fragmentation choices: {invalid}"
+
+
+def test_exp1_two_chip_request_takes_adjacent_pair():
+    """Exp.1 analog (Table I, 2-GPU case): 500/500 on an ICI-adjacent pair."""
+    outcomes = Counter()
+    for _ in range(REPS):
+        alloc = staged_allocator("v5p:2x2x4:wrap=000", [])
+        p = alloc.find(2)
+        topo = alloc.topo
+        outcomes[topo.hop_distance(p.chips[0], p.chips[1])] += 1
+    assert outcomes == {1: REPS}
+
+
+def test_exp3_singular_preserves_tight_pair():
+    """Exp.3 analog (Table III): from the paper's Fig. 8(a)-style state —
+    one lone free chip next to a used block plus an untouched tight pair
+    region — the 1-chip request takes the lone chip 500/500, never breaking
+    the free pair (the stock scheduler's cheapest-index pick would)."""
+    # v5e 4x2: chips (0,0),(0,1),(1,0) used -> (1,1) is the lone fragment;
+    # columns 2-3 are an intact 2x2 block.
+    used = [(0, 0), (0, 1), (1, 0)]
+    outcomes = Counter()
+    for _ in range(REPS):
+        alloc = staged_allocator("v5e:4x2:wrap=00", used)
+        outcomes[alloc.find(1).chips[0]] += 1
+    assert outcomes == {(1, 1): REPS}, outcomes
+
+
+def test_exp4_link_takes_the_true_adjacent_pair():
+    """Exp.4 analog (Table IV): with scattered singles used, the 2-chip
+    request must take a free ICI-adjacent pair 500/500 — never a pair of
+    scattered leftovers."""
+    # v5p host 2x2x2: use (0,0,0) and (1,1,1) (opposite corners) — the free
+    # set still contains adjacent pairs.
+    used = [(0, 0, 0), (1, 1, 1)]
+    outcomes = Counter()
+    for _ in range(REPS):
+        alloc = staged_allocator("v5p:2x2x2:wrap=000", used)
+        p = alloc.find(2)
+        a, b = p.chips
+        outcomes[alloc.topo.hop_distance(a, b)] += 1
+    assert outcomes == {1: REPS}
+
+
+def test_exp4_fragmented_fallback_is_still_connected():
+    """When no box fits, the blob fallback must produce a *connected* set
+    (invalid = disconnected choices must be 0 across repetitions)."""
+    # v5e 4x2 with a wall of used chips leaving an L-shaped free region of 3.
+    used = [(0, 1), (1, 1), (2, 1), (3, 1), (0, 0)]
+    for _ in range(100):
+        alloc = staged_allocator("v5e:4x2:wrap=00", used)
+        p = alloc.find(3)
+        assert p is not None
+        chips = set(p.chips)
+        # connectivity check
+        seen = {next(iter(chips))}
+        frontier = list(seen)
+        while frontier:
+            c = frontier.pop()
+            for nb in alloc.topo.neighbors(c):
+                if nb in chips and nb not in seen:
+                    seen.add(nb)
+                    frontier.append(nb)
+        assert seen == chips, f"disconnected blob {sorted(chips)}"
+
+
+def test_exp5_latency_overhead_vs_naive_count_scheduler():
+    """Exp.5 analog (Fig. 10): the reference pays +0.2-1.0 s for topology
+    awareness on a ~2.5 s base.  Here the topology-aware decision must cost
+    < 50 ms per allocation on a 256-chip torus — orders of magnitude inside
+    the reference's overhead envelope."""
+    import time
+
+    alloc = staged_allocator("v5e:16x16", [])
+    t0 = time.perf_counter()
+    n = 0
+    for _ in range(16):
+        p = alloc.allocate(4)
+        assert p is not None
+        n += 1
+    per_alloc_ms = (time.perf_counter() - t0) * 1e3 / n
+    assert per_alloc_ms < 50.0, f"{per_alloc_ms:.1f} ms per allocation"

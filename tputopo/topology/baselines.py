@@ -1,0 +1,45 @@
+"""Baseline (topology-blind) allocation policies, for A/B comparison.
+
+The reference proves its value by A/B against the stock kube-scheduler
+(Gaia PDF §IV Exp.5/6: the default scheduler picks by count only, landing
+jobs on scattered devices; Fig. 11 contrasts a scattered vs link-local
+placement).  ``naive_pick`` reproduces that behavior for a TPU node: take
+the k lowest-indexed free chips, ignoring geometry — exactly what a
+count-only extended-resource scheduler plus the kubelet's arbitrary
+device pick does.  Used by tests and bench to quantify the bandwidth and
+fragmentation delta of topology awareness.
+"""
+
+from __future__ import annotations
+
+from tputopo.topology.model import ChipTopology, Coord
+
+
+def naive_pick(topo: ChipTopology, free: frozenset[Coord], k: int) -> tuple[Coord, ...] | None:
+    """First-fit: the k lowest row-major-indexed free chips (count-only)."""
+    if len(free) < k:
+        return None
+    ordered = sorted(free, key=topo.index)
+    return tuple(ordered[:k])
+
+
+class NaiveAllocator:
+    """Count-only bookkeeping twin of :class:`tputopo.topology.slices.Allocator`."""
+
+    def __init__(self, topo: ChipTopology):
+        self.topo = topo
+        self._used: set[Coord] = set()
+
+    @property
+    def free(self) -> frozenset[Coord]:
+        return frozenset(c for c in self.topo.chips if c not in self._used)
+
+    def allocate(self, k: int) -> tuple[Coord, ...] | None:
+        picked = naive_pick(self.topo, self.free, k)
+        if picked is not None:
+            self._used.update(picked)
+        return picked
+
+    def release(self, chips) -> None:
+        for c in chips:
+            self._used.discard(tuple(c))
