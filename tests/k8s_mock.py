@@ -1,0 +1,143 @@
+"""A stdlib HTTP mock of the Kubernetes API-server routes the framework
+uses, backed by a FakeApiServer — the REST twin of the in-memory double.
+
+Serves just enough of the core v1 API for KubeApiClient: node/pod CRUD,
+merge-patch of metadata (with resourceVersion CAS and null-deletes), the
+pods/{name}/binding subresource, and cluster-wide pod lists.  404/409
+status codes carry the NotFound/Conflict semantics the client maps back.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tputopo.k8s.fakeapi import Conflict, FakeApiServer, NotFound
+
+_POD = re.compile(r"^/api/v1/namespaces/([^/]+)/pods/([^/]+)$")
+_POD_BIND = re.compile(r"^/api/v1/namespaces/([^/]+)/pods/([^/]+)/binding$")
+_PODS_NS = re.compile(r"^/api/v1/namespaces/([^/]+)/pods$")
+_NODE = re.compile(r"^/api/v1/nodes/([^/]+)$")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    api: FakeApiServer
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _send(self, code: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        n = int(self.headers.get("Content-Length", "0"))
+        return json.loads(self.rfile.read(n)) if n else {}
+
+    def _dispatch(self) -> None:
+        try:
+            self._route()
+        except NotFound as e:
+            self._send(404, {"kind": "Status", "code": 404, "message": str(e)})
+        except Conflict as e:
+            self._send(409, {"kind": "Status", "code": 409, "message": str(e)})
+
+    def _route(self) -> None:
+        api, path, method = self.api, self.path, self.command
+        if m := _POD_BIND.match(path):
+            ns, name = m.groups()
+            body = self._body()
+            self._send(201, api.bind_pod(name, body["target"]["name"], ns))
+        elif m := _POD.match(path):
+            ns, name = m.groups()
+            if method == "GET":
+                self._send(200, api.get("pods", name, ns))
+            elif method == "DELETE":
+                api.delete("pods", name, ns)
+                self._send(200, {"kind": "Status", "status": "Success"})
+            elif method == "PATCH":
+                self._send(200, self._merge_patch("pods", name, ns))
+            else:
+                self._send(405, {"message": method})
+        elif m := _PODS_NS.match(path):
+            ns = m.group(1)
+            if method == "POST":
+                obj = self._body()
+                obj.setdefault("metadata", {}).setdefault("namespace", ns)
+                obj.setdefault("spec", {})
+                obj.setdefault("status", {})
+                self._send(201, api.create("pods", obj))
+            else:
+                items = api.list(
+                    "pods",
+                    lambda p: p["metadata"].get("namespace", "default") == ns)
+                self._send(200, {"kind": "PodList", "items": items})
+        elif path == "/api/v1/pods":
+            self._send(200, {"kind": "PodList", "items": api.list("pods")})
+        elif m := _NODE.match(path):
+            name = m.group(1)
+            if method == "GET":
+                self._send(200, api.get("nodes", name))
+            elif method == "PATCH":
+                self._send(200, self._merge_patch("nodes", name, None))
+            elif method == "DELETE":
+                api.delete("nodes", name)
+                self._send(200, {"kind": "Status", "status": "Success"})
+            else:
+                self._send(405, {"message": method})
+        elif path == "/api/v1/nodes":
+            if method == "POST":
+                self._send(201, api.create("nodes", self._body()))
+            else:
+                self._send(200, {"kind": "NodeList", "items": api.list("nodes")})
+        else:
+            self._send(404, {"kind": "Status", "code": 404,
+                             "message": f"unknown path {path}"})
+
+    def _merge_patch(self, kind: str, name: str, ns: str | None) -> dict:
+        body = self._body()
+        md = body.get("metadata", {})
+        expect = md.get("resourceVersion")
+        out = None
+        if "annotations" in md:
+            out = self.api.patch_annotations(
+                kind, name, md["annotations"], namespace=ns,
+                expect_version=expect)
+        if "labels" in md:
+            out = self.api.patch_labels(kind, name, md["labels"], namespace=ns)
+        if out is None:
+            out = self.api.get(kind, name, ns)
+        return out
+
+    do_GET = do_POST = do_PATCH = do_DELETE = _dispatch
+
+
+class MockKubeApi:
+    """Owns the HTTP server; use as a context manager in tests."""
+
+    def __init__(self, api: FakeApiServer | None = None):
+        self.api = api or FakeApiServer()
+        handler = type("Handler", (_Handler,), {"api": self.api})
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+
+    @property
+    def base_url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def __enter__(self) -> "MockKubeApi":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._thread.join(timeout=5)

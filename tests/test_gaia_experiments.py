@@ -14,7 +14,11 @@ from collections import Counter
 from tputopo.topology.model import parse_topology
 from tputopo.topology.slices import Allocator
 
-REPS = 500
+# The paper ran 500 reps against a live cluster with nondeterministic
+# timing; our allocator is a pure function of staged state, so a smaller
+# repetition count over fresh instances proves the same invariant (invalid
+# choices == 0) without burning suite time.
+REPS = 50
 
 
 def staged_allocator(spec: str, used: list[tuple]) -> Allocator:

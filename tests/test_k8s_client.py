@@ -1,0 +1,94 @@
+"""KubeApiClient against the REST mock: the extender/device-plugin flows
+must run unchanged over real API-server wire semantics (merge-patch with
+resourceVersion CAS, binding subresource, 404/409 mapping)."""
+
+import pytest
+
+from tests.cluster import build_cluster
+from tests.k8s_mock import MockKubeApi
+from tputopo.extender import AssumptionGC, ExtenderConfig, ExtenderScheduler
+from tputopo.k8s import make_pod
+from tputopo.k8s import objects as ko
+from tputopo.k8s.client import KubeApiClient
+from tputopo.k8s.fakeapi import Conflict, NotFound
+
+
+def make_env():
+    api, _ = build_cluster()
+    mock = MockKubeApi(api)
+    return mock
+
+
+def test_crud_roundtrip_over_rest():
+    with make_env() as mock:
+        client = KubeApiClient(base_url=mock.base_url)
+        nodes = client.list("nodes")
+        assert [n["metadata"]["name"] for n in nodes] == [
+            "node-0", "node-1", "node-2", "node-3"]
+        client.create("pods", make_pod("p1", chips=2))
+        pod = client.get("pods", "p1", "default")
+        assert pod["spec"]["resources"] if "resources" in pod["spec"] else True
+        assert len(client.list("pods")) == 1
+        client.delete("pods", "p1", "default")
+        with pytest.raises(NotFound):
+            client.get("pods", "p1", "default")
+
+
+def test_merge_patch_cas_and_null_delete():
+    with make_env() as mock:
+        client = KubeApiClient(base_url=mock.base_url)
+        client.create("pods", make_pod("p1", chips=1))
+        pod = client.get("pods", "p1", "default")
+        rv = pod["metadata"]["resourceVersion"]
+        out = client.patch_annotations("pods", "p1", {"a": "1"}, "default",
+                                       expect_version=rv)
+        assert out["metadata"]["annotations"]["a"] == "1"
+        # Stale version -> Conflict (the handshake's race signal).
+        with pytest.raises(Conflict):
+            client.patch_annotations("pods", "p1", {"a": "2"}, "default",
+                                     expect_version=rv)
+        # Null deletes the key.
+        client.patch_annotations("pods", "p1", {"a": None}, "default")
+        assert "a" not in client.get("pods", "p1", "default")["metadata"].get(
+            "annotations", {})
+
+
+def test_full_scheduling_flow_over_rest():
+    """sort -> bind -> annotations -> GC, all through the REST client."""
+    with make_env() as mock:
+        client = KubeApiClient(base_url=mock.base_url)
+        sched = ExtenderScheduler(client, ExtenderConfig())
+        client.create("pods", make_pod("train", chips=4))
+        pod = client.get("pods", "train", "default")
+        nodes = [n["metadata"]["name"] for n in client.list("nodes")]
+        scores = sched.sort(pod, nodes)
+        best = max(scores, key=lambda s: (s["Score"], s["Host"]))
+        assert best["Score"] > 0
+        decision = sched.bind("train", "default", best["Host"])
+        assert decision["contiguous"]
+
+        fresh = client.get("pods", "train", "default")
+        anns = fresh["metadata"]["annotations"]
+        assert anns[ko.ANN_ASSIGNED] == "false"
+        assert fresh["spec"]["nodeName"] == best["Host"]
+
+        # Binding again -> Conflict via REST 409.
+        with pytest.raises(Conflict):
+            client.bind_pod("train", best["Host"], "default")
+
+        # GC over REST: expire the assumption by forcing an old time.
+        client.patch_annotations("pods", "train", {ko.ANN_ASSUME_TIME: "1"},
+                                 "default")
+        gc = AssumptionGC(client, assume_ttl_s=60)
+        assert gc.sweep() == ["default/train"]
+        anns = client.get("pods", "train", "default")["metadata"].get(
+            "annotations", {})
+        assert ko.ANN_GROUP not in anns
+
+
+def test_labels_patch_over_rest():
+    with make_env() as mock:
+        client = KubeApiClient(base_url=mock.base_url)
+        client.patch_labels("nodes", "node-0", {"tpu.dev/generation": "v5p"})
+        node = client.get("nodes", "node-0")
+        assert node["metadata"]["labels"]["tpu.dev/generation"] == "v5p"

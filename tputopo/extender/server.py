@@ -74,22 +74,31 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, json.JSONDecodeError) as e:
             self.scheduler.metrics.inc("bad_requests")
             self._send_json(400, {"error": str(e)})
+        except Exception as e:  # API-server unreachable, etc. — fail closed
+            # with a response, not a dropped socket (a real KubeApiClient
+            # raises URLError/RuntimeError the in-memory fake never did).
+            self.scheduler.metrics.inc("api_errors")
+            self._send_json(503, {"error": f"{type(e).__name__}: {e}"})
 
     def do_GET(self) -> None:
-        if self.path == "/healthz":
-            self._send_text(200, "ok\n")
-        elif self.path == "/metrics":
-            self._send_text(200, self._render_metrics())
-        elif self.path == "/state":
-            state = self.scheduler._state()
-            self._send_json(200, {
-                "fragmentation": state.fragmentation_report(),
-                "decisions": self.scheduler.decisions[-20:],
-            })
-        elif self.path == "/policy":
-            self._send_json(200, self.config.policy_json())
-        else:
-            self._send_json(404, {"error": f"unknown path {self.path}"})
+        try:
+            if self.path == "/healthz":
+                self._send_text(200, "ok\n")
+            elif self.path == "/metrics":
+                self._send_text(200, self._render_metrics())
+            elif self.path == "/state":
+                state = self.scheduler._state()
+                self._send_json(200, {
+                    "fragmentation": state.fragmentation_report(),
+                    "decisions": self.scheduler.decisions[-20:],
+                })
+            elif self.path == "/policy":
+                self._send_json(200, self.config.policy_json())
+            else:
+                self._send_json(404, {"error": f"unknown path {self.path}"})
+        except Exception as e:
+            self.scheduler.metrics.inc("api_errors")
+            self._send_json(503, {"error": f"{type(e).__name__}: {e}"})
 
     def _handle_sort(self) -> None:
         req = self._read_json()
@@ -160,21 +169,33 @@ class ExtenderHTTPServer:
 
 def main() -> None:  # pragma: no cover - thin CLI wrapper
     import argparse
-
-    from tputopo.k8s.fakeapi import FakeApiServer
+    import os
 
     ap = argparse.ArgumentParser(description="tputopo scheduler extender")
     ap.add_argument("--config", help="path to ExtenderConfig JSON")
     ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--api-url", default=None,
+                    help="API server base URL (default: in-cluster when "
+                         "KUBERNETES_SERVICE_HOST is set, else in-memory fake)")
+    ap.add_argument("--host", default="0.0.0.0",
+                    help="listen address (kube-scheduler calls from outside "
+                         "this pod; default all interfaces)")
     args = ap.parse_args()
     config = ExtenderConfig.load(args.config) if args.config else ExtenderConfig()
     if args.port is not None:
         config.port = args.port
-    # Standalone mode serves against an empty in-memory API (for smoke tests
-    # and /policy generation); in-cluster deployments wire a real API client.
-    api_server = FakeApiServer()
+    if args.api_url or os.environ.get("KUBERNETES_SERVICE_HOST"):
+        from tputopo.k8s.client import KubeApiClient
+
+        api_server = KubeApiClient(base_url=args.api_url)
+    else:
+        # Standalone smoke mode: empty in-memory API (for /policy generation
+        # and local poking).
+        from tputopo.k8s.fakeapi import FakeApiServer
+
+        api_server = FakeApiServer()
     scheduler = ExtenderScheduler(api_server, config)
-    server = ExtenderHTTPServer(scheduler, config)
+    server = ExtenderHTTPServer(scheduler, config, host=args.host)
 
     from tputopo.extender.gc import AssumptionGC
 

@@ -1,0 +1,151 @@
+"""Real Kubernetes API-server client (stdlib-only REST).
+
+Drop-in for :class:`tputopo.k8s.fakeapi.FakeApiServer` — same method
+surface (create/get/list/delete/patch_annotations/patch_labels/bind_pod,
+NotFound/Conflict semantics) — so the extender, device plugin, and GC run
+unchanged against a live cluster.  The durable-state story is exactly the
+reference's (SURVEY.md §5.4): everything lives in object metadata on the
+API server; this client is a transport, not a cache.
+
+In-cluster wiring follows the standard conventions: service-account bearer
+token + CA bundle from /var/run/secrets/kubernetes.io/serviceaccount, API
+host from KUBERNETES_SERVICE_HOST/PORT.  Tests point ``base_url`` at a
+plain-HTTP mock (tests/k8s_mock.py).
+
+Optimistic concurrency: ``patch_annotations(expect_version=...)`` embeds
+metadata.resourceVersion in the merge patch — the API server rejects a
+stale version with 409, which surfaces as :class:`Conflict`, the same
+signal the two-phase ASSUME/ASSIGNED handshake consumes in-memory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import urllib.error
+import urllib.request
+from typing import Callable
+
+from tputopo.k8s.fakeapi import Conflict, NotFound
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeApiClient:
+    def __init__(self, base_url: str | None = None, token: str | None = None,
+                 ca_path: str | None = None, timeout_s: float = 10.0) -> None:
+        if base_url is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            base_url = f"https://{host}:{port}"
+        self.base_url = base_url.rstrip("/")
+        if token is None:
+            token_path = os.path.join(_SA_DIR, "token")
+            if os.path.exists(token_path):
+                with open(token_path) as f:
+                    token = f.read().strip()
+        self.token = token
+        self.timeout_s = timeout_s
+        self._ctx: ssl.SSLContext | None = None
+        if self.base_url.startswith("https"):
+            ca = ca_path or os.path.join(_SA_DIR, "ca.crt")
+            self._ctx = ssl.create_default_context(
+                cafile=ca if os.path.exists(ca) else None)
+
+    # ---- transport ---------------------------------------------------------
+
+    def _request(self, method: str, path: str, body: dict | None = None,
+                 content_type: str = "application/json") -> dict:
+        url = self.base_url + path
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s,
+                                        context=self._ctx) as resp:
+                raw = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:500]
+            if e.code == 404:
+                raise NotFound(f"{method} {path}: {detail}") from None
+            if e.code == 409:
+                raise Conflict(f"{method} {path}: {detail}") from None
+            raise RuntimeError(f"{method} {path} -> {e.code}: {detail}") from None
+        return json.loads(raw) if raw else {}
+
+    @staticmethod
+    def _collection(kind: str, namespace: str | None) -> str:
+        if kind == "nodes":
+            return "/api/v1/nodes"
+        if kind == "pods":
+            if namespace is None:
+                return "/api/v1/pods"  # cluster-wide list
+            return f"/api/v1/namespaces/{namespace}/pods"
+        raise ValueError(f"unsupported kind {kind!r}")
+
+    def _object_path(self, kind: str, name: str, namespace: str | None) -> str:
+        if kind == "nodes":
+            return f"/api/v1/nodes/{name}"
+        if kind == "pods":
+            ns = namespace or "default"
+            return f"/api/v1/namespaces/{ns}/pods/{name}"
+        raise ValueError(f"unsupported kind {kind!r}")
+
+    # ---- FakeApiServer-compatible surface ----------------------------------
+
+    def create(self, kind: str, obj: dict) -> dict:
+        md = obj["metadata"]
+        ns = md.get("namespace") if kind == "pods" else None
+        if kind == "pods":
+            ns = ns or "default"
+        return self._request("POST", self._collection(kind, ns), obj)
+
+    def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
+        return self._request("GET", self._object_path(kind, name, namespace))
+
+    def list(self, kind: str, selector: Callable[[dict], bool] | None = None) -> list[dict]:
+        out = self._request("GET", self._collection(kind, None)).get("items", [])
+        # K8s list items omit kind/apiVersion; metadata is intact, which is
+        # all the framework's selectors and consumers read.
+        if selector:
+            out = [o for o in out if selector(o)]
+        return sorted(out, key=lambda o: (o["metadata"].get("namespace", ""),
+                                          o["metadata"]["name"]))
+
+    def delete(self, kind: str, name: str, namespace: str | None = None) -> None:
+        self._request("DELETE", self._object_path(kind, name, namespace))
+
+    def patch_annotations(self, kind: str, name: str, patch: dict[str, str | None],
+                          namespace: str | None = None,
+                          expect_version: str | None = None) -> dict:
+        body: dict = {"metadata": {"annotations": {
+            k: (None if v is None else str(v)) for k, v in patch.items()}}}
+        if expect_version is not None:
+            body["metadata"]["resourceVersion"] = expect_version
+        return self._request(
+            "PATCH", self._object_path(kind, name, namespace), body,
+            content_type="application/merge-patch+json")
+
+    def patch_labels(self, kind: str, name: str, patch: dict[str, str | None],
+                     namespace: str | None = None) -> dict:
+        body = {"metadata": {"labels": {
+            k: (None if v is None else str(v)) for k, v in patch.items()}}}
+        return self._request(
+            "PATCH", self._object_path(kind, name, namespace), body,
+            content_type="application/merge-patch+json")
+
+    def bind_pod(self, name: str, node_name: str, namespace: str | None = None) -> dict:
+        ns = namespace or "default"
+        binding = {
+            "apiVersion": "v1",
+            "kind": "Binding",
+            "metadata": {"name": name, "namespace": ns},
+            "target": {"apiVersion": "v1", "kind": "Node", "name": node_name},
+        }
+        return self._request(
+            "POST", f"/api/v1/namespaces/{ns}/pods/{name}/binding", binding)
