@@ -27,7 +27,7 @@ def test_crud_roundtrip_over_rest():
             "node-0", "node-1", "node-2", "node-3"]
         client.create("pods", make_pod("p1", chips=2))
         pod = client.get("pods", "p1", "default")
-        assert pod["spec"]["resources"] if "resources" in pod["spec"] else True
+        assert ko.pod_requested_chips(pod) == 2  # spec survived the round-trip
         assert len(client.list("pods")) == 1
         client.delete("pods", "p1", "default")
         with pytest.raises(NotFound):

@@ -24,6 +24,11 @@ class ExtenderConfig:
     port: int = 32743  # same port the reference chose (design.md:98)
     assume_ttl_s: float = 60.0  # stale-assumption GC horizon (§5.2)
     resource_name: str = RESOURCE_CHIPS
+    # Reuse the synced cluster state for `sort` scoring for this many
+    # seconds (0 = always fresh).  Against a real API server every sync is
+    # two cluster-wide LISTs; a sub-second cache bounds that load.  `bind`
+    # always re-syncs — placement decisions never run on stale occupancy.
+    state_cache_s: float = 0.0
     # Per-generation LinkCostModel field overrides, e.g.
     # {"v5p": {"ici_link_gbps": 95.0, "dcn_host_gbps": 42.0}} — the explicit,
     # measured replacement for the reference's TODO weight table.

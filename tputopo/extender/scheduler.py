@@ -86,14 +86,24 @@ class ExtenderScheduler:
         self.clock = clock
         self.metrics = Metrics()
         self.decisions: list[dict] = []  # recent decision records (observability)
+        self._cached_state: ClusterState | None = None
+        self._cached_at: float = 0.0
 
-    def _state(self) -> ClusterState:
-        return ClusterState(
+    def _state(self, allow_cache: bool = False) -> ClusterState:
+        ttl = self.config.state_cache_s
+        if (allow_cache and ttl > 0 and self._cached_state is not None
+                and self.clock() - self._cached_at < ttl):
+            self.metrics.inc("state_cache_hits")
+            return self._cached_state
+        state = ClusterState(
             self.api,
             cost_for_generation=self.config.cost_model,
             assume_ttl_s=self.config.assume_ttl_s,
             clock=self.clock,
         ).sync()
+        self._cached_state = state
+        self._cached_at = self.clock()
+        return state
 
     # ---- sort (Prioritize) -------------------------------------------------
 
@@ -105,7 +115,7 @@ class ExtenderScheduler:
         """
         t0 = time.perf_counter()
         self.metrics.inc("sort_requests")
-        state = self._state()
+        state = self._state(allow_cache=True)
         k = ko.pod_requested_chips(pod)
         gang = _gang_of(pod)
         gang_ctx = None

@@ -204,7 +204,12 @@ def main() -> None:  # pragma: no cover - thin CLI wrapper
 
     def gc_loop() -> None:
         while not stop.wait(max(1.0, config.assume_ttl_s / 2)):
-            released = gc.sweep()
+            try:
+                released = gc.sweep()
+            except Exception as e:  # API blip must not kill the GC thread —
+                # a dead sweeper strands expired reservations forever.
+                print(f"gc: sweep failed ({type(e).__name__}: {e}); retrying")
+                continue
             if released:
                 print(f"gc: released stale assumptions for {released}")
 

@@ -1,0 +1,178 @@
+"""Blockwise (flash) causal attention as a Pallas TPU kernel.
+
+The flagship workload's hot op.  The einsum attention in model.py
+materializes the full [B, N, S, S] score matrix in HBM — O(S^2) memory
+traffic.  This kernel streams K/V blocks through VMEM with the standard
+online-softmax recurrence, keeping the working set at
+O(block_q x block_kv), so long sequences stay HBM-bandwidth-friendly and
+the matmuls stay MXU-shaped (block sizes default to 128, the MXU tile).
+
+Grid: (batch*heads, q_blocks, kv_blocks), sequential on TPU; the running
+max/denominator/accumulator live in VMEM scratch that persists across the
+kv_block steps of one q_block (initialized at kv==0, flushed at the last
+kv step).  Causal blocks above the diagonal are predicated off entirely
+(`@pl.when`), halving the work.
+
+Used by model.forward when ``ModelConfig.attn_impl`` resolves to flash
+(auto: TPU platform + divisible shapes); tests run the same kernel in
+Pallas interpret mode on CPU against the einsum reference.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                  *, scale: float, causal: bool, n_kv: int):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = (ik <= iq) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale          # (bq, H)
+        k = k_ref[0].astype(jnp.float32)                  # (bkv, H)
+        v = v_ref[0].astype(jnp.float32)                  # (bkv, H)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bq, bkv)
+        if causal:
+            bq = q_ref.shape[1]
+            bkv = k_ref.shape[1]
+            q_pos = iq * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bkv), 0)
+            k_pos = ik * bkv + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bkv), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_prev = m_ref[:, :1]                             # (bq, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                            # (bq, bkv)
+        alpha = jnp.exp(m_prev - m_new)                   # (bq, 1)
+        l_ref[:, :1] = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:, :1] = m_new
+
+    @pl.when(ik == n_kv - 1)
+    def _flush():
+        o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_kv",
+                                             "interpret"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True, block_q: int = 128,
+                    block_kv: int = 128, interpret: bool = False) -> jax.Array:
+    """q/k/v: [B, S, N, H] (same head count — expand GQA groups first, as
+    model.py does).  Returns [B, S, N, H] in q's dtype.
+
+    Differentiable: the forward pass is the Pallas kernel; the backward
+    pass rematerializes attention through the einsum reference (nothing
+    O(S^2) is saved between passes — the S^2 scores exist only transiently
+    inside whichever pass is running).  A dedicated Pallas backward kernel
+    is a further optimization, not a correctness need.
+    """
+    return _flash_vjp(q, k, v, causal, block_q, block_kv, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_vjp(q, k, v, causal, block_q, block_kv, interpret):
+    return _flash_forward(q, k, v, causal=causal, block_q=block_q,
+                          block_kv=block_kv, interpret=interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_kv, interpret):
+    out = _flash_forward(q, k, v, causal=causal, block_q=block_q,
+                         block_kv=block_kv, interpret=interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, block_q, block_kv, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: reference_attention(a, b, c,
+                                                         causal=causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   causal: bool, block_q: int, block_kv: int,
+                   interpret: bool) -> jax.Array:
+    B, S, N, H = q.shape
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
+    block_q = min(block_q, S)
+    block_kv = min(block_kv, S)
+    if S % block_q or S % block_kv:
+        raise ValueError(f"seq len {S} not divisible by blocks "
+                         f"({block_q}, {block_kv})")
+    if causal and block_q != block_kv:
+        raise ValueError("causal path requires block_q == block_kv")
+    scale = 1.0 / (H ** 0.5)
+
+    # [B, S, N, H] -> [B*N, S, H]: one grid row per (batch, head).
+    def to_heads(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * N, S, H)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    n_q = S // block_q
+    n_kv = S // block_kv
+
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale, causal=causal,
+                          n_kv=n_kv),
+        grid=(B * N, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, H), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, block_kv, H), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((1, block_kv, H), lambda b, iq, ik: (b, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, H), lambda b, iq, ik: (b, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * N, S, H), q.dtype),
+        scratch_shapes=[
+            pltpu_vmem((block_q, 128), jnp.float32),  # running max (col 0)
+            pltpu_vmem((block_q, 128), jnp.float32),  # running denom (col 0)
+            pltpu_vmem((block_q, H), jnp.float32),    # accumulator
+        ],
+        interpret=interpret,
+    )(qh, kh, vh)
+    return out.reshape(B, N, S, H).transpose(0, 2, 1, 3)
+
+
+def pltpu_vmem(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, dtype)
+
+
+def reference_attention(q, k, v, *, causal: bool = True) -> jax.Array:
+    """Einsum reference (the model.py path), for kernel verification."""
+    B, S, N, H = q.shape
+    scale = 1.0 / (H ** 0.5)
+    logits = jnp.einsum("bqnh,bknh->bnqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+        logits = jnp.where(k_pos <= q_pos, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bnqk,bknh->bqnh", probs,
+                      v.astype(jnp.float32)).astype(q.dtype)

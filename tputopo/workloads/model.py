@@ -22,6 +22,7 @@ which resolves to the mesh axes chosen by the scheduler-driven mesh plan
 
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass
 from functools import partial
@@ -50,6 +51,9 @@ class ModelConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     compute_dtype: jnp.dtype = jnp.bfloat16
+    # "auto": Pallas flash kernel on TPU when shapes allow, einsum elsewhere.
+    # "flash" forces the kernel (interpret mode off-TPU); "einsum" disables.
+    attn_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -147,15 +151,65 @@ def _attention(x: jax.Array, p: dict, config: ModelConfig,
     k = constrain(k, "dp", None, "tp", None)
     v = constrain(v, "dp", None, "tp", None)
 
-    scale = 1.0 / math.sqrt(c.head_dim)
-    logits = jnp.einsum("bqnh,bknh->bnqk", q, k) * scale
-    # Causal mask from iota — traced, static-shape, no host materialization.
-    q_pos = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
-    k_pos = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
-    logits = jnp.where(k_pos <= q_pos, logits, jnp.finfo(logits.dtype).min)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
-    out = jnp.einsum("bnqk,bknh->bqnh", probs, v).reshape(B, S, c.n_heads * c.head_dim)
+    if _use_flash(c, S):
+        out = _flash_dispatch(q, k, v)
+    else:
+        scale = 1.0 / math.sqrt(c.head_dim)
+        logits = jnp.einsum("bqnh,bknh->bnqk", q, k) * scale
+        # Causal mask from iota — traced, static-shape, no host materialization.
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+        logits = jnp.where(k_pos <= q_pos, logits, jnp.finfo(logits.dtype).min)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
+        out = jnp.einsum("bnqk,bknh->bqnh", probs, v)
+    out = out.reshape(B, S, c.n_heads * c.head_dim)
     return out @ p["wo"].astype(x.dtype)
+
+
+def _use_flash(c: ModelConfig, seq: int) -> bool:
+    if c.attn_impl == "einsum":
+        return False
+    block = min(128, seq)
+    # Block must divide seq AND be sublane-aligned (8 for f32 scratch);
+    # without the alignment term, any seq <= 128 trivially divides itself
+    # and odd lengths would reach the kernel.
+    shapes_ok = seq >= 16 and seq % block == 0 and block % 8 == 0
+    if c.attn_impl == "flash":
+        if not shapes_ok:
+            raise ValueError(
+                f"attn_impl=flash needs seq >= 16, divisible by {block}, "
+                f"block 8-aligned; got seq={seq}")
+        return True
+    if c.attn_impl != "auto":
+        raise ValueError(f"unknown attn_impl {c.attn_impl!r}")
+    # auto is conservative: full MXU-shaped 128 blocks only, on TPU.
+    return block == 128 and shapes_ok and jax.default_backend() == "tpu"
+
+
+def _flash_dispatch(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Run the Pallas kernel, shard_map'ed over the active mesh plan so the
+    per-device call sees only its local (batch, head) shard.  Off-TPU the
+    kernel runs in interpret mode (test path only — "auto" never picks
+    flash on CPU)."""
+    from tputopo.workloads import sharding as shardlib
+    from tputopo.workloads.attention import flash_attention
+
+    interpret = jax.default_backend() != "tpu"
+    seq = q.shape[1]
+    block = min(128, seq)
+    kernel = functools.partial(flash_attention, causal=True, block_q=block,
+                               block_kv=block, interpret=interpret)
+    plan = shardlib.active_plan()
+    if plan is None or all(plan.axes.get(a, 1) == 1 for a in ("dp", "tp")):
+        return kernel(q, k, v)
+    spec = plan.spec("dp", None, "tp", None)
+    from jax import shard_map  # jax >= 0.8 (check_vma kwarg)
+
+    # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
+    # annotation; the kernel is purely local per (dp, tp) shard.
+    return shard_map(lambda a, b, c_: kernel(a, b, c_), mesh=plan.mesh,
+                     in_specs=(spec, spec, spec), out_specs=spec,
+                     check_vma=False)(q, k, v)
 
 
 def _mlp(x: jax.Array, p: dict) -> jax.Array:
