@@ -1,0 +1,113 @@
+"""Concurrency + statelessness: the two structural guarantees the
+reference's design leans on (SURVEY.md §5.2/§5.4).
+
+- Concurrent binds through the threaded HTTP server must never
+  double-book a chip: bind re-syncs occupancy and the API server's
+  bind/CAS semantics serialize the losers into clean errors.
+- A restarted extender must rebuild the identical world from annotations
+  alone (checkpoint-by-statelessness: no private files, SURVEY.md §5.4).
+"""
+
+import json
+import threading
+import urllib.request
+
+from tests.cluster import build_cluster
+from tputopo.extender import ClusterState, ExtenderConfig, ExtenderScheduler
+from tputopo.extender.server import ExtenderHTTPServer
+from tputopo.k8s import make_pod
+from tputopo.k8s import objects as ko
+
+
+def _post(base, path, obj):
+    req = urllib.request.Request(base + path, json.dumps(obj).encode(),
+                                 {"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req).read())
+
+
+def test_concurrent_binds_never_double_book():
+    api, _ = build_cluster()  # v5p 2x2x4, 4 nodes x 4 chips
+    sched = ExtenderScheduler(api, ExtenderConfig())
+    srv = ExtenderHTTPServer(sched, port=0).start()
+    try:
+        host, port = srv.address
+        base = f"http://{host}:{port}"
+        prefix = sched.config.url_prefix
+        # 8 pods x 2 chips = exactly the slice capacity; all bind to the
+        # same node name concurrently — losers must fail cleanly, and the
+        # retries (to other nodes) must never overlap chips.
+        for i in range(8):
+            api.create("pods", make_pod(f"c-{i}", chips=2))
+        errors, lock = [], threading.Lock()
+
+        def bind(i, node):
+            r = _post(base, f"{prefix}/bind",
+                      {"PodName": f"c-{i}", "PodNamespace": "default",
+                       "Node": node})
+            if r["Error"]:
+                with lock:
+                    errors.append((i, r["Error"]))
+
+        threads = [threading.Thread(target=bind, args=(i, "node-0"))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # node-0 has 4 chips -> at most 2 two-chip pods fit; the rest error.
+        bound = [p for p in api.list("pods") if p["spec"].get("nodeName")]
+        groups = [p["metadata"]["annotations"][ko.ANN_GROUP] for p in bound]
+        chips = [c for g in groups for c in g.split(";")]
+        assert len(chips) == len(set(chips)), f"double-booked: {groups}"
+        assert len(bound) <= 2
+        assert len(bound) + len(errors) == 8
+        # Retry losers across remaining nodes sequentially: all must fit.
+        for i, _ in errors:
+            for node in ("node-1", "node-2", "node-3"):
+                r = _post(base, f"{prefix}/bind",
+                          {"PodName": f"c-{i}", "PodNamespace": "default",
+                           "Node": node})
+                if not r["Error"]:
+                    break
+        bound = [p for p in api.list("pods") if p["spec"].get("nodeName")]
+        chips = [c for p in bound
+                 for c in p["metadata"]["annotations"][ko.ANN_GROUP].split(";")]
+        assert len(chips) == len(set(chips))
+        assert len(bound) == 8
+        assert len(chips) == 16  # slice fully, disjointly packed
+    finally:
+        srv.stop()
+
+
+def test_restarted_extender_rebuilds_identical_state():
+    api, _ = build_cluster()
+    sched = ExtenderScheduler(api, ExtenderConfig())
+    for i, k in enumerate([1, 2, 4]):
+        api.create("pods", make_pod(f"p-{i}", chips=k))
+        pod = api.get("pods", f"p-{i}", "default")
+        scores = sched.sort(pod, [n["metadata"]["name"]
+                                  for n in api.list("nodes")])
+        best = max(scores, key=lambda s: (s["Score"], s["Host"]))
+        sched.bind(f"p-{i}", "default", best["Host"])
+
+    def snapshot(state: ClusterState):
+        dom = state.domains["slice-a"]
+        return (sorted(dom.allocator.used),
+                sorted((pa.pod_name, tuple(sorted(map(tuple, pa.chips))))
+                       for pa in dom.assignments))
+
+    before = snapshot(sched._state())
+    # "Restart": a brand-new scheduler over the same API server must see
+    # the identical world — no private state carried over.
+    fresh = ExtenderScheduler(api, ExtenderConfig())
+    after = snapshot(fresh._state())
+    assert before == after
+    # And it can continue scheduling correctly from the rebuilt state.
+    api.create("pods", make_pod("post-restart", chips=4))
+    pod = api.get("pods", "post-restart", "default")
+    scores = fresh.sort(pod, [n["metadata"]["name"]
+                              for n in api.list("nodes")])
+    best = max(scores, key=lambda s: (s["Score"], s["Host"]))
+    decision = fresh.bind("post-restart", "default", best["Host"])
+    used_before = set(c for _, chips in before[1] for c in chips)
+    assert not used_before & {tuple(c) for c in decision["chips"]}

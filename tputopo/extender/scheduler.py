@@ -22,6 +22,7 @@ TPU-native departures from the reference, per SURVEY.md §5/§7:
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -88,6 +89,11 @@ class ExtenderScheduler:
         self.decisions: list[dict] = []  # recent decision records (observability)
         self._cached_state: ClusterState | None = None
         self._cached_at: float = 0.0
+        # bind's sync -> select -> patch sequence is not atomic; the HTTP
+        # server is threaded, so serialize binds process-wide.  (The
+        # kube-scheduler also serializes binds per cycle — this is defense
+        # in depth for direct API users and a future multi-verb world.)
+        self._bind_lock = threading.Lock()
 
     def _state(self, allow_cache: bool = False) -> ClusterState:
         ttl = self.config.state_cache_s
@@ -257,6 +263,10 @@ class ExtenderScheduler:
     def bind(self, pod_name: str, namespace: str, node_name: str) -> dict:
         """The bind verb (design.md:119, 223-234): re-run selection on the
         winning node, stamp the assignment handshake, bind the pod."""
+        with self._bind_lock:
+            return self._bind_locked(pod_name, namespace, node_name)
+
+    def _bind_locked(self, pod_name: str, namespace: str, node_name: str) -> dict:
         t0 = time.perf_counter()
         self.metrics.inc("bind_requests")
         try:
