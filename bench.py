@@ -118,6 +118,18 @@ def bench_scheduler(repeats: int = 5) -> dict:
     }
 
 
+def bench_ab_gain() -> float:
+    """Mean predicted-bandwidth advantage of topology-aware placement over
+    count-only first-fit across randomized churn traces (the Gaia Exp.6
+    analog in model units; see tests/test_ab_study.py)."""
+    import statistics as stats
+
+    from tests.test_ab_study import run_trace
+
+    traces = [run_trace(seed) for seed in range(3)]
+    return round(stats.mean(t["bw_smart"] / t["bw_naive"] for t in traces), 2)
+
+
 def bench_workload_step() -> dict | None:
     """Forward-step wall time of the flagship LM on the local accelerator
     (one real TPU chip under the driver; CPU elsewhere).  Context only."""
@@ -174,6 +186,7 @@ def main() -> None:
             "pods_scheduled": sched["pods_scheduled"],
             "cluster": "fake v5p-128 (4x4x4 chips, 16 hosts)",
             "placement_quality_vs_ideal": sched["quality_vs_ideal"],
+            "bandwidth_gain_vs_count_only": bench_ab_gain(),
             "workload_fwd": workload,
         },
     }

@@ -399,3 +399,51 @@ def test_state_nonfinite_assume_time_reads_as_expired():
     assert sorted(pa.pod_name for pa in state.expired) == ["infpod", "nanpod"]
     gc = AssumptionGC(api, assume_ttl_s=60, clock=clock)
     assert sorted(gc.sweep()) == ["default/infpod", "default/nanpod"]
+
+
+def test_generation_quota_pinning():
+    """Gaia heterogeneous-quota analog: a pod pinning tpu.dev/generation
+    must only score/bind on nodes of that generation (mixed v5p + v5e
+    cluster)."""
+    clock = Clock(1000.0)
+    api, _ = build_cluster(spec="v5p:2x2x4", workers=4, slice_id="slice-p",
+                           clock=clock)
+    api, _ = build_cluster(spec="v5e:4x4", workers=2, slice_id="slice-e",
+                           api=api, clock=clock, node_prefix="enode")
+    sched = make_scheduler(api, clock=clock)
+
+    api.create("pods", make_pod("pinned", chips=2,
+                                labels={ko.ANN_GENERATION_LABEL: "v5e"}))
+    pod = api.get("pods", "pinned", "default")
+    scores = {s["Host"]: s["Score"] for s in sched.sort(pod, all_nodes(api))}
+    assert all(scores[n] == 0 for n in scores if n.startswith("node-"))
+    assert any(scores[n] > 0 for n in scores if n.startswith("enode-"))
+
+    with pytest.raises(BindError, match="quota classing"):
+        sched.bind("pinned", "default", "node-0")
+    decision = sched.bind("pinned", "default", "enode-0")
+    assert decision["slice"] == "slice-e"
+
+    # Unpinned pods still use both pools.
+    api.create("pods", make_pod("free", chips=2))
+    free_scores = {s["Host"]: s["Score"]
+                   for s in sched.sort(api.get("pods", "free", "default"),
+                                       all_nodes(api))}
+    assert any(free_scores[n] > 0 for n in free_scores if n.startswith("node-"))
+
+
+def test_gang_generation_pinning():
+    clock = Clock(1000.0)
+    api, _ = build_cluster(spec="v5p:2x2x4", workers=4, slice_id="slice-p",
+                           clock=clock)
+    api, _ = build_cluster(spec="v5e:4x4", workers=2, slice_id="slice-e",
+                           api=api, clock=clock, node_prefix="enode")
+    sched = make_scheduler(api, clock=clock)
+    for i in range(2):
+        p = gang_pod(f"g-{i}", "pinned-gang", 2, 4)
+        p["metadata"]["labels"][ko.ANN_GENERATION_LABEL] = "v5e"
+        api.create("pods", p)
+    pod = api.get("pods", "g-0", "default")
+    scores = {s["Host"]: s["Score"] for s in sched.sort(pod, all_nodes(api))}
+    assert all(scores[n] == 0 for n in scores if n.startswith("node-"))
+    assert any(scores[n] > 0 for n in scores if n.startswith("enode-"))

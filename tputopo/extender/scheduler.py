@@ -61,6 +61,17 @@ class Metrics:
         return xs[len(xs) // 2] if xs else None
 
 
+def _wanted_generation(pod: dict) -> str | None:
+    """Pod-requested TPU generation (label or annotation tpu.dev/generation)
+    — the Gaia heterogeneous-quota rule (PDF §III.A): one workload never
+    receives mixed accelerator types.  Single-pod requests can't mix by
+    construction (one node = one generation); this gate lets a pod *pin* a
+    generation so it never lands on the wrong pool at all."""
+    md = pod.get("metadata", {})
+    meta = {**md.get("annotations", {}), **md.get("labels", {})}
+    return meta.get(ko.ANN_GENERATION_LABEL)
+
+
 def _gang_of(pod: dict) -> tuple[str, str, int] | None:
     """(namespace, gang_id, size) — gang identity is namespace-scoped so
     same-named gangs in different namespaces never merge."""
@@ -124,15 +135,16 @@ class ExtenderScheduler:
         state = self._state(allow_cache=True)
         k = ko.pod_requested_chips(pod)
         gang = _gang_of(pod)
+        wanted_gen = _wanted_generation(pod)
         gang_ctx = None
         if k > 0 and gang is not None:
             # One plan per sort request — the plan depends only on state and
             # the gang, never on the candidate node being scored.
-            gang_ctx = self._gang_context(state, gang, k)
+            gang_ctx = self._gang_context(state, gang, k, wanted_gen)
         out = []
         for name in node_names:
             score = 0
-            if k > 0:
+            if k > 0 and self._generation_ok(state, name, wanted_gen):
                 if gang is not None:
                     score = self._score_gang_node(gang_ctx, name)
                 else:
@@ -140,6 +152,13 @@ class ExtenderScheduler:
             out.append({"Host": name, "Score": score})
         self.metrics.observe_ms("sort", (time.perf_counter() - t0) * 1e3)
         return out
+
+    def _generation_ok(self, state: ClusterState, node_name: str,
+                       wanted: str | None) -> bool:
+        if wanted is None:
+            return True
+        dom = state.domain_of_node(node_name)
+        return dom is not None and dom.topology.generation.name == wanted
 
     def _score_node(self, state: ClusterState, k: int, node_name: str) -> int:
         dom = state.domain_of_node(node_name)
@@ -218,7 +237,8 @@ class ExtenderScheduler:
         return {dom.node_by_host[h]: candidate[h] for h in hosts.chips}
 
     def _gang_context(self, state: ClusterState, gang: tuple[str, str, int],
-                      k: int) -> tuple[SliceDomain | None, dict[str, Placement] | None]:
+                      k: int, wanted_gen: str | None = None,
+                      ) -> tuple[SliceDomain | None, dict[str, Placement] | None]:
         """Remaining-member plan for a gang, given already-bound members."""
         namespace, gang_id, size = gang
         members = self._gang_members(namespace, gang_id)
@@ -239,6 +259,9 @@ class ExtenderScheduler:
         exclude = {p["spec"]["nodeName"] for p in bound}
         search = ([state.domains[next(iter(dom_ids))]] if dom_ids
                   else list(state.domains.values()))
+        if wanted_gen is not None:
+            search = [d for d in search
+                      if d.topology.generation.name == wanted_gen]
         for dom in search:
             plan = self._plan_gang(state, dom, remaining, k, exclude)
             if plan is not None:
@@ -283,12 +306,19 @@ class ExtenderScheduler:
         if dom is None:
             self.metrics.inc("bind_errors")
             raise BindError(f"node {node_name} is not part of any TPU slice")
+        wanted_gen = _wanted_generation(pod)
+        if wanted_gen and dom.topology.generation.name != wanted_gen:
+            self.metrics.inc("bind_errors")
+            raise BindError(
+                f"pod pins generation {wanted_gen!r} but node {node_name} "
+                f"is {dom.topology.generation.name} (quota classing)")
 
         gang = _gang_of(pod)
         gang_id = None
         if gang is not None:
             gang_id = gang[1]
-            plan_dom, plan = self._gang_context(state, gang, k)
+            plan_dom, plan = self._gang_context(state, gang, k,
+                                                _wanted_generation(pod))
             if plan is None:
                 self.metrics.inc("bind_gang_infeasible")
                 raise BindError(
