@@ -55,6 +55,14 @@ def cmd_train(args) -> int:
                          n_kv_heads=4, d_ff=512, max_seq=args.seq)
     plan = mesh_for_slice((n,), heads=config.n_heads)
     state = make_sharded_state(plan, config, jax.random.key(0))
+    resumed_from = None
+    if args.ckpt_dir:
+        from tputopo.workloads import checkpoint as ckptlib
+
+        restored = ckptlib.restore(args.ckpt_dir, state)
+        if restored is not None:
+            state = restored
+            resumed_from = int(state.step)
     step = make_sharded_train_step(plan, config)
     rng = np.random.default_rng(0)
     batch = max(plan.axes["dp"], args.batch // max(1, plan.axes["dp"])
@@ -63,14 +71,23 @@ def cmd_train(args) -> int:
     # reduce loss — fresh random batches each step need not.
     tokens = jnp.asarray(rng.integers(0, config.vocab_size, (batch, args.seq)))
     losses = []
-    for _ in range(args.steps):
+    for i in range(args.steps):
         state, loss = step(state, tokens)
         losses.append(float(loss))
+        if args.ckpt_dir and args.save_every and (i + 1) % args.save_every == 0:
+            from tputopo.workloads import checkpoint as ckptlib
+
+            ckptlib.save(args.ckpt_dir, state)
+    if args.ckpt_dir:
+        from tputopo.workloads import checkpoint as ckptlib
+
+        ckptlib.save(args.ckpt_dir, state)
     print(json.dumps({
         "devices": n, "mesh": plan.axes, "steps": args.steps,
+        "resumed_from": resumed_from, "final_step": int(state.step),
         "first_loss": round(losses[0], 4), "last_loss": round(losses[-1], 4),
     }))
-    return 0 if losses[-1] < losses[0] else 1
+    return 0 if losses[-1] < losses[0] or resumed_from else 1
 
 
 def main() -> int:
@@ -89,6 +106,10 @@ def main() -> int:
     p.add_argument("--steps", type=int, default=5)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--ckpt-dir", default=None,
+                   help="orbax checkpoint dir: resume if present, save at end "
+                        "(and every --save-every steps)")
+    p.add_argument("--save-every", type=int, default=0)
     p.set_defaults(fn=cmd_train)
 
     args = ap.parse_args()

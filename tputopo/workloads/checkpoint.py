@@ -1,0 +1,65 @@
+"""Sharding-aware checkpoint/resume for the training workload (orbax).
+
+The scheduler side needs no checkpointing — its durable state lives in
+K8s object metadata (the reference's statelessness posture, SURVEY.md
+§5.4).  The *workload* side does: a gang member preempted by the TTL GC
+or a node failure must resume training rather than restart (the
+elastic-recovery expectation a placement framework's users have).
+
+Orbax handles the sharded TrainState natively: each host saves only its
+addressable shards, and restore redistributes onto the current MeshPlan
+— which may be a *different* slice than the one that saved, because the
+extender may re-place the gang elsewhere on the torus.  That re-place-
+and-resume flow is exactly what the two-phase handshake + GC enable.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import jax
+import orbax.checkpoint as ocp
+
+from tputopo.workloads.train import TrainState
+
+
+def save(ckpt_dir: str | Path, state: TrainState) -> int:
+    """Write one step's checkpoint; returns the step number saved."""
+    step = int(state.step)
+    path = Path(ckpt_dir).absolute() / f"step_{step}"
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state)
+    return step
+
+
+def latest_step(ckpt_dir: str | Path) -> int | None:
+    root = Path(ckpt_dir)
+    if not root.is_dir():
+        return None
+    steps = []
+    for p in root.iterdir():
+        if p.name.startswith("step_"):
+            try:
+                steps.append(int(p.name[len("step_"):]))
+            except ValueError:
+                continue
+    return max(steps) if steps else None
+
+
+def restore(ckpt_dir: str | Path, target: TrainState,
+            step: int | None = None) -> TrainState | None:
+    """Restore the latest (or given) step into ``target``'s sharded layout.
+
+    ``target`` supplies structure AND shardings (an abstract or concrete
+    TrainState built on the *current* mesh), so a checkpoint written on a
+    different slice lands correctly redistributed.  Returns None when the
+    directory holds no checkpoint (fresh start).
+    """
+    if step is None:
+        step = latest_step(ckpt_dir)
+    if step is None:
+        return None
+    path = Path(ckpt_dir).absolute() / f"step_{step}"
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(path, abstract)
