@@ -139,14 +139,38 @@ def _attention(x: jax.Array, p: dict, config: ModelConfig,
     v = (x @ p["wv"].astype(x.dtype)).reshape(B, S, c.n_kv_heads, c.head_dim)
     q = _apply_rope(q, cos, sin)
     k = _apply_rope(k, cos, sin)
+    group = c.n_heads // c.n_kv_heads
+
+    ring_plan = _ring_plan(c, q.shape)
+    if ring_plan is not None:
+        # Context parallelism: sequence stays sharded over sp; K/V chunks
+        # rotate the ring (ppermute over ICI neighbors) instead of being
+        # all-gathered — peak memory O(S / n_sp).  Rotate the NARROW GQA
+        # K/V (group-x less ICI traffic) when tp divides the KV heads;
+        # otherwise expand first for a shardable head axis.
+        from tputopo.workloads.ring import ring_attention
+
+        tp = ring_plan.axes.get("tp", 1)
+        kv_group = group
+        if group > 1 and c.n_kv_heads % tp != 0:
+            k = jnp.repeat(k, group, axis=2)
+            v = jnp.repeat(v, group, axis=2)
+            kv_group = 1
+        q = constrain(q, "dp", "sp", "tp", None)
+        k = constrain(k, "dp", "sp", "tp", None)
+        v = constrain(v, "dp", "sp", "tp", None)
+        out = ring_attention(q, k, v, ring_plan, causal=True,
+                             kv_group=kv_group)
+        out = out.reshape(B, S, c.n_heads * c.head_dim)
+        return out @ p["wo"].astype(x.dtype)
+
     # Expand KV groups to full head count BEFORE the TP constraint: KV heads
     # may be fewer than the tp degree, and sharding the narrow tensor forces
     # a full rematerialization at the repeat.
-    group = c.n_heads // c.n_kv_heads
     if group > 1:
         k = jnp.repeat(k, group, axis=2)
         v = jnp.repeat(v, group, axis=2)
-    # heads are sharded over TP; batch over DP.
+    # heads are sharded over TP; batch over DP (sequence gathered).
     q = constrain(q, "dp", None, "tp", None)
     k = constrain(k, "dp", None, "tp", None)
     v = constrain(v, "dp", None, "tp", None)
@@ -164,6 +188,24 @@ def _attention(x: jax.Array, p: dict, config: ModelConfig,
         out = jnp.einsum("bnqk,bknh->bqnh", probs, v)
     out = out.reshape(B, S, c.n_heads * c.head_dim)
     return out @ p["wo"].astype(x.dtype)
+
+
+def _ring_plan(c: ModelConfig, qshape: tuple[int, ...]):
+    """The active plan when ring (context-parallel) attention applies:
+    attn_impl auto, sp > 1, local shapes divide evenly.  Forced "flash" /
+    "einsum" keep their documented behavior and never reroute here."""
+    if c.attn_impl != "auto":
+        return None
+    from tputopo.workloads.sharding import active_plan
+
+    plan = active_plan()
+    if plan is None or plan.axes.get("sp", 1) <= 1:
+        return None
+    B, S, N, _ = qshape
+    if (S % plan.axes.get("sp", 1) or B % plan.axes.get("dp", 1)
+            or N % plan.axes.get("tp", 1)):
+        return None
+    return plan
 
 
 def _use_flash(c: ModelConfig, seq: int) -> bool:

@@ -1,0 +1,107 @@
+"""Ring attention — context parallelism over the ``sp`` mesh axis.
+
+Long-context support for the flagship workload: with the sequence sharded
+across devices, naive attention all-gathers K/V (peak memory O(S) per
+device).  Ring attention instead rotates K/V chunks around the ``sp``
+ring with `ppermute` — exactly one chunk resident per device per step —
+merging partial results with the same online-softmax recurrence the flash
+kernel uses.  Peak memory drops to O(S / n_sp) while the math stays
+bit-equivalent to full attention.
+
+This is why the scheduler's placement invariant matters: `ppermute` over
+a contiguous slice's mesh axis rides physical ICI neighbor links
+(jax.sharding lays logical axes onto torus axes — sharding.py), so each
+rotation step is a single-hop transfer.  A scattered placement would turn
+every step into multi-hop or DCN traffic.
+
+GQA: K/V may arrive with fewer heads than Q (``kv_group`` > 1) — the
+narrow tensors are what rotates (group-x less ICI traffic per step);
+heads are expanded transiently at compute time.  Causality is handled by
+global-position masking from each chunk's ring offset.  The rotation
+runs ``lax.scan`` with the last rotation elided (n-1 transfers for n
+chunks), and is reverse-differentiable.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from jax import shard_map
+
+NEG_INF = -1e30
+
+
+def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                         axis_name: str, axis_size: int,
+                         causal: bool = True, kv_group: int = 1) -> jax.Array:
+    """Per-device body (call under shard_map): q [B, Sc, N, H], k/v
+    [B, Sc, N/kv_group, H] local chunks; returns local [B, Sc, N, H]
+    attention output as if computed over the full global sequence."""
+    B, Sc, N, H = q.shape
+    scale = 1.0 / (H ** 0.5)
+    my = jax.lax.axis_index(axis_name)
+    qf = q.astype(jnp.float32) * scale
+    q_pos = my * Sc + jax.lax.broadcasted_iota(jnp.int32, (Sc, Sc), 0)
+
+    def accumulate(carry, j, kc, vc):
+        m, l, acc = carry
+        kcf = kc.astype(jnp.float32)
+        vcf = vc.astype(jnp.float32)
+        if kv_group > 1:
+            kcf = jnp.repeat(kcf, kv_group, axis=2)
+            vcf = jnp.repeat(vcf, kv_group, axis=2)
+        src = (my - j) % axis_size  # ring position this chunk came from
+        s = jnp.einsum("bqnh,bknh->bnqk", qf, kcf)
+        if causal:
+            k_pos = src * Sc + jax.lax.broadcasted_iota(jnp.int32, (Sc, Sc), 1)
+            s = jnp.where((k_pos <= q_pos)[None, None, :, :], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # alpha is [B, N, Sc, 1]; acc is [B, Sc, N, H] — align axes.
+        acc = (acc * jnp.moveaxis(alpha, 1, 2) +
+               jnp.einsum("bnqk,bknh->bqnh", p, vcf))
+        return m_new, l, acc
+
+    def step(carry, j):
+        kc, vc, m, l, acc = carry
+        m, l, acc = accumulate((m, l, acc), j, kc, vc)
+        # Rotate the NARROW K/V to the next device; the final chunk's
+        # rotation is elided (handled after the scan) — n-1 transfers.
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (kc, vc, m, l, acc), None
+
+    m0 = jnp.full((B, N, Sc, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, N, Sc, 1), jnp.float32)
+    acc0 = jnp.zeros((B, Sc, N, H), jnp.float32)
+    if axis_size > 1:
+        (kc, vc, m, l, acc), _ = jax.lax.scan(
+            step, (k, v, m0, l0, acc0), jnp.arange(axis_size - 1))
+    else:
+        kc, vc, m, l, acc = k, v, m0, l0, acc0
+    _, l, acc = accumulate((m, l, acc), axis_size - 1, kc, vc)
+    denom = jnp.moveaxis(l, 1, 2)  # [B, Sc, N, 1]
+    # A fully masked row (can't happen when causal includes self) would
+    # divide by zero; guard anyway for non-causal degenerate shapes.
+    out = acc / jnp.maximum(denom, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, plan, *,
+                   causal: bool = True, kv_group: int = 1) -> jax.Array:
+    """Global-array entry: q [B, S, N, H] (k/v may carry N/kv_group heads),
+    logically global, laid out batch-over-dp, seq-over-sp, heads-over-tp
+    on ``plan``'s mesh."""
+    n_sp = plan.axes.get("sp", 1)
+    spec = plan.spec("dp", "sp", "tp", None)
+    body = functools.partial(ring_attention_local, axis_name="sp",
+                             axis_size=n_sp, causal=causal,
+                             kv_group=kv_group)
+    return shard_map(body, mesh=plan.mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
