@@ -447,3 +447,74 @@ def test_gang_generation_pinning():
     scores = {s["Host"]: s["Score"] for s in sched.sort(pod, all_nodes(api))}
     assert all(scores[n] == 0 for n in scores if n.startswith("node-"))
     assert any(scores[n] > 0 for n in scores if n.startswith("enode-"))
+
+
+# ---- multislice gangs -------------------------------------------------------
+
+def two_slice_cluster(clock):
+    """Two v5p 2x2x2 domains (2 hosts each = 8 chips per slice)."""
+    api, _ = build_cluster(spec="v5p:2x2x2", workers=2, slice_id="slice-a",
+                           clock=clock)
+    api, _ = build_cluster(spec="v5p:2x2x2", workers=2, slice_id="slice-b",
+                           api=api, clock=clock, node_prefix="bnode")
+    return api
+
+
+def test_gang_without_multislice_label_refuses_split():
+    """A 4-replica gang needing 4 hosts cannot fit either 2-host domain;
+    without the opt-in it must not schedule at all (all-or-nothing)."""
+    clock = Clock(1000.0)
+    api = two_slice_cluster(clock)
+    sched = make_scheduler(api, clock=clock)
+    for i in range(4):
+        api.create("pods", gang_pod(f"g-{i}", "big", 4, 4))
+    pod = api.get("pods", "g-0", "default")
+    scores = sched.sort(pod, all_nodes(api))
+    assert all(s["Score"] == 0 for s in scores)
+    with pytest.raises(BindError, match="cannot fit"):
+        sched.bind("g-0", "default", "node-0")
+
+
+def test_gang_multislice_opt_in_splits_across_domains():
+    """With tpu.dev/allow-multislice=true the same gang splits 2+2 across
+    the two slices, each sub-gang contiguous within its domain."""
+    clock = Clock(1000.0)
+    api = two_slice_cluster(clock)
+    sched = make_scheduler(api, clock=clock)
+    for i in range(4):
+        p = gang_pod(f"m-{i}", "big", 4, 4)
+        p["metadata"]["labels"]["tpu.dev/allow-multislice"] = "true"
+        api.create("pods", p)
+    decisions = []
+    for i in range(4):
+        pod = api.get("pods", f"m-{i}", "default")
+        scores = sched.sort(pod, all_nodes(api))
+        best = max(scores, key=lambda s: (s["Score"], s["Host"]))
+        assert best["Score"] > 0, scores
+        decisions.append(sched.bind(f"m-{i}", "default", best["Host"]))
+    slices = {d["slice"] for d in decisions}
+    assert slices == {"slice-a", "slice-b"}
+    assert all(d["contiguous"] for d in decisions)
+    # Every chip of both slices used, each sub-gang disjoint.
+    state = ClusterState(api, clock=clock).sync()
+    assert len(state.domains["slice-a"].allocator.used) == 8
+    assert len(state.domains["slice-b"].allocator.used) == 8
+
+
+def test_gang_multislice_prefers_single_domain_when_it_fits():
+    """The opt-in must not cause gratuitous splitting: a 2-replica gang
+    fits in one domain and must land there."""
+    clock = Clock(1000.0)
+    api = two_slice_cluster(clock)
+    sched = make_scheduler(api, clock=clock)
+    for i in range(2):
+        p = gang_pod(f"s-{i}", "small", 2, 4)
+        p["metadata"]["labels"]["tpu.dev/allow-multislice"] = "true"
+        api.create("pods", p)
+    decisions = []
+    for i in range(2):
+        pod = api.get("pods", f"s-{i}", "default")
+        scores = sched.sort(pod, all_nodes(api))
+        best = max(scores, key=lambda s: (s["Score"], s["Host"]))
+        decisions.append(sched.bind(f"s-{i}", "default", best["Host"]))
+    assert len({d["slice"] for d in decisions}) == 1

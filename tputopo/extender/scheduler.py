@@ -37,6 +37,10 @@ from tputopo.topology.slices import Allocator, Placement, enumerate_shapes
 # Gang metadata lives in labels (selectable) with annotation fallback.
 LABEL_GANG_ID = "tpu.dev/gang-id"
 LABEL_GANG_SIZE = "tpu.dev/gang-size"
+# Opt-in: a gang that may split across ICI domains (TPU multislice — DP
+# replicas sync gradients over DCN between slices).  Off by default: the
+# contiguity guarantee is the framework's core promise.
+LABEL_ALLOW_MULTISLICE = "tpu.dev/allow-multislice"
 
 MAX_PRIORITY = 10  # kube-scheduler extender priority ceiling
 
@@ -236,49 +240,94 @@ class ExtenderScheduler:
             return None
         return {dom.node_by_host[h]: candidate[h] for h in hosts.chips}
 
+    @staticmethod
+    def _gang_allows_multislice(members: list[dict]) -> bool:
+        for p in members:
+            meta = {**p["metadata"].get("annotations", {}),
+                    **p["metadata"].get("labels", {})}
+            if meta.get(LABEL_ALLOW_MULTISLICE) == "true":
+                return True
+        return False
+
     def _gang_context(self, state: ClusterState, gang: tuple[str, str, int],
-                      k: int, wanted_gen: str | None = None,
-                      ) -> tuple[SliceDomain | None, dict[str, Placement] | None]:
-        """Remaining-member plan for a gang, given already-bound members."""
+                      k: int, wanted_gen: str | None = None) -> dict | None:
+        """Remaining-member plan for a gang, given already-bound members.
+
+        Returns {"plan": {node: Placement}, "order": [node, ...]} or None
+        when the gang cannot fit.  One ICI-contiguous domain is always
+        preferred; gangs labeled tpu.dev/allow-multislice=true may split
+        across domains (replica sync rides DCN between slices) when no
+        single domain has room."""
         namespace, gang_id, size = gang
         members = self._gang_members(namespace, gang_id)
         bound = [p for p in members if p["spec"].get("nodeName")]
         remaining = size - len(bound)
         if remaining <= 0:
-            return None, None
+            return None
+        allow_multi = self._gang_allows_multislice(members)
         dom_ids = {d.slice_id for p in bound
                    if (d := state.domain_of_node(p["spec"]["nodeName"])) is not None}
-        if len(dom_ids) > 1:
+        if len(dom_ids) > 1 and not allow_multi:
             # Members already straddle ICI domains — such a gang can never
             # be contiguous; refuse to extend it (its assumptions will age
-            # out via the GC).  Cross-domain gangs over DCN are a deliberate
-            # non-goal for now: the scorer can rank them
-            # (predict_multidomain_allreduce_gbps) but the planner won't
-            # produce them.
-            return None, None
+            # out via the GC).
+            return None
         exclude = {p["spec"]["nodeName"] for p in bound}
-        search = ([state.domains[next(iter(dom_ids))]] if dom_ids
-                  else list(state.domains.values()))
+        all_doms = sorted(state.domains.values(), key=lambda d: d.slice_id)
         if wanted_gen is not None:
-            search = [d for d in search
-                      if d.topology.generation.name == wanted_gen]
-        for dom in search:
+            all_doms = [d for d in all_doms
+                        if d.topology.generation.name == wanted_gen]
+
+        def ctx(plans: dict[str, Placement]) -> dict:
+            order = sorted(
+                plans,
+                key=lambda n: ((d := state.domain_of_node(n)).slice_id,
+                               d.host_by_node[n]))
+            return {"plan": plans, "order": order}
+
+        # Phase 1: one ICI-contiguous domain (the core guarantee).  A gang
+        # with members bound in exactly one domain extends that domain; a
+        # fresh gang may pick any.
+        if len(dom_ids) == 1:
+            phase1 = [d for d in all_doms if d.slice_id in dom_ids]
+        elif not dom_ids:
+            phase1 = all_doms
+        else:
+            phase1 = []  # already split (multislice in progress)
+        for dom in phase1:
             plan = self._plan_gang(state, dom, remaining, k, exclude)
             if plan is not None:
-                return dom, plan
-        return None, None
+                return ctx(plan)
+        if not allow_multi:
+            return None
+        # Phase 2 (opt-in multislice): split across domains, each slice's
+        # sub-gang still a contiguous host box; fill domains greedily with
+        # the largest sub-gang each accepts.
+        plans: dict[str, Placement] = {}
+        rem = remaining
+        for dom in all_doms:
+            if rem == 0:
+                break
+            for m in range(min(rem, len(dom.node_by_host)), 0, -1):
+                sub = self._plan_gang(state, dom, m, k, exclude)
+                if sub is not None:
+                    plans.update(sub)
+                    rem -= m
+                    break
+        if rem > 0:
+            return None
+        self.metrics.inc("gang_multislice_plans")
+        return ctx(plans)
 
-    def _score_gang_node(self, gang_ctx, node_name: str) -> int:
-        dom, plan = gang_ctx if gang_ctx is not None else (None, None)
-        if plan is None or node_name not in plan:
+    def _score_gang_node(self, gang_ctx: dict | None, node_name: str) -> int:
+        if gang_ctx is None or node_name not in gang_ctx["plan"]:
             return 0
-        # Rank member nodes in host-grid (row-major coordinate) order, NOT
-        # node-name order: binding must march through the planned host box
+        # Rank member nodes in (domain, host-grid coordinate) order, NOT
+        # node-name order: binding must march through each planned host box
         # compactly so the hosts still free for later members remain a
         # connected region (lexicographic "node-1" < "node-10" < "node-2"
         # ordering fragments the grid mid-gang).
-        ordered = sorted(plan, key=lambda n: dom.host_by_node[n])
-        rank = ordered.index(node_name)
+        rank = gang_ctx["order"].index(node_name)
         return max(1, MAX_PRIORITY - rank)
 
     # ---- bind --------------------------------------------------------------
@@ -317,21 +366,21 @@ class ExtenderScheduler:
         gang_id = None
         if gang is not None:
             gang_id = gang[1]
-            plan_dom, plan = self._gang_context(state, gang, k,
-                                                _wanted_generation(pod))
-            if plan is None:
+            gang_ctx = self._gang_context(state, gang, k,
+                                          _wanted_generation(pod))
+            if gang_ctx is None:
                 self.metrics.inc("bind_gang_infeasible")
                 raise BindError(
                     f"gang {gang_id!r} cannot fit ({gang[2]} x {k} chips) — "
                     "binding nothing (all-or-nothing)"
                 )
-            if node_name not in plan:
+            if node_name not in gang_ctx["plan"]:
                 self.metrics.inc("bind_gang_wrong_node")
                 raise BindError(
                     f"node {node_name} is not in gang {gang_id!r}'s plan "
-                    f"(planned: {sorted(plan)})"
+                    f"(planned: {sorted(gang_ctx['plan'])})"
                 )
-            placement = plan[node_name]
+            placement = gang_ctx["plan"][node_name]
         else:
             node_free = frozenset(state.free_chips_on_node(node_name))
             placement = dom.allocator.find(k, node_free)
