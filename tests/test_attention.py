@@ -69,13 +69,25 @@ def test_auto_resolves_einsum_on_cpu():
     assert _use_flash(ModelConfig(attn_impl="einsum"), 128) is False
 
 
-def test_flash_grad_matches_reference():
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_grad_matches_reference(causal):
+    """dQ, dK AND dV from the Pallas backward kernels, with a non-constant
+    cotangent so every contraction in the dkv kernel is exercised."""
     q, k, v = qkv((1, 32, 2, 8))
-    gf = jax.grad(lambda a: flash_attention(
-        a, k, v, block_q=16, block_kv=16, interpret=True).sum())(q)
-    gr = jax.grad(lambda a: reference_attention(a, k, v).sum())(q)
-    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
-                               atol=3e-5, rtol=3e-5)
+    w = qkv((1, 32, 2, 8), seed=7)[0]  # weighting -> non-trivial dO
+
+    def lf(a, b, c_):
+        return (flash_attention(a, b, c_, causal=causal, block_q=16,
+                                block_kv=16, interpret=True) * w).sum()
+
+    def lr(a, b, c_):
+        return (reference_attention(a, b, c_, causal=causal) * w).sum()
+
+    gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5)
 
 
 def test_sharded_train_step_with_flash():

@@ -518,3 +518,50 @@ def test_gang_multislice_prefers_single_domain_when_it_fits():
         best = max(scores, key=lambda s: (s["Score"], s["Host"]))
         decisions.append(sched.bind(f"s-{i}", "default", best["Host"]))
     assert len({d["slice"] for d in decisions}) == 1
+
+
+def test_gang_multislice_never_mixes_generations():
+    """Phase-2 split must stay within one generation even without a pin:
+    a 4x4 gang with 2 free v5p hosts and 2 free v5e hosts must NOT split
+    across the pools (quota classing)."""
+    clock = Clock(1000.0)
+    api, _ = build_cluster(spec="v5p:2x2x2", workers=2, slice_id="slice-p",
+                           clock=clock)
+    api, _ = build_cluster(spec="v5e:4x4", workers=2, slice_id="slice-e",
+                           api=api, clock=clock, node_prefix="enode")
+    sched = make_scheduler(api, clock=clock)
+    for i in range(4):
+        p = gang_pod(f"x-{i}", "mixed", 4, 4)
+        p["metadata"]["labels"]["tpu.dev/allow-multislice"] = "true"
+        api.create("pods", p)
+    pod = api.get("pods", "x-0", "default")
+    scores = sched.sort(pod, all_nodes(api))
+    assert all(s["Score"] == 0 for s in scores), scores
+
+
+def test_gang_multislice_prefers_fewest_domains():
+    """Three same-generation domains with capacities 1/1/2 hosts: a
+    2-replica-split gang of 3 must use the 2-host domain plus ONE 1-host
+    domain (largest-first fill = shortest DCN ring), never all three."""
+    clock = Clock(1000.0)
+    api, _ = build_cluster(spec="v5p:2x2x1", workers=1, slice_id="s-one",
+                           clock=clock)
+    api, _ = build_cluster(spec="v5p:2x2x1", workers=1, slice_id="s-two",
+                           api=api, clock=clock, node_prefix="tnode")
+    api, _ = build_cluster(spec="v5p:2x2x2", workers=2, slice_id="s-big",
+                           api=api, clock=clock, node_prefix="bnode")
+    sched = make_scheduler(api, clock=clock)
+    for i in range(3):
+        p = gang_pod(f"f-{i}", "fewest", 3, 4)
+        p["metadata"]["labels"]["tpu.dev/allow-multislice"] = "true"
+        api.create("pods", p)
+    decisions = []
+    for i in range(3):
+        pod = api.get("pods", f"f-{i}", "default")
+        scores = sched.sort(pod, all_nodes(api))
+        best = max(scores, key=lambda s: (s["Score"], s["Host"]))
+        assert best["Score"] > 0
+        decisions.append(sched.bind(f"f-{i}", "default", best["Host"]))
+    used_slices = {d["slice"] for d in decisions}
+    assert "s-big" in used_slices
+    assert len(used_slices) == 2, used_slices

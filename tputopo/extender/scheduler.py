@@ -300,24 +300,48 @@ class ExtenderScheduler:
                 return ctx(plan)
         if not allow_multi:
             return None
-        # Phase 2 (opt-in multislice): split across domains, each slice's
-        # sub-gang still a contiguous host box; fill domains greedily with
-        # the largest sub-gang each accepts.
-        plans: dict[str, Placement] = {}
-        rem = remaining
-        for dom in all_doms:
-            if rem == 0:
-                break
-            for m in range(min(rem, len(dom.node_by_host)), 0, -1):
+        # Phase 2 (opt-in multislice): split across domains.  Constraints:
+        # all sub-gangs share ONE generation even without an explicit pin
+        # (quota classing — a DP job must not straddle v4/v5p; a JAX
+        # multislice mesh cannot form across generations), and each slice's
+        # sub-gang is still a contiguous host box.  Within a generation,
+        # domains are filled largest-feasible-sub-gang first: fewer domains
+        # in the split means a shorter cross-slice DCN ring, which is what
+        # predict_multidomain_allreduce_gbps rewards (score.py) — the
+        # greedy order is the scorer's monotone direction, without a
+        # combinatorial search.
+        if dom_ids:
+            gens = [state.domains[next(iter(dom_ids))].topology.generation.name]
+        else:
+            gens = sorted({d.topology.generation.name for d in all_doms})
+        for gen in gens:
+            gen_doms = [d for d in all_doms
+                        if d.topology.generation.name == gen]
+
+            def max_feasible(dom) -> int:
+                for m in range(min(remaining, len(dom.node_by_host)), 0, -1):
+                    if self._plan_gang(state, dom, m, k, exclude) is not None:
+                        return m
+                return 0
+
+            capacity = {d.slice_id: max_feasible(d) for d in gen_doms}
+            gen_doms.sort(key=lambda d: (-capacity[d.slice_id], d.slice_id))
+            plans: dict[str, Placement] = {}
+            rem = remaining
+            for dom in gen_doms:
+                if rem == 0:
+                    break
+                m = min(rem, capacity[dom.slice_id])
+                if m <= 0:
+                    continue
                 sub = self._plan_gang(state, dom, m, k, exclude)
                 if sub is not None:
                     plans.update(sub)
                     rem -= m
-                    break
-        if rem > 0:
-            return None
-        self.metrics.inc("gang_multislice_plans")
-        return ctx(plans)
+            if rem == 0:
+                self.metrics.inc("gang_multislice_plans")
+                return ctx(plans)
+        return None
 
     def _score_gang_node(self, gang_ctx: dict | None, node_name: str) -> int:
         if gang_ctx is None or node_name not in gang_ctx["plan"]:

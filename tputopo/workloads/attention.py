@@ -1,20 +1,25 @@
-"""Blockwise (flash) causal attention as a Pallas TPU kernel.
+"""Blockwise (flash) causal attention as Pallas TPU kernels, fwd + bwd.
 
 The flagship workload's hot op.  The einsum attention in model.py
 materializes the full [B, N, S, S] score matrix in HBM — O(S^2) memory
-traffic.  This kernel streams K/V blocks through VMEM with the standard
+traffic.  These kernels stream K/V blocks through VMEM with the standard
 online-softmax recurrence, keeping the working set at
 O(block_q x block_kv), so long sequences stay HBM-bandwidth-friendly and
 the matmuls stay MXU-shaped (block sizes default to 128, the MXU tile).
 
-Grid: (batch*heads, q_blocks, kv_blocks), sequential on TPU; the running
-max/denominator/accumulator live in VMEM scratch that persists across the
-kv_block steps of one q_block (initialized at kv==0, flushed at the last
-kv step).  Causal blocks above the diagonal are predicated off entirely
-(`@pl.when`), halving the work.
+Forward: grid (batch*heads, q_blocks, kv_blocks), sequential on TPU; the
+running max/denominator/accumulator live in VMEM scratch that persists
+across the kv_block steps of one q_block.  Emits the per-row logsumexp
+(LSE) alongside the output — the only O(S) residual the backward needs.
+
+Backward: the FlashAttention-2 scheme, two kernels so each output has a
+single accumulation order — dQ iterates (q_block outer, kv inner), dK/dV
+iterate (kv_block outer, q inner).  P is recomputed from Q, K and the
+saved LSE; dS = P * (dP - D) with D = rowsum(dO * O) precomputed.
+Causal blocks off the diagonal are predicated off in all three kernels.
 
 Used by model.forward when ``ModelConfig.attn_impl`` resolves to flash
-(auto: TPU platform + divisible shapes); tests run the same kernel in
+(auto: TPU platform + divisible shapes); tests run the same kernels in
 Pallas interpret mode on CPU against the einsum reference.
 """
 
@@ -29,8 +34,37 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-                  *, scale: float, causal: bool, n_kv: int):
+def pltpu_vmem(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, dtype)
+
+
+# ---- shared tile math -------------------------------------------------------
+
+def _masked_scores(q_ref, k_ref, iq, ik, *, scale, causal):
+    """scale * Q K^T for one (q_block, kv_block) tile, causal positions
+    above the diagonal set to NEG_INF — the ONE definition of the score
+    tile, shared by the forward kernel and the backward recompute so the
+    two can never drift apart."""
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if causal:
+        bq = q_ref.shape[1]
+        bkv = k_ref.shape[1]
+        q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+        k_pos = ik * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+    return s
+
+
+# ---- forward ----------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      m_ref, l_ref, acc_ref,
+                      *, scale: float, causal: bool, n_kv: int):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
 
@@ -44,20 +78,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32) * scale          # (bq, H)
-        k = k_ref[0].astype(jnp.float32)                  # (bkv, H)
         v = v_ref[0].astype(jnp.float32)                  # (bkv, H)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)           # (bq, bkv)
-        if causal:
-            bq = q_ref.shape[1]
-            bkv = k_ref.shape[1]
-            q_pos = iq * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bkv), 0)
-            k_pos = ik * bkv + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bkv), 1)
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        s = _masked_scores(q_ref, k_ref, iq, ik,
+                           scale=scale, causal=causal)    # (bq, bkv)
         m_prev = m_ref[:, :1]                             # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)                            # (bq, bkv)
@@ -70,8 +93,93 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
 
     @pl.when(ik == n_kv - 1)
     def _flush():
-        o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        # LSE is laid out [BN, n_q, bq] so its block's trailing dims equal
+        # the array dims (TPU tiling forbids a (1, bq) tile of [BN, S]).
+        lse_ref[0, iq] = (m_ref[:, :1] + jnp.log(l))[:, 0]
 
+
+# ---- backward ---------------------------------------------------------------
+
+def _recompute_p(q_ref, k_ref, lse_row, iq, ik, *, scale, causal):
+    """P = exp(scale*QK^T - LSE) for one (q_block, kv_block) tile; masked
+    entries come out exactly 0 via the NEG_INF score.  ``lse_row`` is this
+    q block's (bq,) slice of the LSE row."""
+    s = _masked_scores(q_ref, k_ref, iq, ik, scale=scale, causal=causal)
+    return jnp.exp(s - lse_row[:, None])
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
+                     dq_ref, acc_ref,
+                     *, scale: float, causal: bool, n_kv: int):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = (ik <= iq) if causal else True
+
+    @pl.when(run)
+    def _step():
+        lse_row = lse_ref[0, iq]
+        d_row = d_ref[0, iq]
+        p = _recompute_p(q_ref, k_ref, lse_row, iq, ik,
+                         scale=scale, causal=causal)     # (bq, bkv)
+        do = do_ref[0].astype(jnp.float32)               # (bq, H)
+        v = v_ref[0].astype(jnp.float32)                 # (bkv, H)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - d_row[:, None]) * scale           # (bq, bkv)
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == n_kv - 1)
+    def _flush():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
+                      dk_ref, dv_ref, dk_acc, dv_acc,
+                      *, scale: float, causal: bool, n_q: int):
+    ikv = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = (iq >= ikv) if causal else True
+
+    @pl.when(run)
+    def _step():
+        lse_row = lse_ref[0, iq]
+        d_row = d_ref[0, iq]
+        p = _recompute_p(q_ref, k_ref, lse_row, iq, ikv,
+                         scale=scale, causal=causal)     # (bq, bkv)
+        do = do_ref[0].astype(jnp.float32)               # (bq, H)
+        v = v_ref[0].astype(jnp.float32)                 # (bkv, H)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bkv, H)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - d_row[:, None]) * scale           # (bq, bkv)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bkv, H)
+
+    @pl.when(iq == n_q - 1)
+    def _flush():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# ---- public API -------------------------------------------------------------
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_kv",
                                              "interpret"))
@@ -81,41 +189,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """q/k/v: [B, S, N, H] (same head count — expand GQA groups first, as
     model.py does).  Returns [B, S, N, H] in q's dtype.
 
-    Differentiable: the forward pass is the Pallas kernel; the backward
-    pass rematerializes attention through the einsum reference (nothing
-    O(S^2) is saved between passes — the S^2 scores exist only transiently
-    inside whichever pass is running).  A dedicated Pallas backward kernel
-    is a further optimization, not a correctness need.
-    """
+    Fully kernelized: forward saves only O and the per-row LSE; the
+    backward pass runs the FlashAttention-2 dQ and dK/dV kernels — nothing
+    O(S^2) is ever resident in HBM in either direction."""
     return _flash_vjp(q, k, v, causal, block_q, block_kv, interpret)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_vjp(q, k, v, causal, block_q, block_kv, interpret):
-    return _flash_forward(q, k, v, causal=causal, block_q=block_q,
-                          block_kv=block_kv, interpret=interpret)
-
-
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_kv, interpret):
-    out = _flash_forward(q, k, v, causal=causal, block_q=block_q,
-                         block_kv=block_kv, interpret=interpret)
-    return out, (q, k, v)
-
-
-def _flash_vjp_bwd(causal, block_q, block_kv, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: reference_attention(a, b, c,
-                                                         causal=causal),
-                     q, k, v)
-    return vjp(g)
-
-
-_flash_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
-
-
-def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                   causal: bool, block_q: int, block_kv: int,
-                   interpret: bool) -> jax.Array:
+def _validate(q, k, v, causal, block_q, block_kv):
     B, S, N, H = q.shape
     if k.shape != q.shape or v.shape != q.shape:
         raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
@@ -126,18 +206,28 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *,
                          f"({block_q}, {block_kv})")
     if causal and block_q != block_kv:
         raise ValueError("causal path requires block_q == block_kv")
+    return block_q, block_kv
+
+
+def _to_heads(x):
+    B, S, N, H = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * N, S, H)
+
+
+def _from_heads(x, B, N):
+    BN, S, H = x.shape
+    return x.reshape(B, N, S, H).transpose(0, 2, 1, 3)
+
+
+def _flash_forward_lse(q, k, v, *, causal, block_q, block_kv, interpret):
+    B, S, N, H = q.shape
+    block_q, block_kv = _validate(q, k, v, causal, block_q, block_kv)
     scale = 1.0 / (H ** 0.5)
+    qh, kh, vh = _to_heads(q), _to_heads(k), _to_heads(v)
+    n_q, n_kv = S // block_q, S // block_kv
 
-    # [B, S, N, H] -> [B*N, S, H]: one grid row per (batch, head).
-    def to_heads(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * N, S, H)
-
-    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    n_q = S // block_q
-    n_kv = S // block_kv
-
-    out = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale, causal=causal,
+    out, lse = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
                           n_kv=n_kv),
         grid=(B * N, n_q, n_kv),
         in_specs=[
@@ -145,8 +235,14 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *,
             pl.BlockSpec((1, block_kv, H), lambda b, iq, ik: (b, ik, 0)),
             pl.BlockSpec((1, block_kv, H), lambda b, iq, ik: (b, ik, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, H), lambda b, iq, ik: (b, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * N, S, H), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, H), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, n_q, block_q), lambda b, iq, ik: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * N, S, H), q.dtype),
+            jax.ShapeDtypeStruct((B * N, n_q, block_q), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu_vmem((block_q, 128), jnp.float32),  # running max (col 0)
             pltpu_vmem((block_q, 128), jnp.float32),  # running denom (col 0)
@@ -154,13 +250,88 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, *,
         ],
         interpret=interpret,
     )(qh, kh, vh)
-    return out.reshape(B, N, S, H).transpose(0, 2, 1, 3)
+    return _from_heads(out, B, N), lse
 
 
-def pltpu_vmem(shape, dtype):
-    from jax.experimental.pallas import tpu as pltpu
+def _flash_backward(q, k, v, o, lse, do, *, causal, block_q, block_kv,
+                    interpret):
+    B, S, N, H = q.shape
+    block_q, block_kv = _validate(q, k, v, causal, block_q, block_kv)
+    scale = 1.0 / (H ** 0.5)
+    qh, kh, vh = _to_heads(q), _to_heads(k), _to_heads(v)
+    doh = _to_heads(do)
+    n_q, n_kv = S // block_q, S // block_kv
+    # D = rowsum(dO * O): the only other O(S) residual FlashAttention-2
+    # needs; cheap elementwise work, no reason to kernelize.  Same
+    # [BN, n_q, bq] layout as the LSE.
+    d = _to_heads((do.astype(jnp.float32) * o.astype(jnp.float32))
+                  .sum(axis=-1, keepdims=True))[..., 0]
+    d = d.reshape(B * N, n_q, block_q)
 
-    return pltpu.VMEM(shape, dtype)
+    qspec = pl.BlockSpec((1, block_q, H), lambda b, i, j: (b, i, 0))
+    row_spec = pl.BlockSpec((1, n_q, block_q), lambda b, i, j: (b, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, scale=scale, causal=causal,
+                          n_kv=n_kv),
+        grid=(B * N, n_q, n_kv),
+        in_specs=[
+            qspec,
+            pl.BlockSpec((1, block_kv, H), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((1, block_kv, H), lambda b, iq, ik: (b, ik, 0)),
+            qspec,      # dO
+            row_spec,   # LSE
+            row_spec,   # D
+        ],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((B * N, S, H), q.dtype),
+        scratch_shapes=[pltpu_vmem((block_q, H), jnp.float32)],
+        interpret=interpret,
+    )(qh, kh, vh, doh, lse, d)
+
+    kv_spec = pl.BlockSpec((1, block_kv, H), lambda b, ikv, iq: (b, ikv, 0))
+    q_spec2 = pl.BlockSpec((1, block_q, H), lambda b, ikv, iq: (b, iq, 0))
+    row_spec2 = pl.BlockSpec((1, n_q, block_q), lambda b, ikv, iq: (b, 0, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, scale=scale, causal=causal,
+                          n_q=n_q),
+        grid=(B * N, n_kv, n_q),
+        in_specs=[q_spec2, kv_spec, kv_spec, q_spec2, row_spec2, row_spec2],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * N, S, H), k.dtype),
+            jax.ShapeDtypeStruct((B * N, S, H), v.dtype),
+        ],
+        scratch_shapes=[pltpu_vmem((block_kv, H), jnp.float32),
+                        pltpu_vmem((block_kv, H), jnp.float32)],
+        interpret=interpret,
+    )(qh, kh, vh, doh, lse, d)
+
+    return (_from_heads(dq, B, N), _from_heads(dk, B, N),
+            _from_heads(dv, B, N))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_vjp(q, k, v, causal, block_q, block_kv, interpret):
+    out, _ = _flash_forward_lse(q, k, v, causal=causal, block_q=block_q,
+                                block_kv=block_kv, interpret=interpret)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_kv, interpret):
+    out, lse = _flash_forward_lse(q, k, v, causal=causal, block_q=block_q,
+                                  block_kv=block_kv, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, block_q, block_kv, interpret, res, g):
+    q, k, v, o, lse = res
+    return _flash_backward(q, k, v, o, lse, g, causal=causal,
+                           block_q=block_q, block_kv=block_kv,
+                           interpret=interpret)
+
+
+_flash_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def reference_attention(q, k, v, *, causal: bool = True) -> jax.Array:
