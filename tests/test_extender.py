@@ -565,3 +565,92 @@ def test_gang_multislice_prefers_fewest_domains():
     used_slices = {d["slice"] for d in decisions}
     assert "s-big" in used_slices
     assert len(used_slices) == 2, used_slices
+
+
+# ---- round-2 regressions: gang-order scaling & scored multislice splits ----
+
+def test_gang_16_members_no_rank_saturation():
+    """VERDICT r1 #7: a 16-pod gang must keep a strict front-runner at every
+    bind step (the old max(1, 10-rank) clamp tied all ranks >= 9, so the
+    host-box marching order degraded exactly at the scale it served)."""
+    clock = Clock(1000.0)
+    api, _ = build_cluster(spec="v5p:4x4x4", workers=16, clock=clock)
+    sched = make_scheduler(api, clock=clock)
+    for i in range(16):
+        api.create("pods", gang_pod(f"big-{i}", "sixteen", 16, 4))
+    for i in range(16):
+        pod = api.get("pods", f"big-{i}", "default")
+        scores = sorted(sched.sort(pod, all_nodes(api)),
+                        key=lambda s: -s["Score"])
+        # Strict front-runner: the planned next host outranks every other.
+        assert scores[0]["Score"] > scores[1]["Score"], (i, scores[:4])
+        sched.bind(f"big-{i}", "default", scores[0]["Host"])
+    state = ClusterState(api, clock=clock).sync()
+    assert len(state.domains["slice-a"].allocator.used) == 64
+
+
+def test_gang_multislice_split_is_scored_not_greedy():
+    """VERDICT r1 #8: with DCN wide enough that the narrowest sub-gang's ICI
+    bandwidth binds the multidomain score, a balanced 2+2 split (each a
+    2x2x2 box, 600 GB/s) must beat greedy largest-first (3+1: the 1-host
+    2x2x1 box scores 400)."""
+    clock = Clock(1000.0)
+    api, _ = build_cluster(spec="v5p:2x2x3", workers=3, slice_id="s-three",
+                           clock=clock)
+    api, _ = build_cluster(spec="v5p:2x2x2", workers=2, slice_id="s-two",
+                           api=api, clock=clock, node_prefix="tnode")
+    # Fat DCN: per-chip DCN share (10000 * 1/4 per chip) no longer binds,
+    # exposing the ICI term the greedy order ignored.
+    sched = make_scheduler(
+        api, clock=clock,
+        cost_overrides={"v5p": {"dcn_host_gbps": 10000.0}})
+    for i in range(4):
+        p = gang_pod(f"b-{i}", "balanced", 4, 4)
+        p["metadata"]["labels"]["tpu.dev/allow-multislice"] = "true"
+        api.create("pods", p)
+    decisions = []
+    for i in range(4):
+        pod = api.get("pods", f"b-{i}", "default")
+        scores = sched.sort(pod, all_nodes(api))
+        best = max(scores, key=lambda s: (s["Score"], s["Host"]))
+        assert best["Score"] > 0, scores
+        decisions.append(sched.bind(f"b-{i}", "default", best["Host"]))
+    per_slice = {}
+    for d in decisions:
+        per_slice[d["slice"]] = per_slice.get(d["slice"], 0) + 1
+    assert per_slice == {"s-three": 2, "s-two": 2}, per_slice
+    # Both sub-gangs contiguous 2x2x2 boxes.
+    assert all(d["contiguous"] for d in decisions)
+
+
+def test_scheduler_configuration_v1_shape():
+    """VERDICT r1 #5: the modern KubeSchedulerConfiguration artifact."""
+    cfg = ExtenderConfig()
+    sc = cfg.scheduler_configuration(host="tputopo-extender.kube-system.svc")
+    assert sc["apiVersion"] == "kubescheduler.config.k8s.io/v1"
+    assert sc["kind"] == "KubeSchedulerConfiguration"
+    ext = sc["extenders"][0]
+    assert ext["urlPrefix"] == (
+        "http://tputopo-extender.kube-system.svc:32743/tputopo-scheduler")
+    assert ext["prioritizeVerb"] == "sort" and ext["bindVerb"] == "bind"
+    assert "filterVerb" not in ext
+    assert ext["weight"] == 1 and ext["enableHTTPS"] is False
+    assert ext["nodeCacheCapable"] is True and ext["ignorable"] is False
+    assert ext["managedResources"] == [
+        {"name": "tpu.dev/chips", "ignoredByScheduler": True}]
+
+
+def test_gang_rank_scaling_no_tie_at_any_size():
+    """Code-review r2: round() re-tied rank 1 with rank 0 from n=19 up
+    (banker's rounding); rank 0 must be the unique max at every gang size."""
+    from tputopo.extender.scheduler import ExtenderScheduler
+
+    for n in (2, 3, 10, 16, 19, 32, 64, 128):
+        ctx = {"plan": {f"n{i}": None for i in range(n)},
+               "order": [f"n{i}" for i in range(n)]}
+        scores = [ExtenderScheduler._score_gang_node(None, ctx, f"n{i}")
+                  for i in range(n)]
+        assert scores[0] == MAX_PRIORITY
+        assert all(s < scores[0] for s in scores[1:]), (n, scores[:4])
+        assert all(a >= b for a, b in zip(scores, scores[1:])), (n, scores)
+        assert min(scores) >= 1

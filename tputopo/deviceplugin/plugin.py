@@ -209,31 +209,38 @@ class TpuDevicePlugin:
             return False
         md = pod["metadata"]
         patch = {ko.ANN_ASSIGNED: "true", ko.ANN_ASSUME_TIME: str(self.clock())}
-        try:
-            self.api_server.patch_annotations(
-                "pods", md["name"], patch,
-                namespace=md.get("namespace"),
-                expect_version=md.get("resourceVersion"),
-            )
-            return True
-        except Conflict:
+        version = md.get("resourceVersion")
+        # Bounded retries: a hot metadata writer must not livelock the
+        # kubelet's Allocate RPC here; on exhaustion the Allocate fails and
+        # the kubelet retries the whole pod sync.
+        for _ in range(8):
+            try:
+                self.api_server.patch_annotations(
+                    "pods", md["name"], patch,
+                    namespace=md.get("namespace"),
+                    expect_version=version,
+                )
+                return True
+            except Conflict:
+                pass
             # Someone raced us.  Re-read: if the GROUP annotation survived,
             # the assignment still stands (e.g. an unrelated metadata write
-            # bumped the version) — confirm on the fresh version.  If GROUP
-            # is gone, the GC released the assignment; confirming would
-            # resurrect ASSIGNED=true on a group-less pod and double-book
-            # the chips to whoever the extender hands them next.
+            # bumped the version) — retry the confirm CAS-guarded on the
+            # fresh version (an unversioned retry would reopen the race: a
+            # GC release landing between re-read and patch could resurrect
+            # ASSIGNED=true on released chips).  If GROUP is gone, the GC
+            # released the assignment; confirming would double-book the
+            # chips to whoever the extender hands them next.
             fresh = self.api_server.get("pods", md["name"], md.get("namespace"))
             anns = fresh["metadata"]["annotations"]
             if ko.ANN_GROUP not in anns:
                 return False
-            if anns.get(ko.ANN_ASSIGNED) != "true":
-                if not self._is_live_assumption(fresh):
-                    return False  # expired while we raced — do not resurrect
-                self.api_server.patch_annotations(
-                    "pods", md["name"], patch, namespace=md.get("namespace"),
-                )
-            return True
+            if anns.get(ko.ANN_ASSIGNED) == "true":
+                return True
+            if not self._is_live_assumption(fresh):
+                return False  # expired while we raced — do not resurrect
+            version = fresh["metadata"].get("resourceVersion")
+        return False  # retries exhausted; kubelet will re-sync the pod
 
     def _container_response(self, chip_ids: list[str]) -> api.ContainerAllocateResponse:
         local_ids = []

@@ -60,7 +60,12 @@ class ExtenderConfig:
         field-for-field the shape the reference specifies (design.md:92-113):
         Prioritize verb "sort", Bind verb "bind", deliberately no Filter verb
         (design.md:115-117), nodeCacheCapable, fail-closed ignorable=false
-        (design.md:109, SURVEY.md §5.3)."""
+        (design.md:109, SURVEY.md §5.3).
+
+        NOTE: ``kind: Policy`` was removed from kube-scheduler in v1.23;
+        this emitter is kept for parity with the reference artifact and for
+        pre-1.23 clusters.  Current clusters use
+        :meth:`scheduler_configuration`."""
         return {
             "kind": "Policy",
             "apiVersion": "v1",
@@ -70,6 +75,32 @@ class ExtenderConfig:
                     "prioritizeVerb": "sort",
                     "bindVerb": "bind",
                     "enableHttps": False,
+                    "nodeCacheCapable": True,
+                    "managedResources": [
+                        {"name": self.resource_name, "ignoredByScheduler": True}
+                    ],
+                    "ignorable": False,
+                }
+            ],
+        }
+
+    def scheduler_configuration(self, host: str = "127.0.0.1") -> dict:
+        """The modern registration artifact: a ``KubeSchedulerConfiguration``
+        (``kubescheduler.config.k8s.io/v1``, kube-scheduler >= 1.25; the
+        Policy API this replaces left in v1.23).  Same extender semantics as
+        :meth:`policy_json` — Prioritize="sort", Bind="bind", no Filter verb,
+        fail-closed — expressed in the v1 field names (``enableHTTPS``,
+        ``weight`` required on prioritize extenders)."""
+        return {
+            "apiVersion": "kubescheduler.config.k8s.io/v1",
+            "kind": "KubeSchedulerConfiguration",
+            "extenders": [
+                {
+                    "urlPrefix": f"http://{host}:{self.port}{self.url_prefix}",
+                    "prioritizeVerb": "sort",
+                    "bindVerb": "bind",
+                    "weight": 1,
+                    "enableHTTPS": False,
                     "nodeCacheCapable": True,
                     "managedResources": [
                         {"name": self.resource_name, "ignoredByScheduler": True}

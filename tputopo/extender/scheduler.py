@@ -22,6 +22,7 @@ TPU-native departures from the reference, per SURVEY.md §5/§7:
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from dataclasses import dataclass, field
@@ -31,7 +32,9 @@ from tputopo.k8s.fakeapi import Conflict, FakeApiServer, NotFound
 from tputopo.extender.config import ExtenderConfig
 from tputopo.extender.state import ClusterState, SliceDomain
 from tputopo.topology.model import ChipTopology, Coord
-from tputopo.topology.score import predict_allreduce_gbps, score_chip_set
+from tputopo.topology.score import (predict_allreduce_gbps,
+                                    predict_multidomain_allreduce_gbps,
+                                    score_chip_set)
 from tputopo.topology.slices import Allocator, Placement, enumerate_shapes
 
 # Gang metadata lives in labels (selectable) with annotation fallback.
@@ -305,42 +308,102 @@ class ExtenderScheduler:
         # (quota classing — a DP job must not straddle v4/v5p; a JAX
         # multislice mesh cannot form across generations), and each slice's
         # sub-gang is still a contiguous host box.  Within a generation,
-        # domains are filled largest-feasible-sub-gang first: fewer domains
-        # in the split means a shorter cross-slice DCN ring, which is what
-        # predict_multidomain_allreduce_gbps rewards (score.py) — the
-        # greedy order is the scorer's monotone direction, without a
-        # combinatorial search.
+        # candidate splits (compositions of the remaining replica count over
+        # the feasible domains) are scored with
+        # predict_multidomain_allreduce_gbps and the max-scoring split wins
+        # — greedy largest-first can lose, e.g. when draining one large
+        # domain to a 1-replica remainder in a second domain scores below
+        # two balanced sub-gangs whose narrowest DCN attachment is wider.
         if dom_ids:
             gens = [state.domains[next(iter(dom_ids))].topology.generation.name]
         else:
             gens = sorted({d.topology.generation.name for d in all_doms})
+        # Chips of already-bound members participate in the collective and
+        # must count toward the split's score.
+        bound_by_dom: dict[str, set[Coord]] = {}
+        for p in bound:
+            bdom = state.domain_of_node(p["spec"]["nodeName"])
+            grp = p["metadata"].get("annotations", {}).get(ko.ANN_GROUP)
+            if bdom is not None and grp:
+                bound_by_dom.setdefault(bdom.slice_id, set()).update(
+                    ko.ann_to_coords(grp))
         for gen in gens:
             gen_doms = [d for d in all_doms
                         if d.topology.generation.name == gen]
+            cost = self.config.cost_model(gen)
+            plan_cache: dict[tuple[str, int], dict[str, Placement] | None] = {}
+
+            def plan_for(dom, m: int):
+                key = (dom.slice_id, m)
+                if key not in plan_cache:
+                    plan_cache[key] = self._plan_gang(state, dom, m, k, exclude)
+                return plan_cache[key]
 
             def max_feasible(dom) -> int:
                 for m in range(min(remaining, len(dom.node_by_host)), 0, -1):
-                    if self._plan_gang(state, dom, m, k, exclude) is not None:
+                    if plan_for(dom, m) is not None:
                         return m
                 return 0
 
             capacity = {d.slice_id: max_feasible(d) for d in gen_doms}
-            gen_doms.sort(key=lambda d: (-capacity[d.slice_id], d.slice_id))
-            plans: dict[str, Placement] = {}
-            rem = remaining
-            for dom in gen_doms:
-                if rem == 0:
-                    break
-                m = min(rem, capacity[dom.slice_id])
-                if m <= 0:
-                    continue
-                sub = self._plan_gang(state, dom, m, k, exclude)
-                if sub is not None:
+            doms = [d for d in gen_doms if capacity[d.slice_id] > 0]
+            if sum(capacity[d.slice_id] for d in doms) < remaining:
+                continue
+            best_key: tuple | None = None
+            best_plans: dict[str, Placement] | None = None
+
+            def consider(split: list[tuple]) -> None:
+                nonlocal best_key, best_plans
+                plans: dict[str, Placement] = {}
+                chips_by_dom: dict[str, set[Coord]] = {
+                    sid: set(cs) for sid, cs in bound_by_dom.items()}
+                topo_by_dom = {d.slice_id: d.topology for d in gen_doms}
+                for dom, m in split:
+                    sub = plan_for(dom, m)
+                    if sub is None:
+                        return
                     plans.update(sub)
-                    rem -= m
-            if rem == 0:
+                    chips_by_dom.setdefault(dom.slice_id, set()).update(
+                        c for p in sub.values() for c in p.chips)
+                score = predict_multidomain_allreduce_gbps(
+                    [(topo_by_dom[sid], frozenset(cs))
+                     for sid, cs in sorted(chips_by_dom.items())
+                     if sid in topo_by_dom],
+                    cost,
+                )
+                # Ties: fewer domains (shorter DCN ring), then deterministic.
+                key = (-score, len(chips_by_dom),
+                       tuple(sorted(sid for sid, _ in
+                                    ((d.slice_id, m) for d, m in split))))
+                if best_key is None or key < best_key:
+                    best_key, best_plans = key, plans
+
+            # Budget bounds the search on pathological states (many domains
+            # x large gangs).  Enumeration goes largest-m-first per domain,
+            # so the earliest splits visited include the old greedy plan —
+            # exhausting the budget degrades to greedy-or-better, never
+            # worse.
+            budget = [512]
+
+            def compositions(idx: int, rem: int, acc: list[tuple]) -> None:
+                if rem == 0:
+                    if budget[0] > 0:
+                        budget[0] -= 1
+                        consider(acc)
+                    return
+                if idx >= len(doms) or budget[0] <= 0:
+                    return
+                dom = doms[idx]
+                tail_cap = sum(capacity[d.slice_id] for d in doms[idx + 1:])
+                lo = max(0, rem - tail_cap)
+                for m in range(min(rem, capacity[dom.slice_id]), lo - 1, -1):
+                    compositions(idx + 1, rem - m,
+                                 acc + ([(dom, m)] if m else []))
+
+            compositions(0, remaining, [])
+            if best_plans is not None:
                 self.metrics.inc("gang_multislice_plans")
-                return ctx(plans)
+                return ctx(best_plans)
         return None
 
     def _score_gang_node(self, gang_ctx: dict | None, node_name: str) -> int:
@@ -351,8 +414,23 @@ class ExtenderScheduler:
         # compactly so the hosts still free for later members remain a
         # connected region (lexicographic "node-1" < "node-10" < "node-2"
         # ordering fragments the grid mid-gang).
-        rank = gang_ctx["order"].index(node_name)
-        return max(1, MAX_PRIORITY - rank)
+        #
+        # Ranks scale into [1, MAX_PRIORITY] across the whole plan instead
+        # of clamping at MAX_PRIORITY - rank (which saturated to all-ties
+        # past 10 members): rank 0 is always strictly highest, so gangs of
+        # any size bind in host-box order under max-score-first selection
+        # (each bind re-plans, so only the front of the order must win).
+        order = gang_ctx["order"]
+        n = len(order)
+        if n <= 1:
+            return MAX_PRIORITY
+        rank = order.index(node_name)
+        if rank == 0:
+            return MAX_PRIORITY
+        # ceil keeps every rank > 0 strictly below MAX_PRIORITY at any gang
+        # size (round() re-ties rank 1 with rank 0 from n=19 up).
+        return max(1, MAX_PRIORITY - math.ceil(rank * (MAX_PRIORITY - 1)
+                                               / (n - 1)))
 
     # ---- bind --------------------------------------------------------------
 

@@ -310,10 +310,62 @@ class Allocator:
     def largest_free_box(self) -> tuple[int, tuple[int, ...]] | None:
         """(volume, dims) of the largest free axis-aligned box — the
         fragmentation health metric (analog of Gaia's fragment-node count,
-        Gaia PDF §III.B)."""
+        Gaia PDF §III.B).
+
+        Cost is bounded: one sliding-window sum per candidate dims tuple
+        (prod(topo.dims) tuples, each O(grid) via cumsum) instead of the
+        former volume-descending rescan of every shape x origin, which did
+        unbounded work on large toruses (/state served this per hit)."""
+        import numpy as np
+
         free = self.free
-        for k in range(len(free), 0, -1):
-            for shape in enumerate_shapes(self.topo, k, self.cost):
-                if enumerate_placements(self.topo, shape, free, self.cost):
-                    return k, shape.dims
-        return None
+        if not free:
+            return None
+        topo = self.topo
+        grid = np.zeros(topo.dims, dtype=np.int32)
+        for c in free:
+            grid[c] = 1
+        # Tile wrapped axes 2x so seam-crossing boxes appear as plain
+        # windows; valid origins stay within the first period.
+        tiled = grid
+        for ax, w in enumerate(topo.wrap):
+            if w and topo.dims[ax] > 1:
+                tiled = np.concatenate([tiled, tiled], axis=ax)
+
+        def window_sums(arr: np.ndarray, dims: tuple[int, ...]) -> np.ndarray:
+            for ax, d in enumerate(dims):
+                c = np.cumsum(arr, axis=ax)
+                pad = np.zeros_like(np.take(c, [0], axis=ax))
+                c = np.concatenate([pad, c], axis=ax)
+                lead = np.take(c, range(d, c.shape[ax]), axis=ax)
+                lag = np.take(c, range(0, c.shape[ax] - d), axis=ax)
+                arr = lead - lag
+            return arr
+
+        feasible: list[tuple[int, ...]] = []
+        axis_ranges = [range(1, d + 1) for d in topo.dims]
+        dims_candidates: list[tuple[int, ...]] = [()]
+        for r in axis_ranges:
+            dims_candidates = [d + (i,) for d in dims_candidates for i in r]
+        for dims in dims_candidates:
+            ws = window_sums(tiled, dims)
+            # Restrict to origins in the first period / open-axis bounds.
+            sl = tuple(
+                slice(0, topo.dims[ax] if (topo.wrap[ax] and topo.dims[ax] > 1
+                                           and dims[ax] < topo.dims[ax])
+                      else topo.dims[ax] - dims[ax] + 1)
+                for ax in range(len(dims))
+            )
+            region = ws[sl]
+            if region.size and int(region.max()) == math.prod(dims):
+                feasible.append(dims)
+        if not feasible:
+            return None
+        best_k = max(math.prod(d) for d in feasible)
+        # Among max-volume shapes, keep enumerate_shapes' preference order
+        # (best predicted bandwidth, then standard vocabulary, then compact).
+        order = {s.dims: i for i, s in
+                 enumerate(enumerate_shapes(topo, best_k, self.cost))}
+        winners = [d for d in feasible if math.prod(d) == best_k]
+        winners.sort(key=lambda d: order.get(d, len(order)))
+        return best_k, winners[0]

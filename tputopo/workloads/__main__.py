@@ -71,14 +71,18 @@ def cmd_train(args) -> int:
     # reduce loss — fresh random batches each step need not.
     tokens = jnp.asarray(rng.integers(0, config.vocab_size, (batch, args.seq)))
     losses = []
+    last_saved = None
     for i in range(args.steps):
         state, loss = step(state, tokens)
         losses.append(float(loss))
         if args.ckpt_dir and args.save_every and (i + 1) % args.save_every == 0:
             from tputopo.workloads import checkpoint as ckptlib
 
-            ckptlib.save(args.ckpt_dir, state)
-    if args.ckpt_dir:
+            last_saved = ckptlib.save(args.ckpt_dir, state)
+    # Final save — but not when the in-loop save already wrote this exact
+    # step (orbax refuses to overwrite an existing step_N directory, which
+    # would fail the pod after a fully successful run).
+    if args.ckpt_dir and last_saved != int(state.step):
         from tputopo.workloads import checkpoint as ckptlib
 
         ckptlib.save(args.ckpt_dir, state)
