@@ -1,36 +1,104 @@
-"""Device-plugin CLI: probe the local host and emit what would be published.
+"""Device-plugin CLI — the node agent entry point.
 
-``python -m tputopo.deviceplugin`` runs the discovery shim (native
-libtputopo.so when built, pure-Python twin otherwise) and prints the node
-annotations + device list the plugin registers with the kubelet — the
-dry-run half of the bring-up flow (SURVEY.md §3.1).  Use
+``python -m tputopo.deviceplugin`` probes the local host through the
+discovery shim (native libtputopo.so when built, pure-Python twin
+otherwise) and prints the node annotations + device list — the dry-run
+half of the bring-up flow (SURVEY.md §3.1).  Use
 ``TPUTOPO_FAKE="v5p:2x2x4@0"`` on a box without TPUs.
 
-In-cluster serving wires :class:`tputopo.deviceplugin.plugin.TpuDevicePlugin`
-to the kubelet's device-plugin socket; the transport in this repo is the
-in-process :class:`tputopo.deviceplugin.api.FakeKubelet` (the image has no
-grpcio — see deviceplugin/api.py for the gRPC surface to bind).
+``--serve`` runs the real node agent (design.md:57-86, 237-246):
+
+1. publish topology annotations onto this Node via the API server
+   (in-cluster service account, or ``--api-server`` for dev clusters);
+2. bind the ``v1beta1.DevicePlugin`` gRPC service on a unix socket under
+   the kubelet device-plugin dir and Register with the kubelet
+   (grpc_transport.py; requires grpcio — in the tputopo[grpc] extra);
+3. heartbeat: re-probe every ``--interval`` seconds; probe degradation
+   flips every chip Unhealthy (streamed to the kubelet AND re-published
+   as node annotations so the extender stops placing here — the
+   health->scheduler loop); recovery flips them back; a topology change
+   re-publishes annotations.
+
+Without a kubelet socket (dev box) the agent still publishes annotations
+and heartbeats — the scheduling plane is fully testable against it; only
+the container-wiring leg needs the kubelet.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import time
+
+
+def _make_api_server(args):
+    """In-cluster service-account client, --api-server URL, or an
+    in-process fake (pure dry-run)."""
+    if args.api_server:
+        from tputopo.k8s.client import KubeApiClient
+        return KubeApiClient(base_url=args.api_server), args.api_server
+    if os.environ.get("KUBERNETES_SERVICE_HOST"):
+        from tputopo.k8s.client import KubeApiClient
+        return KubeApiClient(), "in-cluster"
+    from tputopo.k8s.fakeapi import FakeApiServer
+    return FakeApiServer(), "fake (dry-run)"
+
+
+def _make_kubelet(args, in_cluster: bool):
+    """In-cluster the kubelet leg is mandatory: wait for kubelet.sock (node
+    bootstrap / kubelet restart) and fail loudly on timeout so the
+    DaemonSet restarts us — silently downgrading to the in-process fake
+    while still publishing schedulable annotations would strand every pod
+    the extender places here.  Dev boxes (fake API server) run
+    annotations-only without a socket."""
+    from tputopo.deviceplugin import grpc_transport as gt
+    kubelet_sock = os.path.join(args.kubelet_dir, gt.KUBELET_SOCKET)
+    deadline = time.monotonic() + args.kubelet_wait
+    while not os.path.exists(kubelet_sock):
+        if not in_cluster:
+            from tputopo.deviceplugin.api import FakeKubelet
+            return FakeKubelet(), "none (annotations-only dev mode)"
+        if time.monotonic() >= deadline:
+            raise RuntimeError(
+                f"kubelet socket {kubelet_sock} did not appear within "
+                f"{args.kubelet_wait}s")
+        time.sleep(1.0)
+    try:
+        import grpc  # noqa: F401
+    except ImportError:
+        if in_cluster:
+            raise RuntimeError(
+                "kubelet socket present but grpcio missing; install the "
+                "tputopo[grpc] extra in the node-agent image") from None
+        from tputopo.deviceplugin.api import FakeKubelet
+        return FakeKubelet(), "none (annotations-only dev mode)"
+    return gt.GrpcKubelet(kubelet_dir=args.kubelet_dir), kubelet_sock
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(
         prog="tputopo-device-plugin",
-        description="TPU topology discovery + node-annotation dry run")
-    ap.add_argument("--node-name", default="local")
-    ap.add_argument("--slice-id", default="slice-local")
+        description="TPU topology node agent (probe, annotate, serve kubelet)")
+    ap.add_argument("--node-name",
+                    default=os.environ.get("NODE_NAME", "local"))
+    ap.add_argument("--slice-id",
+                    default=os.environ.get("TPU_SLICE_ID", "slice-local"))
     ap.add_argument("--native", action="store_true",
                     help="require the native libtputopo.so probe (no fallback)")
     ap.add_argument("--serve", action="store_true",
-                    help="keep running, re-probing device health every "
-                         "--interval seconds (in-cluster mode)")
+                    help="run the node agent: annotate, serve the kubelet "
+                         "device-plugin socket, heartbeat health")
     ap.add_argument("--interval", type=float, default=30.0)
+    ap.add_argument("--kubelet-dir", default="/var/lib/kubelet/device-plugins")
+    ap.add_argument("--kubelet-wait", type=float, default=300.0,
+                    help="seconds to wait for kubelet.sock in-cluster")
+    ap.add_argument("--api-server", default=None,
+                    help="API server base URL (default: in-cluster config, "
+                         "else an in-process fake)")
+    ap.add_argument("--max-iterations", type=int, default=0,
+                    help="stop the serve loop after N heartbeats (tests)")
     args = ap.parse_args()
 
     from tputopo.discovery import shim
@@ -48,26 +116,75 @@ def main() -> int:
     out = {
         "backend": probe.backend,
         "node": args.node_name,
-        "annotations": node_annotations_for_probe(probe, args.slice_id),
+        "annotations": node_annotations_for_probe(probe, args.slice_id,
+                                                  drop_none=True),
         "devices": [c for c in probe.chips],
     }
     print(json.dumps(out, indent=2))
-    if args.serve:
-        # In-cluster serving loop: re-probe on an interval so device-file
-        # disappearance surfaces as a health flip.  The kubelet gRPC leg
-        # binds through deviceplugin/api.py's transport surface; this image
-        # carries no grpcio, so the loop is the health heartbeat scaffold.
-        import time
-        while True:
+    if not args.serve:
+        return 0
+
+    from tputopo.deviceplugin.plugin import TpuDevicePlugin, coord_id
+
+    api_server, api_desc = _make_api_server(args)
+    in_cluster = api_desc != "fake (dry-run)"
+    kubelet, kubelet_desc = _make_kubelet(args, in_cluster)
+    plugin = TpuDevicePlugin(
+        node_name=args.node_name, slice_id=args.slice_id,
+        kubelet=kubelet, api_server=api_server, probe=probe)
+
+    degraded = False
+    iterations = 0
+    all_ids = [coord_id(c["coords"]) for c in probe.chips]
+    from tputopo.deviceplugin import grpc_transport as gt
+    own_sock = os.path.join(args.kubelet_dir, f"tputopo-{args.node_name}.sock")
+    try:
+        # Inside the try: a failed registration must still stop the gRPC
+        # server's non-daemon threads, or the process hangs instead of
+        # crash-looping visibly.
+        plugin.start()
+        print(json.dumps({"event": "serving", "api_server": api_desc,
+                          "kubelet": str(kubelet_desc)}), flush=True)
+        while args.max_iterations <= 0 or iterations < args.max_iterations:
             time.sleep(args.interval)
+            iterations += 1
+            if isinstance(kubelet, gt.GrpcKubelet) and not os.path.exists(own_sock):
+                # Kubelet restarted and wiped the device-plugin dir: the
+                # v1beta1 contract expects plugins to re-register.  Exit so
+                # the DaemonSet restarts us into a fresh registration.
+                print(json.dumps({"event": "kubelet-restarted"}),
+                      file=sys.stderr, flush=True)
+                return 4
             fresh = shim.probe_host()
             if not fresh.ok:
-                print(f"probe degraded: {fresh.error}", file=sys.stderr)
-            elif fresh.chips != probe.chips:
+                if not degraded:
+                    # Probe lost the chips: everything on this node is
+                    # unschedulable until it recovers — one frame, one patch.
+                    plugin.set_health_batch(all_ids, healthy=False)
+                    degraded = True
+                    print(json.dumps({"event": "probe-degraded",
+                                      "error": fresh.error}), file=sys.stderr,
+                          flush=True)
+                continue
+            if degraded:
+                plugin.set_health_batch(all_ids, healthy=True)
+                degraded = False
+                print(json.dumps({"event": "probe-recovered"}), flush=True)
+            if fresh.chips != probe.chips:
+                # Topology changed under us (re-cabling, chip swap):
+                # restart the agent cleanly rather than serve a stale
+                # device list — the DaemonSet restarts the pod and
+                # re-registration follows.
                 print(json.dumps({"event": "topology-changed",
-                                  "devices": list(fresh.chips)}))
-                probe = fresh
-    return 0
+                                  "devices": list(fresh.chips)}), flush=True)
+                return 3
+        return 0
+    finally:
+        # The gRPC server holds non-daemon threads; without this the
+        # process never exits after the loop ends or a signal lands.
+        stop = getattr(kubelet, "stop", None)
+        if stop is not None:
+            stop()
 
 
 if __name__ == "__main__":

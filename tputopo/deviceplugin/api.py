@@ -3,9 +3,9 @@
 Python-side types for the contract in ``deviceplugin.proto`` (the kubelet
 device-plugin gRPC shape the reference design uses, design.md:57-59).  The
 transport is pluggable: :class:`FakeKubelet` drives the same Register /
-ListAndWatch / Allocate state machine in-process, which is how the whole
-node-agent plane tests without a cluster (SURVEY.md §4.4 — kind/envtest is
-only needed for the final real-kubelet leg).
+ListAndWatch / Allocate state machine in-process (how most tests stage
+clusters), and :mod:`tputopo.deviceplugin.grpc_transport` drives it over
+the real kubelet unix-socket gRPC wire.
 """
 
 from __future__ import annotations

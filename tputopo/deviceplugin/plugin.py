@@ -68,9 +68,8 @@ class TpuDevicePlugin:
 
     def start(self) -> None:
         """Publish topology annotations, then register with the kubelet."""
-        anns = node_annotations_for_probe(self.probe, self.slice_id)
         try:
-            self.api_server.patch_annotations("nodes", self.node_name, anns)
+            self._publish_annotations()
             # Real clusters always have a pre-existing Node (kubelet creates
             # it); the quota-classing label must land on this path too.
             self.api_server.patch_labels(
@@ -100,14 +99,38 @@ class TpuDevicePlugin:
     def devices(self) -> list[api.Device]:
         return [api.Device(id=cid, health=h) for cid, h in sorted(self._health.items())]
 
+    def _unhealthy_ids(self) -> tuple[str, ...]:
+        return tuple(cid for cid, h in sorted(self._health.items())
+                     if h != api.HEALTHY)
+
+    def _publish_annotations(self) -> None:
+        self.api_server.patch_annotations(
+            "nodes", self.node_name,
+            node_annotations_for_probe(self.probe, self.slice_id,
+                                       unhealthy=self._unhealthy_ids()))
+
     def set_health(self, chip_id: str, healthy: bool) -> None:
-        """Flip a chip's health and push a ListAndWatch update — the failure
-        detection surface (SURVEY.md §5.3: device health is the only
-        resilience stream the reference defines)."""
-        if chip_id not in self._health:
-            raise KeyError(f"unknown chip {chip_id}")
-        self._health[chip_id] = api.HEALTHY if healthy else api.UNHEALTHY
+        """Flip one chip's health: push a ListAndWatch update (the kubelet's
+        view, design.md:84-86) AND re-publish node annotations (the
+        scheduler's view) — without the second leg the extender would keep
+        planning placements onto a chip the plugin knows is dead."""
+        self.set_health_batch([chip_id], healthy)
+
+    def set_health_batch(self, chip_ids, healthy: bool) -> None:
+        """Flip many chips in one ListAndWatch frame + one annotation patch
+        (a whole-host probe loss is N flips; N patches would multiply
+        API-server write load N-fold per transition)."""
+        unknown = [c for c in chip_ids if c not in self._health]
+        if unknown:
+            raise KeyError(f"unknown chips {unknown}")
+        mark = api.HEALTHY if healthy else api.UNHEALTHY
+        for c in chip_ids:
+            self._health[c] = mark
         self.kubelet.notify_devices(self.devices())
+        try:
+            self._publish_annotations()
+        except NotFound:
+            pass  # node object gone (drain/delete); nothing to report to
 
     def allocate(self, req: api.AllocateRequest) -> api.AllocateResponse:
         responses = []

@@ -17,11 +17,18 @@ from tputopo.k8s import objects as ko
 from tputopo.topology.model import format_topology
 
 
-def node_annotations_for_probe(probe: HostProbe, slice_id: str) -> dict[str, str]:
+def node_annotations_for_probe(probe: HostProbe, slice_id: str,
+                               unhealthy: tuple[str, ...] = (),
+                               drop_none: bool = False) -> dict[str, str]:
+    """``unhealthy`` is chip-coordinate-id strings ("0,0,1") of this node's
+    dead chips; the annotation is *deleted* (None) when all are healthy so
+    absence stays the common-case encoding.  ``drop_none=True`` strips the
+    delete markers — for create/display contexts where a literal null
+    annotation would be emitted instead of a deletion."""
     if not probe.ok:
         raise ValueError(f"cannot report a failed probe: {probe.error}")
     topo = probe.topology()
-    return {
+    anns = {
         ko.ANN_TOPOLOGY: format_topology(topo),
         ko.ANN_HOST_COORD: ",".join(str(x) for x in probe.host_coord),
         ko.ANN_CHIPS: json.dumps(
@@ -32,12 +39,17 @@ def node_annotations_for_probe(probe: HostProbe, slice_id: str) -> dict[str, str
             separators=(",", ":"),
         ),
         ko.ANN_SLICE_ID: slice_id,
+        ko.ANN_UNHEALTHY: ";".join(sorted(unhealthy)) if unhealthy else None,
         ko.ANN_TOPOLOGY_HUMAN: (
             f"{topo.describe()}; this host {probe.host_coord} owns "
             f"{len(probe.chips)} chips "
             f"{[tuple(c['coords']) for c in probe.chips]}"
+            + (f"; UNHEALTHY: {sorted(unhealthy)}" if unhealthy else "")
         ),
     }
+    if drop_none:
+        return {k: v for k, v in anns.items() if v is not None}
+    return anns
 
 
 def node_object_for_probe(probe: HostProbe, node_name: str, slice_id: str) -> dict:
@@ -48,5 +60,5 @@ def node_object_for_probe(probe: HostProbe, node_name: str, slice_id: str) -> di
         node_name,
         chips=len(probe.chips),
         labels={ko.ANN_GENERATION_LABEL: probe.generation},
-        annotations=node_annotations_for_probe(probe, slice_id),
+        annotations=node_annotations_for_probe(probe, slice_id, drop_none=True),
     )

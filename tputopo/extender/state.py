@@ -61,6 +61,11 @@ class SliceDomain:
     assignments: list[PodAssignment] = field(default_factory=list)
     conflicts: list[PodAssignment] = field(default_factory=list)
     expired: list[PodAssignment] = field(default_factory=list)
+    # Dead chips (node-reported health, ANN_UNHEALTHY) and the live
+    # assignments whose groups overlap them — the scheduler half of the
+    # health loop: never place onto these, surface who is stranded on them.
+    unhealthy: set[Coord] = field(default_factory=set)
+    on_unhealthy: list[PodAssignment] = field(default_factory=list)
 
     def node_of_chip(self, chip: Coord) -> str | None:
         host = self.topology.host_of(chip)
@@ -117,6 +122,10 @@ class ClusterState:
                 tuple(int(x) for x in c["id"].split(","))
                 for c in json.loads(anns.get(ko.ANN_CHIPS, "[]"))
             ]
+            valid = set(dom.topology.chips)
+            dom.unhealthy.update(
+                c for c in ko.ann_to_coords(anns.get(ko.ANN_UNHEALTHY, ""))
+                if c in valid)  # a bogus coord must not wedge sync
 
         now = self.clock()
         valid_chips = {sid: set(dom.topology.chips)
@@ -166,6 +175,16 @@ class ClusterState:
                 self.conflicts.append(pa)
                 dom.conflicts.append(pa)
             dom.allocator.mark_used(fresh)
+            if any(c in dom.unhealthy for c in pa.chips):
+                # Running (or promised) on silicon the node now reports
+                # dead — surfaced for the job controller; chips stay
+                # accounted to the pod until it is deleted/re-placed.
+                dom.on_unhealthy.append(pa)
+        # Dead chips are not placeable: mark the remainder used so no
+        # selector, gang plan, or k=1 pick can touch them.
+        for dom in self.domains.values():
+            dom.allocator.mark_used(
+                [c for c in dom.unhealthy if c not in dom.allocator.used])
         return self
 
     def _domain_of_node(self, node_name: str) -> SliceDomain | None:
@@ -200,6 +219,12 @@ class ClusterState:
                 "expired_assumptions": len(dom.expired),
                 "conflicting_assignments": [
                     f"{pa.namespace}/{pa.pod_name}" for pa in dom.conflicts
+                ],
+                "unhealthy_chips": sorted(map(list, dom.unhealthy)),
+                "assignments_on_unhealthy": [
+                    {"pod": f"{pa.namespace}/{pa.pod_name}",
+                     "gang": pa.gang_id}
+                    for pa in dom.on_unhealthy
                 ],
             }
         return out

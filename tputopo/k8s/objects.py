@@ -30,6 +30,12 @@ ANN_SLICE_ID = "tpu.dev/slice-id"          # ICI domain id; nodes sharing it sha
 ANN_TOPOLOGY_HUMAN = "tpu.dev/topology-human"  # human-readable observability surface
 ANN_GENERATION_LABEL = "tpu.dev/generation"    # node label for quota classing
                                                # (Gaia heterogeneous quota, PDF §III.A)
+ANN_UNHEALTHY = "tpu.dev/unhealthy-chips"      # this node's dead chips ("0,0,0;0,1,0");
+                                               # absent == all healthy.  Closes the
+                                               # health->scheduler loop: the device
+                                               # plugin's health stream (design.md:84-86)
+                                               # must reach cluster state, or the
+                                               # extender plans onto dead silicon.
 
 # -- Pod annotations: the optimistic assignment handshake
 #    (design.md:227-232: ALIYUN_COM_GPU_GROUP / ASSUME_TIME / ASSIGNED).
