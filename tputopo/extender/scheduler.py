@@ -99,21 +99,57 @@ def _gang_of(pod: dict) -> tuple[str, str, int] | None:
 class ExtenderScheduler:
     def __init__(self, api_server: FakeApiServer,
                  config: ExtenderConfig | None = None,
-                 clock=time.time) -> None:
+                 clock=time.time, informer=None) -> None:
         self.api = api_server
         self.config = config or ExtenderConfig()
         self.clock = clock
+        # Optional list+watch cache (k8s/informer.py).  When present and
+        # synced, `sort` builds its state from the cache — zero LISTs
+        # against the API server in steady state (the nodeCacheCapable
+        # posture, design.md:102).  `bind` always re-syncs authoritatively.
+        self.informer = informer
         self.metrics = Metrics()
         self.decisions: list[dict] = []  # recent decision records (observability)
         self._cached_state: ClusterState | None = None
         self._cached_at: float = 0.0
+        self._cached_informer_version: tuple[str, ...] | None = None
         # bind's sync -> select -> patch sequence is not atomic; the HTTP
         # server is threaded, so serialize binds process-wide.  (The
         # kube-scheduler also serializes binds per cycle — this is defense
         # in depth for direct API users and a future multi-verb world.)
         self._bind_lock = threading.Lock()
 
-    def _state(self, allow_cache: bool = False) -> ClusterState:
+    # Even with an unchanged informer mirror, a derived state cannot be
+    # reused forever: assumption-TTL expiry is judged by the clock at sync
+    # time, not by watch events.  5 s keeps worst-case expiry staleness far
+    # under the 60 s assume TTL while still absorbing sort bursts.
+    _INFORMER_STATE_MAX_AGE_S = 5.0
+
+    def _state(self, allow_cache: bool = False, reader=None) -> ClusterState:
+        if allow_cache and reader is not None:
+            # Cache-backed sync: ClusterState reads the informer's local
+            # mirror through the same list() surface — no API-server LISTs.
+            # Rebuild only when the mirror changed (rv token) or the derived
+            # state aged past the expiry-staleness bound; a sort burst
+            # otherwise reuses one build.
+            version = reader.version()
+            if (self._cached_state is not None
+                    and self._cached_informer_version == version
+                    and self.clock() - self._cached_at
+                        < self._INFORMER_STATE_MAX_AGE_S):
+                self.metrics.inc("state_cache_hits")
+                return self._cached_state
+            self.metrics.inc("state_from_informer")
+            state = ClusterState(
+                reader,
+                cost_for_generation=self.config.cost_model,
+                assume_ttl_s=self.config.assume_ttl_s,
+                clock=self.clock,
+            ).sync()
+            self._cached_state = state
+            self._cached_at = self.clock()
+            self._cached_informer_version = version
+            return state
         ttl = self.config.state_cache_s
         if (allow_cache and ttl > 0 and self._cached_state is not None
                 and self.clock() - self._cached_at < ttl):
@@ -127,6 +163,7 @@ class ExtenderScheduler:
         ).sync()
         self._cached_state = state
         self._cached_at = self.clock()
+        self._cached_informer_version = None  # not an informer-coherent build
         return state
 
     # ---- sort (Prioritize) -------------------------------------------------
@@ -139,7 +176,13 @@ class ExtenderScheduler:
         """
         t0 = time.perf_counter()
         self.metrics.inc("sort_requests")
-        state = self._state(allow_cache=True)
+        # Decide the read source ONCE: state sync and gang-member lookup
+        # must see the same view (cache during sort, API during bind) — a
+        # second synced check could flip between the two reads if a Gone
+        # clears the informer mid-sort.
+        informer_reader = (self.informer if self.informer is not None
+                           and self.informer.synced else None)
+        state = self._state(allow_cache=True, reader=informer_reader)
         k = ko.pod_requested_chips(pod)
         gang = _gang_of(pod)
         wanted_gen = _wanted_generation(pod)
@@ -147,7 +190,9 @@ class ExtenderScheduler:
         if k > 0 and gang is not None:
             # One plan per sort request — the plan depends only on state and
             # the gang, never on the candidate node being scored.
-            gang_ctx = self._gang_context(state, gang, k, wanted_gen)
+            gang_ctx = self._gang_context(
+                state, gang, k, wanted_gen,
+                reader=informer_reader or self.api)
         out = []
         for name in node_names:
             score = 0
@@ -200,8 +245,9 @@ class ExtenderScheduler:
 
     # ---- gang planning -----------------------------------------------------
 
-    def _gang_members(self, namespace: str, gang_id: str) -> list[dict]:
-        return self.api.list(
+    def _gang_members(self, namespace: str, gang_id: str,
+                      reader=None) -> list[dict]:
+        return (reader or self.api).list(
             "pods",
             lambda p: (
                 p["metadata"].get("namespace", "default") == namespace
@@ -253,7 +299,8 @@ class ExtenderScheduler:
         return False
 
     def _gang_context(self, state: ClusterState, gang: tuple[str, str, int],
-                      k: int, wanted_gen: str | None = None) -> dict | None:
+                      k: int, wanted_gen: str | None = None,
+                      reader=None) -> dict | None:
         """Remaining-member plan for a gang, given already-bound members.
 
         Returns {"plan": {node: Placement}, "order": [node, ...]} or None
@@ -262,7 +309,7 @@ class ExtenderScheduler:
         across domains (replica sync rides DCN between slices) when no
         single domain has room."""
         namespace, gang_id, size = gang
-        members = self._gang_members(namespace, gang_id)
+        members = self._gang_members(namespace, gang_id, reader=reader)
         bound = [p for p in members if p["spec"].get("nodeName")]
         remaining = size - len(bound)
         if remaining <= 0:

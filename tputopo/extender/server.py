@@ -194,7 +194,12 @@ def main() -> None:  # pragma: no cover - thin CLI wrapper
         from tputopo.k8s.fakeapi import FakeApiServer
 
         api_server = FakeApiServer()
-    scheduler = ExtenderScheduler(api_server, config)
+    # List+watch cache: sort serves from this mirror (zero LISTs per verb
+    # in steady state); bind still re-syncs authoritatively.
+    from tputopo.k8s.informer import Informer
+
+    informer = Informer(api_server).start()
+    scheduler = ExtenderScheduler(api_server, config, informer=informer)
     server = ExtenderHTTPServer(scheduler, config, host=args.host)
 
     from tputopo.extender.gc import AssumptionGC

@@ -24,10 +24,11 @@ import json
 import os
 import ssl
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Callable
 
-from tputopo.k8s.fakeapi import Conflict, NotFound
+from tputopo.k8s.fakeapi import Conflict, Gone, NotFound
 
 _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -108,14 +109,88 @@ class KubeApiClient:
     def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
         return self._request("GET", self._object_path(kind, name, namespace))
 
-    def list(self, kind: str, selector: Callable[[dict], bool] | None = None) -> list[dict]:
-        out = self._request("GET", self._collection(kind, None)).get("items", [])
+    def list(self, kind: str, selector: Callable[[dict], bool] | None = None,
+             label_selector: dict[str, str] | None = None,
+             chunk_limit: int = 500) -> list[dict]:
+        out, _ = self._list_paged(kind, label_selector, chunk_limit)
         # K8s list items omit kind/apiVersion; metadata is intact, which is
         # all the framework's selectors and consumers read.
         if selector:
             out = [o for o in out if selector(o)]
         return sorted(out, key=lambda o: (o["metadata"].get("namespace", ""),
                                           o["metadata"]["name"]))
+
+    def _list_paged(self, kind: str, label_selector: dict[str, str] | None,
+                    chunk_limit: int) -> tuple[list[dict], str]:
+        """Server-side selector push-down + apiserver chunking (limit /
+        continue) — a cluster-wide pod list no longer transfers every pod
+        when a label selector narrows it, and never in one giant response."""
+        base = self._collection(kind, None)
+        params = []
+        if label_selector:
+            sel = ",".join(f"{k}={v}" for k, v in sorted(label_selector.items()))
+            params.append("labelSelector=" + urllib.parse.quote(sel))
+        if chunk_limit:
+            params.append(f"limit={chunk_limit}")
+        items: list[dict] = []
+        cont = None
+        rv = ""
+        while True:
+            qs = list(params)
+            if cont:
+                qs.append("continue=" + urllib.parse.quote(cont))
+            path = base + ("?" + "&".join(qs) if qs else "")
+            resp = self._request("GET", path)
+            items.extend(resp.get("items", []))
+            meta = resp.get("metadata", {})
+            rv = meta.get("resourceVersion", rv)
+            cont = meta.get("continue")
+            if not cont:
+                return items, rv
+
+    def list_with_version(self, kind: str) -> tuple[list[dict], str]:
+        items, rv = self._list_paged(kind, None, 500)
+        items.sort(key=lambda o: (o["metadata"].get("namespace", ""),
+                                  o["metadata"]["name"]))
+        return items, rv
+
+    def watch(self, kind: str, resource_version: str,
+              timeout_s: float = 30.0):
+        """Stream watch events (``{"type", "object", "rv"}``) for ``kind``
+        from ``resource_version``; returns when the server closes the
+        stream at ``timeoutSeconds``.  HTTP 410 surfaces as
+        :class:`~tputopo.k8s.fakeapi.Gone` (informer relists)."""
+        path = (f"{self._collection(kind, None)}?watch=1"
+                f"&resourceVersion={urllib.parse.quote(resource_version)}"
+                f"&allowWatchBookmarks=true&timeoutSeconds={int(timeout_s)}")
+        url = self.base_url + path
+        req = urllib.request.Request(url, method="GET")
+        req.add_header("Accept", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            resp = urllib.request.urlopen(req, timeout=timeout_s + 10,
+                                          context=self._ctx)
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:500]
+            if e.code == 410:
+                raise Gone(f"watch {kind}@{resource_version}: {detail}") from None
+            raise RuntimeError(f"watch {kind} -> {e.code}: {detail}") from None
+        with resp:
+            for raw in resp:
+                line = raw.strip()
+                if not line:
+                    continue
+                ev = json.loads(line)
+                obj = ev.get("object", {})
+                if ev.get("type") == "ERROR":
+                    # In-stream 410 (expired watch window) arrives as a
+                    # Status object, not an HTTP error.
+                    if obj.get("code") == 410:
+                        raise Gone(f"watch {kind}: {obj.get('message')}")
+                    raise RuntimeError(f"watch {kind} error: {obj}")
+                rv = obj.get("metadata", {}).get("resourceVersion", "")
+                yield {"type": ev.get("type"), "object": obj, "rv": rv}
 
     def delete(self, kind: str, name: str, namespace: str | None = None) -> None:
         self._request("DELETE", self._object_path(kind, name, namespace))

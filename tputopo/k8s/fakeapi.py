@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import copy
 import threading
+import time
 from typing import Any, Callable, Iterable
 
 
@@ -27,8 +28,34 @@ class Conflict(RuntimeError):
     """resourceVersion mismatch — the optimistic-concurrency signal."""
 
 
+class Gone(RuntimeError):
+    """Watch resourceVersion fell off the retained event window (HTTP 410)
+    — the informer must relist."""
+
+
 def _key(namespace: str | None, name: str) -> tuple[str, str]:
     return (namespace or "", name)
+
+
+def parse_label_selector(sel: str) -> dict[str, str]:
+    """``"a=b,c=d"`` -> {"a": "b", "c": "d"} (equality terms only — all the
+    framework uses)."""
+    out = {}
+    for term in sel.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        k, _, v = term.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+def matches_labels(obj: dict, sel: dict[str, str]) -> bool:
+    labels = obj.get("metadata", {}).get("labels", {})
+    return all(labels.get(k) == v for k, v in sel.items())
+
+
+_WATCH_WINDOW = 2048  # retained events; older watch rvs get Gone (410)
 
 
 class FakeApiServer:
@@ -40,12 +67,23 @@ class FakeApiServer:
         }
         self._rv = 0
         self.events: list[dict] = []
+        # Watch machinery: a bounded per-server event log + a condition the
+        # watchers block on.  Event = {"type": ADDED|MODIFIED|DELETED,
+        # "kind": ..., "rv": int, "object": deepcopy-at-emit}.
+        self._watch_log: list[dict] = []
+        self._watch_cond = threading.Condition(self._lock)
 
     # ---- helpers ----------------------------------------------------------
 
     def _bump(self, obj: dict) -> None:
         self._rv += 1
         obj["metadata"]["resourceVersion"] = str(self._rv)
+
+    def _emit(self, type_: str, kind: str, obj: dict) -> None:
+        self._watch_log.append({"type": type_, "kind": kind, "rv": self._rv,
+                                "object": copy.deepcopy(obj)})
+        del self._watch_log[:-_WATCH_WINDOW]
+        self._watch_cond.notify_all()
 
     def _store(self, kind: str) -> dict[tuple[str, str], dict]:
         return self._objects[kind]
@@ -62,6 +100,7 @@ class FakeApiServer:
             copy_ = copy.deepcopy(obj)
             self._bump(copy_)
             store[k] = copy_
+            self._emit("ADDED", kind, copy_)
             return copy.deepcopy(copy_)
 
     def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
@@ -71,20 +110,76 @@ class FakeApiServer:
             except KeyError:
                 raise NotFound(f"{kind} {namespace}/{name}") from None
 
-    def list(self, kind: str, selector: Callable[[dict], bool] | None = None) -> list[dict]:
+    def list(self, kind: str, selector: Callable[[dict], bool] | None = None,
+             label_selector: dict[str, str] | None = None) -> list[dict]:
         with self._lock:
             out = [copy.deepcopy(o) for o in self._store(kind).values()]
+        if label_selector:
+            out = [o for o in out if matches_labels(o, label_selector)]
         if selector:
             out = [o for o in out if selector(o)]
         return sorted(out, key=lambda o: (o["metadata"].get("namespace", ""),
                                           o["metadata"]["name"]))
 
+    def list_with_version(self, kind: str) -> tuple[list[dict], str]:
+        """(items, list resourceVersion) — the informer's initial sync point:
+        a watch from this rv sees exactly the mutations after this list."""
+        with self._lock:
+            out = [copy.deepcopy(o) for o in self._store(kind).values()]
+            rv = str(self._rv)
+        out.sort(key=lambda o: (o["metadata"].get("namespace", ""),
+                                o["metadata"]["name"]))
+        return out, rv
+
+    def watch(self, kind: str, resource_version: str,
+              timeout_s: float = 30.0):
+        """Yield events for ``kind`` with rv > resource_version, blocking up
+        to ``timeout_s`` for new ones; returns on timeout (the caller
+        re-watches from its last seen rv, exactly the K8s watch contract).
+        Raises Gone when resource_version predates the retained window."""
+        try:
+            last = int(resource_version)
+        except (TypeError, ValueError):
+            raise ValueError(f"bad resourceVersion {resource_version!r}") from None
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._watch_cond:
+                if self._watch_log and last < self._watch_log[0]["rv"] - 1:
+                    raise Gone(f"resourceVersion {last} too old "
+                               f"(window starts at {self._watch_log[0]['rv']})")
+                pending = [e for e in self._watch_log
+                           if e["rv"] > last and e["kind"] == kind]
+                if not pending:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        current = self._rv
+                        break  # emit a closing BOOKMARK outside the lock
+                    self._watch_cond.wait(remaining)
+                    continue
+            for e in pending:
+                last = e["rv"]
+                yield {"type": e["type"], "object": copy.deepcopy(e["object"]),
+                       "rv": str(e["rv"])}
+        # Closing BOOKMARK: advances an idle kind's watcher to the current
+        # global rv so churn on the *other* kind can't push its position
+        # out of the retained window (spurious Gone -> relist otherwise).
+        if current > last:
+            yield {"type": "BOOKMARK",
+                   "object": {"metadata": {"resourceVersion": str(current)}},
+                   "rv": str(current)}
+
     def delete(self, kind: str, name: str, namespace: str | None = None) -> None:
         with self._lock:
             try:
-                del self._store(kind)[_key(namespace, name)]
+                obj = self._store(kind).pop(_key(namespace, name))
             except KeyError:
                 raise NotFound(f"{kind} {namespace}/{name}") from None
+            # _bump (not a bare rv increment): the event's object must carry
+            # the delete's own resourceVersion — the REST watch leg derives
+            # its progress from object metadata, and a stale rv there makes
+            # the stream replay the trailing delete forever.
+            self._bump(obj)
+            self._emit("DELETED", kind, obj)
 
     # ---- metadata patches (the handshake's transport) ----------------------
 
@@ -113,6 +208,7 @@ class FakeApiServer:
                 else:
                     anns[k] = str(v)
             self._bump(obj)
+            self._emit("MODIFIED", kind, obj)
             self.events.append({"type": "patch", "kind": kind, "name": name,
                                 "patch": dict(patch)})
             return copy.deepcopy(obj)
@@ -132,6 +228,7 @@ class FakeApiServer:
                 else:
                     labels[k] = str(v)
             self._bump(obj)
+            self._emit("MODIFIED", kind, obj)
             return copy.deepcopy(obj)
 
     # ---- binding (the extender's bind verb target) -------------------------
@@ -147,6 +244,7 @@ class FakeApiServer:
             pod["spec"]["nodeName"] = node_name
             pod["status"]["phase"] = "Scheduled"
             self._bump(pod)
+            self._emit("MODIFIED", "pods", pod)
             self.events.append({"type": "bind", "name": name, "node": node_name})
             return copy.deepcopy(pod)
 
