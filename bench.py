@@ -130,48 +130,285 @@ def bench_ab_gain() -> float:
     return round(stats.mean(t["bw_smart"] / t["bw_naive"] for t in traces), 2)
 
 
-def bench_workload_step() -> dict | None:
-    """Forward-step wall time of the flagship LM on the local accelerator
-    (one real TPU chip under the driver; CPU elsewhere).  Context only."""
+# Peak dense bf16 throughput per chip, by device_kind substring (public
+# spec numbers; the MFU denominator).
+_TPU_PEAK_BF16 = {
+    "v5 lite": 197e12, "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6 lite": 918e12, "v6e": 918e12,
+}
+
+
+def _chip_peak_flops() -> tuple[float | None, str]:
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for sub, peak in _TPU_PEAK_BF16.items():
+        if sub in kind.lower():
+            return peak, kind
+    return None, kind
+
+
+def _fwd_flops(c, batch: int, seq: int) -> float:
+    """Required forward FLOPs (2*m*n*k per matmul; causal attention counted
+    at the half the math actually needs, so a kernel that skips masked
+    blocks is not credited for skipped work)."""
+    D, F, N, KV, Hd, L = (c.d_model, c.d_ff, c.n_heads, c.n_kv_heads,
+                          c.head_dim, c.n_layers)
+    per_tok = L * (
+        2 * D * N * Hd          # wq
+        + 2 * 2 * D * KV * Hd   # wk, wv
+        + 2 * N * Hd * D        # wo
+        + 3 * 2 * D * F         # w_gate, w_up, w_down
+    ) + 2 * D * c.vocab_size    # lm_head
+    attn = L * 2.0 * batch * seq * seq * N * Hd  # QK^T + PV, causal half
+    return per_tok * batch * seq + attn
+
+
+def _measure_fwd_s(config, batch: int, seq: int, *, steps: int = 6,
+                   reps: int = 3, overhead_s: float = 0.0) -> float:
+    """Per-forward-step seconds: ``steps`` forwards chained inside ONE jit
+    call (the tunnel to the chip costs ~70 ms per dispatch — unamortized
+    timing would measure the RPC, not the chip), minus the measured
+    trivial-roundtrip overhead, divided by ``steps``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tputopo.workloads.model import forward, init_params
+
+    params = init_params(config, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    base = jnp.asarray(rng.integers(0, config.vocab_size, (batch, seq)))
+
+    @jax.jit
+    def multi(p, t):
+        def body(acc, i):
+            # Tokens vary per iteration — loop-invariant code motion must
+            # not hoist the forward out of the scan.
+            toks = (t + i) % config.vocab_size
+            return acc + jnp.sum(forward(p, toks, config)
+                                 .astype(jnp.float32)), None
+        acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(steps))
+        return acc
+
+    float(multi(params, base))  # compile
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(multi(params, base))
+        times.append(time.perf_counter() - t0)
+    return max(min(times) - overhead_s, 1e-9) / steps
+
+
+def _measure_dispatch_overhead_s() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    g = jax.jit(jnp.sum)
+    x = jnp.ones((8, 8))
+    float(g(x))
+    return min(float("inf"), *[
+        (lambda t0: (float(g(x)), time.perf_counter() - t0)[1])(time.perf_counter())
+        for _ in range(8)
+    ])
+
+
+def _measure_train_s(config, batch: int, seq: int, *, steps: int = 4,
+                     reps: int = 3, overhead_s: float = 0.0) -> float:
+    """Per-train-step (fwd + bwd, no optimizer) seconds, same chained-jit
+    protocol as :func:`_measure_fwd_s`."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tputopo.workloads.model import forward, init_params
+
+    params = init_params(config, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    base = jnp.asarray(rng.integers(0, config.vocab_size, (batch, seq)))
+
+    def loss_fn(p, toks):
+        logits = forward(p, toks, config)
+        tgt = jnp.roll(toks, -1, axis=1)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, tgt[..., None], axis=-1).mean()
+
+    @jax.jit
+    def multi(p, t):
+        def body(acc, i):
+            toks = (t + i) % config.vocab_size
+            loss, grads = jax.value_and_grad(loss_fn)(p, toks)
+            # Consume EVERY grad leaf — anything unused is dead code the
+            # compiler will prune, silently turning this into a fwd bench.
+            gsum = sum(jnp.sum(g.astype(jnp.float32))
+                       for g in jax.tree.leaves(grads))
+            return acc + loss + gsum, None
+        acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(steps))
+        return acc
+
+    float(multi(params, base))  # compile
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(multi(params, base))
+        times.append(time.perf_counter() - t0)
+    return max(min(times) - overhead_s, 1e-9) / steps
+
+
+def bench_hbm_gbps() -> dict | None:
+    """Measured single-chip HBM copy bandwidth vs the cost model's
+    ``hbm_gbps`` entry for this generation (VERDICT r1 weak #7: the model's
+    numbers were spec-derived, never validated on silicon).  A big-array
+    elementwise op reads + writes HBM once each; achieved bytes/s over 2x
+    the array size approximates stream bandwidth."""
     try:
         import jax
-
-        from tputopo.workloads.model import ModelConfig, forward, init_params
         import jax.numpy as jnp
-        import numpy as np
 
-        config = ModelConfig(vocab_size=2048, d_model=512, n_layers=4,
-                             n_heads=8, n_kv_heads=4, d_ff=1024, max_seq=512,
-                             compute_dtype=jnp.bfloat16)
-        params = init_params(config, jax.random.key(0))
-        rng = np.random.default_rng(0)
-        batches = [jnp.asarray(rng.integers(0, config.vocab_size, (8, 256)))
-                   for _ in range(4)]
-        fn = jax.jit(lambda p, t: forward(p, t, config))
-        fn(params, batches[0]).block_until_ready()  # compile
+        if jax.devices()[0].platform != "tpu":
+            return None
+        n = 512 * 1024 * 1024 // 2  # 512 MB of bf16
+        x = jnp.ones((n,), jnp.bfloat16)
+        steps = 8
+
+        @jax.jit
+        def multi(x):
+            # The full array is the loop carry: every step must read it and
+            # write the next one — a reduction-only body would let XLA skip
+            # the write, and an unused product would be dead code entirely.
+            def body(c, i):
+                return c * (1.0 + 1e-6 * i.astype(jnp.bfloat16)), None
+            y, _ = jax.lax.scan(body, x, jnp.arange(steps))
+            return y[0].astype(jnp.float32)
+
+        float(multi(x))
+        overhead = _measure_dispatch_overhead_s()
         times = []
-        for i in range(12):
+        for _ in range(3):
             t0 = time.perf_counter()
-            # jnp.sum forces a full device round-trip: float() on the result
-            # cannot return before the forward pass actually finished, even
-            # if the platform's block_until_ready is optimistic.
-            float(jnp.sum(fn(params, batches[i % 4])))
+            float(multi(x))
             times.append(time.perf_counter() - t0)
-        t = statistics.median(times)
-        toks = batches[0].size
-        return {
-            "platform": jax.devices()[0].platform,
-            "fwd_step_ms": round(t * 1e3, 3),
-            "fwd_tokens_per_s": round(toks / t),
+        t = max(min(times) - overhead, 1e-9) / steps
+        measured = 2 * n * 2 / t / 1e9  # read + write, bf16 = 2 bytes
+        from tputopo.topology.cost import LinkCostModel
+
+        kind = jax.devices()[0].device_kind.lower()
+        gen = ("v5e" if "v5 lite" in kind or "v5e" in kind
+               else "v6e" if "v6" in kind
+               else "v5p" if "v5" in kind else "v4")
+        model_gbps = LinkCostModel.for_generation(gen).hbm_gbps
+        return {"generation": gen,
+                "measured_hbm_gbps": round(measured, 1),
+                "cost_model_hbm_gbps": model_gbps,
+                "measured_over_model": round(measured / model_gbps, 3)}
+    except Exception as e:  # pragma: no cover
+        print(f"bench: hbm skipped: {type(e).__name__}: {e}", file=sys.stderr)
+        return None
+
+
+def bench_workload_mfu() -> dict | None:
+    """The workload perf story (VERDICT r1 #3): a chip-sized model
+    (~640 M params, seq 2048), achieved TFLOP/s and MFU against the
+    generation's published peak, plus the flash-vs-einsum attention A/B in
+    the same run — forward AND train step (the einsum path's backward must
+    keep the S^2 probabilities of every layer resident, which is where
+    flash is load-bearing rather than a forward-only micro-win).  TPU-only;
+    on other backends returns a small-context number without MFU claims.
+    Never fatal."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from tputopo.workloads.model import ModelConfig
+
+        platform = jax.devices()[0].platform
+        if platform != "tpu":
+            config = ModelConfig(vocab_size=2048, d_model=256, n_layers=2,
+                                 n_heads=8, n_kv_heads=4, d_ff=512,
+                                 max_seq=256, compute_dtype=jnp.bfloat16)
+            t = _measure_fwd_s(config, batch=4, seq=256, steps=2, reps=2)
+            return {"platform": platform, "fwd_step_ms": round(t * 1e3, 3),
+                    "note": "non-TPU context run; no MFU claim"}
+
+        peak, kind = _chip_peak_flops()
+        batch, seq = 8, 2048
+        base = dict(vocab_size=32768, d_model=2048, n_layers=8, n_heads=16,
+                    n_kv_heads=8, d_ff=8192, max_seq=seq,
+                    compute_dtype=jnp.bfloat16)
+        overhead = _measure_dispatch_overhead_s()
+        flash_cfg = ModelConfig(**base, attn_impl="flash")
+        einsum_cfg = ModelConfig(**base, attn_impl="einsum")
+        t_flash = _measure_fwd_s(flash_cfg, batch, seq, overhead_s=overhead)
+        t_einsum = _measure_fwd_s(einsum_cfg, batch, seq, overhead_s=overhead)
+        flops = _fwd_flops(flash_cfg, batch, seq)
+        achieved = flops / t_flash
+        out = {
+            "platform": "tpu",
+            "device_kind": kind,
+            "model": "d2048 L8 ff8192 gqa16/8 vocab32k (~0.64 B params)",
+            "tokens": batch * seq,
+            "fwd_step_ms": round(t_flash * 1e3, 3),
+            "fwd_tokens_per_s": round(batch * seq / t_flash),
+            "achieved_tflops": round(achieved / 1e12, 1),
+            "dispatch_overhead_ms": round(overhead * 1e3, 1),
+            "flash_speedup_vs_einsum": round(t_einsum / t_flash, 3),
+            "einsum_fwd_step_ms": round(t_einsum * 1e3, 3),
         }
+        if peak is not None:
+            out["mfu"] = round(achieved / peak, 3)
+            out["peak_tflops"] = peak / 1e12
+        # Train step (fwd+bwd): flash always; einsum attempted — its
+        # backward keeps every layer's S^2 probabilities resident, so at
+        # this shape it is expected to exhaust HBM, which is the honest
+        # form of the "flash wins" claim.
+        t_train = _measure_train_s(flash_cfg, batch, seq, overhead_s=overhead)
+        train_flops = 3.0 * flops
+        out["train_step_ms"] = round(t_train * 1e3, 3)
+        out["train_tokens_per_s"] = round(batch * seq / t_train)
+        if peak is not None:
+            out["train_mfu"] = round(train_flops / t_train / peak, 3)
+        try:
+            t_train_e = _measure_train_s(einsum_cfg, batch, seq,
+                                         overhead_s=overhead)
+            out["flash_train_speedup_vs_einsum"] = round(t_train_e / t_train, 3)
+            out["einsum_train_step_ms"] = round(t_train_e * 1e3, 3)
+        except Exception as e:
+            out["einsum_train"] = f"failed: {type(e).__name__} (expected OOM)"
+        # Long-context A/B (seq 4096): where the einsum path's S^2 HBM
+        # traffic dominates and the kernel pulls ahead; beyond ~8k the
+        # einsum scores alone exceed HBM and flash is the only path.
+        try:
+            long_seq, long_batch = 4096, 4
+            lbase = dict(base, max_seq=long_seq)
+            tl_flash = _measure_fwd_s(ModelConfig(**lbase, attn_impl="flash"),
+                                      long_batch, long_seq, steps=4,
+                                      overhead_s=overhead)
+            tl_einsum = _measure_fwd_s(ModelConfig(**lbase, attn_impl="einsum"),
+                                       long_batch, long_seq, steps=4,
+                                       overhead_s=overhead)
+            lflops = _fwd_flops(ModelConfig(**lbase), long_batch, long_seq)
+            out["long_seq"] = {
+                "seq": long_seq, "tokens": long_batch * long_seq,
+                "fwd_step_ms": round(tl_flash * 1e3, 3),
+                "einsum_fwd_step_ms": round(tl_einsum * 1e3, 3),
+                "flash_speedup_vs_einsum": round(tl_einsum / tl_flash, 3),
+            }
+            if peak is not None:
+                out["long_seq"]["mfu"] = round(lflops / tl_flash / peak, 3)
+        except Exception as e:
+            out["long_seq"] = f"skipped: {type(e).__name__}"
+        return out
     except Exception as e:  # pragma: no cover - context only, never fatal
-        print(f"bench: workload step skipped: {e}", file=sys.stderr)
+        print(f"bench: workload MFU skipped: {type(e).__name__}: {e}",
+              file=sys.stderr)
         return None
 
 
 def main() -> None:
     sched = bench_scheduler()
-    workload = bench_workload_step()
+    workload = bench_workload_mfu()
     p50 = sched["p50_ms"]
     out = {
         "metric": "scheduler_sort_bind_p50_latency",
@@ -188,6 +425,7 @@ def main() -> None:
             "placement_quality_vs_ideal": sched["quality_vs_ideal"],
             "bandwidth_gain_vs_count_only": bench_ab_gain(),
             "workload_fwd": workload,
+            "hbm": bench_hbm_gbps(),
         },
     }
     print(json.dumps(out))

@@ -109,3 +109,87 @@ def test_forced_flash_not_overridden_by_sp_plan():
                            n_kv_heads=2, d_ff=64, max_seq=32,
                            compute_dtype=jnp.float32)
         assert _ring_plan(auto, (2, 32, 4, 8)) is plan
+
+
+# ---- flash-fused ring (VERDICT r1 #4) ---------------------------------------
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_matches_reference(causal):
+    q, k, v = qkv((2, 128, 4, 8))
+    plan = build_mesh({"dp": 2, "sp": 4, "tp": 1})
+    out = ring_attention(q, k, v, plan, causal=causal, impl="flash")
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+
+
+def test_ring_flash_grads_match_reference():
+    """All three input grads through the hand-written ring backward (a
+    second ring pass running the FlashAttention-2 kernels with the global
+    logsumexp)."""
+    q, k, v = qkv((2, 64, 2, 8))
+    plan = build_mesh({"dp": 2, "sp": 4, "tp": 1})
+
+    def loss_ring(q, k, v):
+        return (ring_attention(q, k, v, plan, causal=True, impl="flash") ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=True) ** 2).sum()
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gr, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5, err_msg=f"d{name}")
+
+
+def test_ring_flash_gqa_narrow_rotation():
+    """GQA: the NARROW K/V rotates; expansion happens per-step at kernel
+    entry and dK/dV reduce back to the narrow groups."""
+    q, _, _ = qkv((2, 64, 4, 8))
+    _, k, v = qkv((2, 64, 2, 8), seed=1)
+    plan = build_mesh({"dp": 2, "sp": 4, "tp": 1})
+    out = ring_attention(q, k, v, plan, causal=True, kv_group=2, impl="flash")
+    ref = reference_attention(q, jnp.repeat(k, 2, axis=2),
+                              jnp.repeat(v, 2, axis=2), causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+
+    def loss_ring(k_):
+        return (ring_attention(q, k_, v, plan, causal=True, kv_group=2,
+                               impl="flash") ** 2).sum()
+
+    def loss_ref(k_):
+        return (reference_attention(q, jnp.repeat(k_, 2, axis=2),
+                                    jnp.repeat(v, 2, axis=2),
+                                    causal=True) ** 2).sum()
+
+    np.testing.assert_allclose(np.asarray(jax.grad(loss_ring)(k)),
+                               np.asarray(jax.grad(loss_ref)(k)),
+                               atol=5e-5, rtol=5e-5)
+
+
+def test_ring_flash_local_block_does_not_materialize_scores():
+    """The long-context claim made honest: at Sc=512 the einsum local block
+    allocates the Sc x Sc f32 score tile per head (4 x 512^2 x 4 B = 4.2 MB
+    per step); the fused path's compiled temp stays block-sized.  Compare
+    XLA's own memory analysis for the two implementations."""
+    S, B, N, H = 4096, 1, 4, 64
+    Sc = S // 8
+    plan = build_mesh({"dp": 1, "sp": 8, "tp": 1})
+    q = jax.ShapeDtypeStruct((B, S, N, H), jnp.float32)
+    temps = {}
+    for impl in ("einsum", "flash"):
+        f = jax.jit(lambda q_, k_, v_, impl=impl: ring_attention(
+            q_, k_, v_, plan, causal=True, impl=impl))
+        m = f.lower(q, q, q).compile().memory_analysis()
+        if m is None:
+            pytest.skip("backend provides no memory analysis")
+        temps[impl] = m.temp_size_in_bytes
+    # Both paths carry the same O(Sc*H) ring state; the einsum path adds
+    # the per-head Sc x Sc f32 score tile.  The fused path's saving must
+    # cover most of that tile (it keeps only O(block^2) score state).
+    score_tile_bytes = B * N * Sc * Sc * 4
+    assert temps["flash"] < temps["einsum"], temps
+    assert temps["einsum"] - temps["flash"] > 0.8 * score_tile_bytes, (
+        temps, score_tile_bytes)

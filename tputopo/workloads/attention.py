@@ -5,7 +5,10 @@ materializes the full [B, N, S, S] score matrix in HBM — O(S^2) memory
 traffic.  These kernels stream K/V blocks through VMEM with the standard
 online-softmax recurrence, keeping the working set at
 O(block_q x block_kv), so long sequences stay HBM-bandwidth-friendly and
-the matmuls stay MXU-shaped (block sizes default to 128, the MXU tile).
+the matmuls stay MXU-shaped.  Blocks default to 256: on a real v5e the
+256-block kernel measures ~2x the einsum path at S=2048 (and ~1.6x at
+4096) where 128 blocks run below it — the larger tile amortizes the
+per-grid-step overhead and keeps the MXU fed.
 
 Forward: grid (batch*heads, q_blocks, kv_blocks), sequential on TPU; the
 running max/denominator/accumulator live in VMEM scratch that persists
@@ -46,11 +49,14 @@ def _masked_scores(q_ref, k_ref, iq, ik, *, scale, causal):
     """scale * Q K^T for one (q_block, kv_block) tile, causal positions
     above the diagonal set to NEG_INF — the ONE definition of the score
     tile, shared by the forward kernel and the backward recompute so the
-    two can never drift apart."""
-    q = q_ref[0].astype(jnp.float32) * scale
-    k = k_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+    two can never drift apart.
+
+    The dot runs in the INPUT dtype with f32 accumulation: upcasting bf16
+    operands to f32 first would push the matmul off the MXU's native
+    bf16 path (~8x slower); scaling happens on the f32 result, which is
+    exact either way."""
+    s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
     if causal:
         bq = q_ref.shape[1]
         bkv = k_ref.shape[1]
@@ -78,16 +84,17 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(run)
     def _step():
-        v = v_ref[0].astype(jnp.float32)                  # (bkv, H)
         s = _masked_scores(q_ref, k_ref, iq, ik,
                            scale=scale, causal=causal)    # (bq, bkv)
         m_prev = m_ref[:, :1]                             # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)                            # (bq, bkv)
+        p = jnp.exp(s - m_new)                            # (bq, bkv) f32
         alpha = jnp.exp(m_prev - m_new)                   # (bq, 1)
         l_ref[:, :1] = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # P @ V in V's dtype (f32 accumulate): bf16 inputs stay on the
+        # MXU's fast path; f32 inputs are unchanged.
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:, :1] = m_new
 
@@ -127,14 +134,12 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
         lse_row = lse_ref[0, iq]
         d_row = d_ref[0, iq]
         p = _recompute_p(q_ref, k_ref, lse_row, iq, ik,
-                         scale=scale, causal=causal)     # (bq, bkv)
-        do = do_ref[0].astype(jnp.float32)               # (bq, H)
-        v = v_ref[0].astype(jnp.float32)                 # (bkv, H)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                         scale=scale, causal=causal)     # (bq, bkv) f32
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - d_row[:, None]) * scale           # (bq, bkv)
+        ds = p * (dp - d_row[:, None]) * scale           # (bq, bkv) f32
         acc_ref[:] += jax.lax.dot_general(
-            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ik == n_kv - 1)
@@ -160,17 +165,15 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
         lse_row = lse_ref[0, iq]
         d_row = d_ref[0, iq]
         p = _recompute_p(q_ref, k_ref, lse_row, iq, ikv,
-                         scale=scale, causal=causal)     # (bq, bkv)
-        do = do_ref[0].astype(jnp.float32)               # (bq, H)
-        v = v_ref[0].astype(jnp.float32)                 # (bkv, H)
+                         scale=scale, causal=causal)     # (bq, bkv) f32
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # (bkv, H)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - d_row[:, None]) * scale           # (bq, bkv)
+        ds = p * (dp - d_row[:, None]) * scale           # (bq, bkv) f32
         dk_acc[:] += jax.lax.dot_general(
-            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # (bkv, H)
 
     @pl.when(iq == n_q - 1)
@@ -184,8 +187,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref,
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_kv",
                                              "interpret"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                    causal: bool = True, block_q: int = 128,
-                    block_kv: int = 128, interpret: bool = False) -> jax.Array:
+                    causal: bool = True, block_q: int = 256,
+                    block_kv: int = 256, interpret: bool = False) -> jax.Array:
     """q/k/v: [B, S, N, H] (same head count — expand GQA groups first, as
     model.py does).  Returns [B, S, N, H] in q's dtype.
 

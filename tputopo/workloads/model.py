@@ -54,6 +54,11 @@ class ModelConfig:
     # "auto": Pallas flash kernel on TPU when shapes allow, einsum elsewhere.
     # "flash" forces the kernel (interpret mode off-TPU); "einsum" disables.
     attn_impl: str = "auto"
+    # "block": jax.checkpoint each transformer layer — the backward holds
+    # one layer's residuals instead of every layer's (incl. the bf16 weight
+    # casts, 256 MB/layer at d2048/ff8192), trading ~1/3 extra forward
+    # FLOPs for O(1)-in-depth activation memory.  "none" disables.
+    remat: str = "block"
 
     @property
     def head_dim(self) -> int:
@@ -238,7 +243,9 @@ def _flash_dispatch(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
 
     interpret = jax.default_backend() != "tpu"
     seq = q.shape[1]
-    block = min(128, seq)
+    # 256 blocks measure ~2x the 128-block kernel on v5e (attention.py
+    # docstring); fall back to 128 when 256 does not divide the sequence.
+    block = 256 if seq % 256 == 0 else min(128, seq)
     kernel = functools.partial(flash_attention, causal=True, block_q=block,
                                block_kv=block, interpret=interpret)
     plan = shardlib.active_plan()
@@ -281,6 +288,10 @@ def forward(params: dict, tokens: jax.Array, config: ModelConfig) -> jax.Array:
             "dp", "sp", None)
         return out, None
 
+    if c.remat == "block":
+        block = jax.checkpoint(block)
+    elif c.remat != "none":
+        raise ValueError(f"unknown remat policy {c.remat!r}")
     x, _ = jax.lax.scan(block, x, params["layers"])
     x = _rmsnorm(x, params["final_norm"], c.norm_eps)
     logits = x.astype(jnp.float32) @ params["lm_head"]

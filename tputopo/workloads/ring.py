@@ -31,6 +31,8 @@ import jax.numpy as jnp
 
 from jax import shard_map
 
+from tputopo.workloads.attention import _flash_backward, _flash_forward_lse
+
 NEG_INF = -1e30
 
 
@@ -93,15 +95,219 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
     return out.astype(q.dtype)
 
 
+# ---- flash-fused local block (VERDICT r1 #4) --------------------------------
+#
+# The einsum local block above materializes a full Sc x Sc f32 score tile
+# per head per ring step, so the long-context pitch (O(S/n) memory) held
+# only *across* devices.  The fused path below runs the Pallas flash
+# kernel (attention.py) as the per-step local block — O(block^2) working
+# set — and merges per-chunk partials with the logsumexp recurrence.  The
+# backward is a hand-written second ring pass: with the saved GLOBAL
+# logsumexp, each chunk's P = exp(s - LSE) is the true global softmax, so
+# the FlashAttention-2 dQ / dK/dV kernels apply per chunk unchanged; dK/dV
+# accumulators rotate with their chunk and arrive home after a full cycle.
+
+def _expand_kv(x: jax.Array, kv_group: int) -> jax.Array:
+    return jnp.repeat(x, kv_group, axis=2) if kv_group > 1 else x
+
+
+def _reduce_kv(dx: jax.Array, kv_group: int) -> jax.Array:
+    if kv_group == 1:
+        return dx
+    B, Sc, N, H = dx.shape
+    return dx.reshape(B, Sc, N // kv_group, kv_group, H).sum(axis=3)
+
+
+def _lse_flat(lse: jax.Array, B: int, N: int, Sc: int) -> jax.Array:
+    """[B*N, n_q, bq] kernel layout -> [B, N, Sc]."""
+    return lse.reshape(B, N, Sc)
+
+
+def _chunk_case(my, src, causal: bool):
+    """0 = diagonal (within-chunk causal), 1 = fully visible, 2 = invisible."""
+    if not causal:
+        return jnp.int32(1)
+    return jnp.where(src == my, 0, jnp.where(src < my, 1, 2))
+
+
+def _ring_flash_fwd_impl(q, k, v, *, axis_name, axis_size, causal, kv_group,
+                         block, interpret):
+    B, Sc, N, H = q.shape
+    my = jax.lax.axis_index(axis_name)
+    n_q = Sc // block
+
+    def chunk(case, kc, vc):
+        kx, vx = _expand_kv(kc, kv_group), _expand_kv(vc, kv_group)
+
+        def diag(q_, kx_, vx_):
+            return _flash_forward_lse(q_, kx_, vx_, causal=True,
+                                      block_q=block, block_kv=block,
+                                      interpret=interpret)
+
+        def full(q_, kx_, vx_):
+            return _flash_forward_lse(q_, kx_, vx_, causal=False,
+                                      block_q=block, block_kv=block,
+                                      interpret=interpret)
+
+        def skip(q_, kx_, vx_):
+            return (jnp.zeros_like(q_),
+                    jnp.full((B * N, n_q, block), NEG_INF, jnp.float32))
+
+        return jax.lax.switch(case, (diag, full, skip), q, kx, vx)
+
+    def merge(out_run, lse_run, case, kc, vc, src):
+        o_j, lse_j = chunk(case, kc, vc)
+        lse_j = _lse_flat(lse_j, B, N, Sc)
+        new = jnp.logaddexp(lse_run, lse_j)
+        # [B, N, Sc] weight -> [B, Sc, N, 1] to scale the output layout.
+        def w(x):
+            return jnp.exp(x - new).transpose(0, 2, 1)[..., None]
+        out_run = out_run * w(lse_run) + o_j.astype(jnp.float32) * w(lse_j)
+        return out_run, new
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, j):
+        kc, vc, out_run, lse_run = carry
+        src = (my - j) % axis_size
+        out_run, lse_run = merge(out_run, lse_run,
+                                 _chunk_case(my, src, causal), kc, vc, src)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (kc, vc, out_run, lse_run), None
+
+    out0 = jnp.zeros((B, Sc, N, H), jnp.float32)
+    lse0 = jnp.full((B, N, Sc), NEG_INF, jnp.float32)
+    if axis_size > 1:
+        (kc, vc, out_run, lse_run), _ = jax.lax.scan(
+            step, (k, v, out0, lse0), jnp.arange(axis_size - 1))
+    else:
+        kc, vc, out_run, lse_run = k, v, out0, lse0
+    src = (my - (axis_size - 1)) % axis_size
+    out_run, lse_run = merge(out_run, lse_run,
+                             _chunk_case(my, src, causal), kc, vc, src)
+    return out_run.astype(q.dtype), lse_run
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, axis_name, axis_size, causal, kv_group, block,
+                interpret):
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name=axis_name,
+                                  axis_size=axis_size, causal=causal,
+                                  kv_group=kv_group, block=block,
+                                  interpret=interpret)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, axis_size, causal, kv_group, block,
+                    interpret):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name=axis_name,
+                                    axis_size=axis_size, causal=causal,
+                                    kv_group=kv_group, block=block,
+                                    interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, axis_size, causal, kv_group, block, interpret,
+                    res, g):
+    q, k0, v0, out, lse_run = res
+    B, Sc, N, H = q.shape
+    my = jax.lax.axis_index(axis_name)
+    lse = lse_run.reshape(B * N, Sc // block, block)
+    do = g
+
+    def chunk_grads(case, kc, vc):
+        kx, vx = _expand_kv(kc, kv_group), _expand_kv(vc, kv_group)
+
+        def diag(q_, kx_, vx_):
+            return _flash_backward(q_, kx_, vx_, out, lse, do, causal=True,
+                                   block_q=block, block_kv=block,
+                                   interpret=interpret)
+
+        def full(q_, kx_, vx_):
+            return _flash_backward(q_, kx_, vx_, out, lse, do, causal=False,
+                                   block_q=block, block_kv=block,
+                                   interpret=interpret)
+
+        def skip(q_, kx_, vx_):
+            return (jnp.zeros_like(q_), jnp.zeros_like(kx_),
+                    jnp.zeros_like(vx_))
+
+        dq_j, dk_j, dv_j = jax.lax.switch(case, (diag, full, skip), q, kx, vx)
+        return dq_j, _reduce_kv(dk_j, kv_group), _reduce_kv(dv_j, kv_group)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, j):
+        kc, vc, dk_acc, dv_acc, dq_acc = carry
+        src = (my - j) % axis_size
+        dq_j, dk_j, dv_j = chunk_grads(_chunk_case(my, src, causal), kc, vc)
+        dq_acc = dq_acc + dq_j.astype(jnp.float32)
+        dk_acc = dk_acc + dk_j.astype(jnp.float32)
+        dv_acc = dv_acc + dv_j.astype(jnp.float32)
+        # Rotate EVERY step (n total): the chunk and its accumulated
+        # gradient complete a full cycle and land back on the owner.
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+        return (kc, vc, dk_acc, dv_acc, dq_acc), None
+
+    zeros_kv = jnp.zeros(k0.shape, jnp.float32)
+    (kc, vc, dk_acc, dv_acc, dq_acc), _ = jax.lax.scan(
+        step,
+        (k0, v0, zeros_kv, zeros_kv, jnp.zeros(q.shape, jnp.float32)),
+        jnp.arange(axis_size))
+    return (dq_acc.astype(q.dtype), dk_acc.astype(k0.dtype),
+            dv_acc.astype(v0.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_attention_local(q, k, v, *, axis_name: str, axis_size: int,
+                               causal: bool = True, kv_group: int = 1,
+                               block: int = 256,
+                               interpret: bool = False) -> jax.Array:
+    """Flash-fused per-device ring body (call under shard_map) — same
+    contract as :func:`ring_attention_local`, O(block^2) local working set
+    instead of O(Sc^2)."""
+    block = min(block, q.shape[1])
+    return _ring_flash(q, k, v, axis_name, axis_size, causal, kv_group,
+                       block, interpret)
+
+
+def _flash_shapes_ok(Sc: int, block: int = 128) -> bool:
+    b = min(block, Sc)
+    return Sc >= 16 and Sc % b == 0 and b % 8 == 0
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, plan, *,
-                   causal: bool = True, kv_group: int = 1) -> jax.Array:
+                   causal: bool = True, kv_group: int = 1,
+                   impl: str = "auto") -> jax.Array:
     """Global-array entry: q [B, S, N, H] (k/v may carry N/kv_group heads),
     logically global, laid out batch-over-dp, seq-over-sp, heads-over-tp
-    on ``plan``'s mesh."""
+    on ``plan``'s mesh.
+
+    ``impl``: "flash" fuses the Pallas kernel into the ring local block
+    (interpret mode off-TPU), "einsum" keeps the reference local block,
+    "auto" picks flash whenever the local chunk shape allows it.
+    """
     n_sp = plan.axes.get("sp", 1)
     spec = plan.spec("dp", "sp", "tp", None)
-    body = functools.partial(ring_attention_local, axis_name="sp",
-                             axis_size=n_sp, causal=causal,
-                             kv_group=kv_group)
+    Sc = q.shape[1] // max(1, n_sp)
+    if impl == "auto":
+        impl = "flash" if _flash_shapes_ok(Sc) else "einsum"
+    if impl == "flash":
+        body = functools.partial(
+            ring_flash_attention_local, axis_name="sp", axis_size=n_sp,
+            causal=causal, kv_group=kv_group,
+            interpret=jax.default_backend() != "tpu")
+    elif impl == "einsum":
+        body = functools.partial(ring_attention_local, axis_name="sp",
+                                 axis_size=n_sp, causal=causal,
+                                 kv_group=kv_group)
+    else:
+        raise ValueError(f"unknown ring impl {impl!r}")
     return shard_map(body, mesh=plan.mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)(q, k, v)
