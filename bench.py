@@ -292,13 +292,13 @@ def bench_hbm_gbps() -> dict | None:
             times.append(time.perf_counter() - t0)
         t = max(min(times) - overhead, 1e-9) / steps
         measured = 2 * n * 2 / t / 1e9  # read + write, bf16 = 2 bytes
-        from tputopo.topology.cost import LinkCostModel
+        from tputopo.topology.generations import get_generation
 
         kind = jax.devices()[0].device_kind.lower()
         gen = ("v5e" if "v5 lite" in kind or "v5e" in kind
                else "v6e" if "v6" in kind
                else "v5p" if "v5" in kind else "v4")
-        model_gbps = LinkCostModel.for_generation(gen).hbm_gbps
+        model_gbps = get_generation(gen).hbm_gbps
         return {"generation": gen,
                 "measured_hbm_gbps": round(measured, 1),
                 "cost_model_hbm_gbps": model_gbps,
