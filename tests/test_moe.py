@@ -1,0 +1,145 @@
+"""Expert parallelism: MoE routing math, ep-sharded execution, training.
+
+Strategy mirrors tests/test_workloads.py: exact parity between the
+capacity-dispatch fast path and a per-expert reference on shapes where no
+token can be dropped, then distribution/sharding properties on the 8-device
+CPU mesh (ep active), then a full MoE train-step smoke including the aux
+load-balancing loss.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tputopo.workloads.model import ModelConfig, forward_with_aux, init_params
+from tputopo.workloads.moe import MoEConfig, moe_mlp, moe_mlp_reference
+from tputopo.workloads.sharding import build_mesh
+from tputopo.workloads.train import (
+    loss_fn, make_sharded_state, make_sharded_train_step, make_train_state,
+    train_step,
+)
+
+MOE_TINY = ModelConfig(
+    vocab_size=128, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=64, max_seq=64, compute_dtype=jnp.float32,
+    moe=MoEConfig(n_experts=4, top_k=2, capacity_factor=2.0),
+)
+
+
+def _layer0(params):
+    return jax.tree.map(lambda a: a[0], params["layers"]["moe"])
+
+
+def test_moe_matches_reference_when_capacity_ample():
+    """capacity_factor big enough that no token is dropped -> the dense
+    dispatch must equal the per-expert loop exactly (same f32 math)."""
+    cfg = MOE_TINY
+    params = init_params(cfg, jax.random.key(0))
+    p = _layer0(params)
+    x = jax.random.normal(jax.random.key(1), (2, 16, cfg.d_model), jnp.float32)
+    # T=16, k=2, E=4, cf=2.0 -> capacity 16 == T: nothing can overflow.
+    out, aux = moe_mlp(x, p, cfg)
+    ref = moe_mlp_reference(x, p, cfg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    assert float(aux) > 0
+
+
+def test_moe_capacity_drops_are_bounded():
+    """With a tight capacity the fast path may only differ from the
+    no-drop reference on tokens it dropped — and each dropped (token, slot)
+    zeroes that expert's contribution, never invents one."""
+    cfg = ModelConfig(
+        vocab_size=128, d_model=32, n_layers=1, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq=64, compute_dtype=jnp.float32,
+        moe=MoEConfig(n_experts=4, top_k=1, capacity_factor=0.5))
+    params = init_params(cfg, jax.random.key(0))
+    p = _layer0(params)
+    x = jax.random.normal(jax.random.key(1), (1, 32, cfg.d_model), jnp.float32)
+    out, _ = moe_mlp(x, p, cfg)
+    ref = moe_mlp_reference(x, p, cfg)
+    out, ref = np.asarray(out)[0], np.asarray(ref)[0]
+    # top_k=1: a kept token matches the reference, a dropped one is 0.
+    kept = np.isclose(out, ref, rtol=2e-5, atol=2e-5).all(axis=-1)
+    dropped = np.isclose(out, 0.0, atol=1e-6).all(axis=-1)
+    assert (kept | dropped).all()
+    assert dropped.any(), "capacity 0.5 over uniform router must drop"
+    assert kept.any()
+
+
+def test_moe_capacity_seating_is_slot_rank_order():
+    """Seats fill in (token, slot-rank) order: with capacity C and one
+    expert receiving everything, exactly the first C tokens survive."""
+    cfg = ModelConfig(
+        vocab_size=128, d_model=32, n_layers=1, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq=64, compute_dtype=jnp.float32,
+        moe=MoEConfig(n_experts=4, top_k=1, capacity_factor=1.0))
+    params = init_params(cfg, jax.random.key(0))
+    p = dict(_layer0(params))
+    # Router forced: every token picks expert 2.
+    router = np.zeros((cfg.d_model, 4), np.float32)
+    router[:, 2] = 1.0
+    p["router"] = jnp.asarray(router)
+    x = jnp.abs(jax.random.normal(jax.random.key(1), (1, 32, cfg.d_model))) + 0.1
+    out, _ = moe_mlp(x, p, cfg)
+    out = np.asarray(out)[0]
+    C = cfg.moe.capacity(32)  # 32 * 1 * 1.0 / 4 = 8
+    assert C == 8
+    live = ~np.isclose(out, 0.0, atol=1e-6).all(axis=-1)
+    assert live[:C].all() and not live[C:].any()
+
+
+def test_moe_forward_aux_positive_and_bounded():
+    params = init_params(MOE_TINY, jax.random.key(0))
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 128, (2, 32)))
+    logits, aux = forward_with_aux(params, toks, MOE_TINY)
+    assert logits.shape == (2, 32, 128)
+    # Perfectly balanced top-k routing gives aux == weight * n_layers
+    # (E * sum(1/E * 1/E * E) == 1 per layer); skew only raises it.
+    w = MOE_TINY.moe.aux_loss_weight * MOE_TINY.n_layers
+    assert float(aux) >= 0.9 * w
+    assert np.isfinite(float(aux))
+
+
+def test_moe_sharded_ep_matches_unsharded():
+    """dp=2 x ep=2 x tp=2 sharded MoE train step == single-device step:
+    expert parallelism is layout, not math (modulo bf16-free f32 path)."""
+    plan = build_mesh({"dp": 2, "ep": 2, "tp": 2})
+    toks = jnp.asarray(np.random.default_rng(1).integers(0, 128, (4, 32)))
+
+    ref_state = make_train_state(MOE_TINY, jax.random.key(2), lr=1e-2)
+    ref_loss = float(loss_fn(ref_state.params, toks, MOE_TINY))
+
+    sh_state = make_sharded_state(plan, MOE_TINY, jax.random.key(2), lr=1e-2)
+    step = make_sharded_train_step(plan, MOE_TINY, lr=1e-2)
+    sh_state, sh_loss = step(sh_state, toks)
+    assert float(sh_loss) == pytest.approx(ref_loss, rel=1e-4)
+
+    ref_state, _ = jax.jit(
+        lambda s, t: train_step(s, t, MOE_TINY, lr=1e-2))(ref_state, toks)
+    for a, b in zip(jax.tree.leaves(ref_state.params),
+                    jax.tree.leaves(sh_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_moe_expert_weights_actually_sharded_over_ep():
+    plan = build_mesh({"dp": 2, "ep": 2, "tp": 2})
+    state = make_sharded_state(plan, MOE_TINY, jax.random.key(0))
+    wg = state.params["layers"]["moe"]["w_gate"]  # [L, E, D, F]
+    shard_shape = wg.sharding.shard_shape(wg.shape)
+    E = MOE_TINY.moe.n_experts
+    assert shard_shape[1] == E // 2, "expert axis must split over ep"
+    assert shard_shape[3] == MOE_TINY.d_ff // 2, "ffn axis must split over tp"
+
+
+def test_moe_training_reduces_loss():
+    plan = build_mesh({"dp": 2, "ep": 2, "tp": 2})
+    state = make_sharded_state(plan, MOE_TINY, jax.random.key(3), lr=5e-3)
+    step = make_sharded_train_step(plan, MOE_TINY, lr=5e-3)
+    toks = jnp.asarray(np.random.default_rng(2).integers(0, 128, (4, 32)))
+    state, first = step(state, toks)
+    for _ in range(8):
+        state, loss = step(state, toks)
+    assert float(loss) < float(first)

@@ -60,12 +60,19 @@ def test_train_step_reduces_loss():
 
 
 def test_plan_mesh_policy():
-    assert plan_mesh(8, heads=4) == {"dp": 2, "sp": 1, "tp": 4}
-    assert plan_mesh(8, heads=2) == {"dp": 4, "sp": 1, "tp": 2}
-    assert plan_mesh(8, tp=2, sp=2) == {"dp": 2, "sp": 2, "tp": 2}
-    assert plan_mesh(1) == {"dp": 1, "sp": 1, "tp": 1}
+    def axes(**kw):
+        return {"pp": 1, "dp": 1, "sp": 1, "ep": 1, "tp": 1, **kw}
+
+    assert plan_mesh(8, heads=4) == axes(dp=2, tp=4)
+    assert plan_mesh(8, heads=2) == axes(dp=4, tp=2)
+    assert plan_mesh(8, tp=2, sp=2) == axes(dp=2, sp=2, tp=2)
+    assert plan_mesh(1) == axes()
+    assert plan_mesh(8, pp=2, ep=2, tp=2) == axes(pp=2, ep=2, tp=2)
+    assert plan_mesh(16, pp=2, ep=2, heads=4) == axes(pp=2, ep=2, tp=4)
     with pytest.raises(ValueError):
         plan_mesh(8, tp=3)
+    with pytest.raises(ValueError):
+        plan_mesh(8, pp=3)
 
 
 def test_constrain_is_noop_without_plan():

@@ -51,9 +51,23 @@ def cmd_train(args) -> int:
     from tputopo.workloads.train import make_sharded_state, make_sharded_train_step
 
     n = jax.device_count()
+    moe = None
+    if args.experts:
+        from tputopo.workloads.moe import MoEConfig
+
+        moe = MoEConfig(n_experts=args.experts)
+    elif args.ep and args.ep > 1:
+        print("error: --ep needs --experts (a dense model would replicate "
+              "over the ep axis and waste those chips)", file=sys.stderr)
+        return 2
     config = ModelConfig(vocab_size=2048, d_model=256, n_layers=4, n_heads=8,
-                         n_kv_heads=4, d_ff=512, max_seq=args.seq)
-    plan = mesh_for_slice((n,), heads=config.n_heads)
+                         n_kv_heads=4, d_ff=512, max_seq=args.seq, moe=moe)
+    plan = mesh_for_slice((n,), heads=config.n_heads, pp=args.pp, ep=args.ep,
+                          sp=args.sp, tp=args.tp)
+    if config.n_layers % plan.axes["pp"]:
+        print(f"error: --pp {args.pp} must divide {config.n_layers} layers",
+              file=sys.stderr)
+        return 2
     state = make_sharded_state(plan, config, jax.random.key(0))
     resumed_from = None
     if args.ckpt_dir:
@@ -65,8 +79,9 @@ def cmd_train(args) -> int:
             resumed_from = int(state.step)
     step = make_sharded_train_step(plan, config)
     rng = np.random.default_rng(0)
-    batch = max(plan.axes["dp"], args.batch // max(1, plan.axes["dp"])
-                * plan.axes["dp"])
+    # Batch must shard over dp AND split into pp microbatches.
+    q = max(1, plan.axes["dp"]) * max(1, plan.axes["pp"])
+    batch = max(q, args.batch // q * q)
     # Fixed batch: the convergence check is memorization, which must always
     # reduce loss — fresh random batches each step need not.
     tokens = jnp.asarray(rng.integers(0, config.vocab_size, (batch, args.seq)))
@@ -110,6 +125,16 @@ def main() -> int:
     p.add_argument("--steps", type=int, default=5)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--tp", type=int, default=None,
+                   help="tensor-parallel degree (default: policy)")
+    p.add_argument("--sp", type=int, default=None,
+                   help="sequence-parallel degree (ring attention)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline stages (SPMD GPipe)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel degree (MoE; needs --experts)")
+    p.add_argument("--experts", type=int, default=0,
+                   help="MoE experts per layer (0 = dense FFN)")
     p.add_argument("--ckpt-dir", default=None,
                    help="orbax checkpoint dir: resume if present, save at end "
                         "(and every --save-every steps)")

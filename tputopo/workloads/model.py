@@ -59,6 +59,10 @@ class ModelConfig:
     # casts, 256 MB/layer at d2048/ff8192), trading ~1/3 extra forward
     # FLOPs for O(1)-in-depth activation memory.  "none" disables.
     remat: str = "block"
+    # Mixture-of-Experts: when set, every layer's FFN becomes an
+    # expert-parallel MoE block (tputopo.workloads.moe) routed top-k with
+    # a capacity limit; None keeps the dense SwiGLU MLP.
+    moe: "object | None" = None
 
     @property
     def head_dim(self) -> int:
@@ -91,7 +95,7 @@ def init_params(config: ModelConfig, key: jax.Array) -> dict:
         return jax.random.normal(key, shape, jnp.float32) * scale
 
     L, D, H, KV, Hd, F = c.n_layers, c.d_model, c.n_heads, c.n_kv_heads, c.head_dim, c.d_ff
-    ks = jax.random.split(k_layers, 7)
+    ks = jax.random.split(k_layers, 8)
     layers = {
         "attn_norm": norm_init((L, D)),
         "wq": dense_init(ks[0], (L, D, H * Hd), D),
@@ -99,10 +103,17 @@ def init_params(config: ModelConfig, key: jax.Array) -> dict:
         "wv": dense_init(ks[2], (L, D, KV * Hd), D),
         "wo": dense_init(ks[3], (L, H * Hd, D), H * Hd),
         "mlp_norm": norm_init((L, D)),
-        "w_gate": dense_init(ks[4], (L, D, F), D),
-        "w_up": dense_init(ks[5], (L, D, F), D),
-        "w_down": dense_init(ks[6], (L, F, D), F),
     }
+    if c.moe is not None:
+        from tputopo.workloads.moe import init_moe_params
+
+        layers["moe"] = init_moe_params(c, ks[7])
+    else:
+        layers.update({
+            "w_gate": dense_init(ks[4], (L, D, F), D),
+            "w_up": dense_init(ks[5], (L, D, F), D),
+            "w_down": dense_init(ks[6], (L, F, D), F),
+        })
     return {
         "embed": dense_init(k_embed, (c.vocab_size, D), D),
         "layers": layers,
@@ -243,9 +254,10 @@ def _flash_dispatch(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
 
     interpret = jax.default_backend() != "tpu"
     seq = q.shape[1]
-    # 256 blocks measure ~2x the 128-block kernel on v5e (attention.py
-    # docstring); fall back to 128 when 256 does not divide the sequence.
-    block = 256 if seq % 256 == 0 else min(128, seq)
+    # 512 blocks + parallel grid semantics measure 1.84x the einsum path
+    # on v5e at S=2048 (attention.py docstring); smaller power-of-two
+    # fallbacks for sequences 512 does not divide.
+    block = next((b for b in (512, 256) if seq % b == 0), min(128, seq))
     kernel = functools.partial(flash_attention, causal=True, block_q=block,
                                block_kv=block, interpret=interpret)
     plan = shardlib.active_plan()
@@ -268,34 +280,76 @@ def _mlp(x: jax.Array, p: dict) -> jax.Array:
     return h @ p["w_down"].astype(x.dtype)
 
 
-def forward(params: dict, tokens: jax.Array, config: ModelConfig) -> jax.Array:
-    """Token ids [B, S] -> logits [B, S, vocab] (float32).
+def transformer_block(x: jax.Array, layer: dict, config: ModelConfig,
+                      cos: jax.Array, sin: jax.Array
+                      ) -> tuple[jax.Array, jax.Array]:
+    """One decoder layer: (x, layer params) -> (x, aux loss scalar).
 
-    One scan over stacked layers; activations carried in ``compute_dtype``.
+    ``layer`` holds ONE layer's tensors (a leading-axis slice of the
+    stacked init_params layout — the layer scan and the pipeline stage
+    scan both index it the same way).  aux is 0 for dense FFN layers and
+    the router load-balancing loss for MoE layers.
     """
     c = config
-    S = tokens.shape[1]
-    cos, sin = _rope_tables(c, S)
-    x = params["embed"].astype(c.compute_dtype)[tokens]
-    x = constrain(x, "dp", "sp", None)
+    h = x + constrain(
+        _attention(_rmsnorm(x, layer["attn_norm"], c.norm_eps), layer, c, cos, sin),
+        "dp", "sp", None)
+    pre = _rmsnorm(h, layer["mlp_norm"], c.norm_eps)
+    if c.moe is not None:
+        from tputopo.workloads.moe import moe_mlp
 
-    def block(x, layer):
-        h = x + constrain(
-            _attention(_rmsnorm(x, layer["attn_norm"], c.norm_eps), layer, c, cos, sin),
-            "dp", "sp", None)
-        out = h + constrain(
-            _mlp(_rmsnorm(h, layer["mlp_norm"], c.norm_eps), layer),
-            "dp", "sp", None)
-        return out, None
+        y, aux = moe_mlp(pre, layer["moe"], c)
+    else:
+        y, aux = _mlp(pre, layer), jnp.float32(0)
+    out = h + constrain(y, "dp", "sp", None)
+    return out, aux
+
+
+def _block_scan(x: jax.Array, layers: dict, config: ModelConfig,
+                cos: jax.Array, sin: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Scan transformer_block over stacked ``layers``; returns (x, total aux)."""
+    c = config
+
+    def block(carry, layer):
+        x, aux = carry
+        out, a = transformer_block(x, layer, c, cos, sin)
+        return (out, aux + a), None
 
     if c.remat == "block":
         block = jax.checkpoint(block)
     elif c.remat != "none":
         raise ValueError(f"unknown remat policy {c.remat!r}")
-    x, _ = jax.lax.scan(block, x, params["layers"])
-    x = _rmsnorm(x, params["final_norm"], c.norm_eps)
+    (x, aux), _ = jax.lax.scan(block, (x, jnp.float32(0)), layers)
+    return x, aux
+
+
+def embed_tokens(params: dict, tokens: jax.Array, config: ModelConfig) -> jax.Array:
+    x = params["embed"].astype(config.compute_dtype)[tokens]
+    return constrain(x, "dp", "sp", None)
+
+
+def lm_head(params: dict, x: jax.Array, config: ModelConfig) -> jax.Array:
+    x = _rmsnorm(x, params["final_norm"], config.norm_eps)
     logits = x.astype(jnp.float32) @ params["lm_head"]
     return constrain(logits, "dp", "sp", None)
+
+
+def forward_with_aux(params: dict, tokens: jax.Array,
+                     config: ModelConfig) -> tuple[jax.Array, jax.Array]:
+    """Token ids [B, S] -> (logits [B, S, vocab] f32, aux loss scalar).
+
+    One scan over stacked layers; activations carried in ``compute_dtype``.
+    """
+    c = config
+    cos, sin = _rope_tables(c, tokens.shape[1])
+    x = embed_tokens(params, tokens, c)
+    x, aux = _block_scan(x, params["layers"], c, cos, sin)
+    return lm_head(params, x, c), aux
+
+
+def forward(params: dict, tokens: jax.Array, config: ModelConfig) -> jax.Array:
+    """Token ids [B, S] -> logits [B, S, vocab] (float32)."""
+    return forward_with_aux(params, tokens, config)[0]
 
 
 @partial(jax.jit, static_argnums=2)

@@ -1,0 +1,159 @@
+"""Mixture-of-Experts MLP with expert parallelism over the ``ep`` mesh axis.
+
+The reference schedules whatever parallelism the workload brings
+(SURVEY.md §2 "Parallelism strategies": the placement invariant is the
+framework's deliverable); this module is the expert-parallel workload that
+exercises that invariant.  Design is TPU-first throughout:
+
+- **Dense dispatch, static shapes.**  Routing uses the GShard/Switch
+  capacity-factor formulation: every (token, slot) is scattered into a
+  fixed [experts, capacity] buffer via one-hot matmuls — no gather/scatter
+  with data-dependent shapes, so the whole layer is a handful of einsums
+  XLA tiles straight onto the MXU, and `lax.scan` over layers still sees
+  identical shapes every step.
+- **Expert parallelism = sharding, not message passing.**  Expert weight
+  tables are sharded over ``ep`` on their leading (expert) axis; the
+  dispatch einsum's output carries a sharding constraint placing its
+  expert axis on ``ep`` while tokens stay on ``dp``/``sp`` — XLA lowers
+  that boundary to the all-to-all, riding ICI on a contiguous slice (the
+  scheduler's whole value proposition).  Within each expert the FFN is
+  additionally tensor-parallel over ``tp``, same Megatron layout as the
+  dense MLP.
+- **Router in float32.**  Softmax over expert logits is precision-critical
+  (bf16 logit ties flap routing step to step); params and gating math stay
+  f32, only the expert FFN itself runs in ``compute_dtype``.
+
+Load balancing is the standard Switch auxiliary loss (mean fraction of
+tokens routed x mean router probability, scaled by E), surfaced to the
+training loss through the layer scan's carry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from tputopo.workloads.sharding import constrain
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    """Expert-layer hyperparameters (attached to ModelConfig.moe)."""
+
+    n_experts: int = 8
+    top_k: int = 2
+    # capacity per expert = ceil(tokens_per_group * top_k / n_experts
+    #                            * capacity_factor), rounded up to 8
+    # (sublane alignment) — tokens over capacity fall through the residual.
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 1e-2
+
+    def capacity(self, group_tokens: int) -> int:
+        raw = group_tokens * self.top_k * self.capacity_factor / self.n_experts
+        cap = int(-(-raw // 8) * 8)  # ceil to multiple of 8
+        return max(8, min(cap, group_tokens))
+
+
+def init_moe_params(cfg, key: jax.Array) -> dict:
+    """Per-layer MoE tensors, stacked on a leading layer axis (scan order),
+    expert axis second: router [L, D, E], expert FFN [L, E, D, F] / [L, E, F, D]."""
+    import math
+
+    c, m = cfg, cfg.moe
+    L, D, F, E = c.n_layers, c.d_model, c.d_ff, m.n_experts
+    ks = jax.random.split(key, 4)
+
+    def dense(key, shape, fan_in):
+        return jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
+
+    return {
+        "router": dense(ks[0], (L, D, E), D),
+        "w_gate": dense(ks[1], (L, E, D, F), D),
+        "w_up": dense(ks[2], (L, E, D, F), D),
+        "w_down": dense(ks[3], (L, E, F, D), F),
+    }
+
+
+def _route(x32: jax.Array, router: jax.Array, m: MoEConfig):
+    """Top-k routing with capacity assignment.
+
+    x32 [B, T, D] float32 -> (combine [B, T, k, E, C], aux_loss scalar).
+    ``combine`` carries the gate weight at each (slot, expert, capacity
+    position); its boolean support is the dispatch mask.
+    """
+    B, T, D = x32.shape
+    E, k = m.n_experts, m.top_k
+    C = m.capacity(T)
+
+    probs = jax.nn.softmax(x32 @ router.astype(jnp.float32), axis=-1)  # [B,T,E]
+    gates, idx = jax.lax.top_k(probs, k)                               # [B,T,k]
+    gates = gates / jnp.maximum(jnp.sum(gates, -1, keepdims=True), 1e-9)
+
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)                 # [B,T,k,E]
+    # Capacity positions: slots claim seats in (token, slot-rank) order —
+    # flatten (T, k) so rank-0 slots of earlier tokens win seats first.
+    flat = onehot.reshape(B, T * k, E)
+    pos = jnp.cumsum(flat, axis=1) - flat                              # seats before me
+    pos = pos.reshape(B, T, k, E)
+    kept = onehot * (pos < C)                                          # seat granted
+    seat = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32) # [B,T,k,E,C]
+    combine = kept[..., None] * seat * gates[..., None, None]
+
+    # Switch aux loss: E * mean_e(fraction routed to e) . mean_e(router prob).
+    frac = jnp.mean(onehot.sum(2), axis=(0, 1))                        # [E]
+    mean_prob = jnp.mean(probs, axis=(0, 1))                           # [E]
+    aux = m.aux_loss_weight * E * jnp.sum(frac * mean_prob)
+    return combine, aux
+
+
+def moe_mlp(x: jax.Array, p: dict, cfg) -> tuple[jax.Array, jax.Array]:
+    """Expert-parallel FFN: x [B, T, D] -> (out [B, T, D], aux loss).
+
+    ``p`` holds ONE layer's slice of the init_moe_params tensors (the model
+    scan indexes the leading layer axis away).  Tokens over capacity
+    contribute zero here and survive through the residual connection.
+    """
+    m: MoEConfig = cfg.moe
+    B, T, D = x.shape
+    dt = x.dtype
+    combine, aux = _route(x.astype(jnp.float32), p["router"], m)
+    disp = (combine > 0).astype(dt)                                    # [B,T,k,E,C]
+
+    # Dispatch: tokens -> [E, B, C, D], expert axis onto ep, batch stays dp.
+    # XLA lowers the constraint boundary to the ep all-to-all.
+    xe = jnp.einsum("btkec,btd->ebcd", disp, x)
+    xe = constrain(xe, "ep", "dp", None, None)
+
+    wg = p["w_gate"].astype(dt)
+    wu = p["w_up"].astype(dt)
+    wd = p["w_down"].astype(dt)
+    h = jax.nn.silu(jnp.einsum("ebcd,edf->ebcf", xe, wg))
+    h = h * jnp.einsum("ebcd,edf->ebcf", xe, wu)
+    h = constrain(h, "ep", "dp", None, "tp")
+    ye = jnp.einsum("ebcf,efd->ebcd", h, wd)
+    ye = constrain(ye, "ep", "dp", None, None)
+
+    # Combine: weighted un-dispatch back to [B, T, D] (the reverse all-to-all).
+    out = jnp.einsum("btkec,ebcd->btd", combine.astype(dt), ye)
+    return constrain(out, "dp", "sp", None), aux
+
+
+def moe_mlp_reference(x: jax.Array, p: dict, cfg) -> jax.Array:
+    """O(tokens x experts) loop-free reference without capacity dropping —
+    every token reaches its top-k experts.  Used by tests to bound what the
+    capacity-limited fast path may drop."""
+    m: MoEConfig = cfg.moe
+    x32 = x.astype(jnp.float32)
+    probs = jax.nn.softmax(x32 @ p["router"].astype(jnp.float32), -1)
+    gates, idx = jax.lax.top_k(probs, m.top_k)
+    gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+
+    def expert(e):
+        h = jax.nn.silu(x32 @ p["w_gate"][e]) * (x32 @ p["w_up"][e])
+        return h @ p["w_down"][e]
+
+    ys = jnp.stack([expert(e) for e in range(m.n_experts)])  # [E,B,T,D]
+    w = (jax.nn.one_hot(idx, m.n_experts) * gates[..., None]).sum(2)  # [B,T,E]
+    return jnp.einsum("bte,ebtd->btd", w, ys).astype(x.dtype)

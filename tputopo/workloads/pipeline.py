@@ -1,0 +1,152 @@
+"""Pipeline parallelism over the ``pp`` mesh axis — SPMD GPipe.
+
+The fifth parallelism axis (after dp/sp/ep/tp): the layer stack is cut
+into ``pp`` contiguous stages, microbatches stream through the stages, and
+stage-to-stage handoffs are single `ppermute` hops — which is why ``pp``
+is the OUTERMOST mesh axis (sharding.py AXES): pipeline traffic is the
+only point-to-point, latency-tolerant traffic in the step, so it gets the
+longest physical paths while tp/ep collectives keep the short rings.
+
+TPU-first formulation (vs the reference stack's per-rank send/recv
+pipelines): one SPMD program under `jax.shard_map` manual over *only* the
+``pp`` axis — dp/sp/ep/tp stay in XLA "auto" mode, so the per-stage layer
+math keeps its sharding constraints and every other collective is still
+compiler-placed.  The schedule is a `lax.scan` over M + pp - 1 ticks; each
+tick every stage runs its layers on its current microbatch and `ppermute`s
+the activation to its successor.  Reverse-mode autodiff of that scan IS
+the backward pipeline (activations for the bubble ticks included), so the
+same function trains under `jax.grad` with no bespoke backward schedule.
+
+Stage weights are not materialized anywhere: `param_specs` (sharding.py)
+shards the stacked [L, ...] layer tensors over ``pp`` on the layer axis,
+and the shard_map in_spec consumes exactly that layout — each device holds
+its own stage's layers and nothing else.
+
+Citations: reference design.md:92-121 schedules whole-job placements; the
+pipeline is the workload-side consumer of a contiguous slice's long axis
+(SURVEY.md §2 "Parallelism strategies" row TP/PP/SP/EP).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from jax.sharding import PartitionSpec as P
+
+from tputopo.workloads.model import (ModelConfig, _rope_tables, embed_tokens,
+                                     lm_head, transformer_block)
+from tputopo.workloads.sharding import MeshPlan
+
+
+def _stage_body(layers_local, x, config, cos, sin):
+    """Run this stage's layers (leading axis L/pp) on one microbatch."""
+    c = config
+
+    def block(carry, layer):
+        x, aux = carry
+        out, a = transformer_block(x, layer, c, cos, sin)
+        return (out, aux + a), None
+
+    if c.remat == "block":
+        block = jax.checkpoint(block)
+    elif c.remat != "none":
+        raise ValueError(f"unknown remat policy {c.remat!r}")
+    (x, aux), _ = jax.lax.scan(block, (x, jnp.float32(0)), layers_local)
+    return x, aux
+
+
+def pipelined_forward_with_aux(params: dict, tokens: jax.Array,
+                               config: ModelConfig, plan: MeshPlan,
+                               n_micro: int | None = None
+                               ) -> tuple[jax.Array, jax.Array]:
+    """forward_with_aux, with the layer stack pipelined over ``pp``.
+
+    tokens [B, S]; B must divide into ``n_micro`` microbatches (default:
+    pp, the minimum that keeps every stage busy in steady state; raise it
+    to shrink the (pp-1)/(M+pp-1) bubble at the cost of smaller per-tick
+    matmuls).  n_layers must divide by pp (stage boundary alignment).
+    """
+    c = config
+    pp = plan.axes.get("pp", 1)
+    if pp <= 1:
+        from tputopo.workloads.model import forward_with_aux
+
+        return forward_with_aux(params, tokens, c)
+    M = n_micro or pp
+    B, S = tokens.shape
+    if B % M:
+        raise ValueError(f"batch {B} not divisible into {M} microbatches")
+    if c.n_layers % pp:
+        raise ValueError(f"{c.n_layers} layers not divisible into {pp} stages")
+    cos, sin = _rope_tables(c, S)
+
+    x = embed_tokens(params, tokens, c)          # [B, S, D]
+    D = x.shape[-1]
+    xm = x.reshape(M, B // M, S, D)
+
+    layer_rank = {k: jax.tree.map(jnp.ndim, v)
+                  for k, v in params["layers"].items()}
+    stage_specs = jax.tree.map(lambda r: P("pp", *(None,) * (r - 1)),
+                               layer_rank)
+
+    @functools.partial(
+        jax.shard_map, mesh=plan.mesh, axis_names={"pp"},
+        in_specs=(stage_specs, P(), P(), P()),
+        out_specs=(P(), P()), check_vma=False)
+    def run(stage_layers, xm, cos, sin):
+        i = jax.lax.axis_index("pp")
+        perm = [(j, (j + 1) % pp) for j in range(pp)]
+
+        def tick(carry, t):
+            state, outbuf, aux = carry
+            # Stage 0 injects microbatch t (clipped garbage past M rides
+            # the tail bubble and never lands in outbuf); later stages
+            # consume their predecessor's handoff.
+            mb = jax.lax.dynamic_index_in_dim(
+                xm, jnp.clip(t, 0, M - 1), 0,
+                keepdims=False).astype(state.dtype)
+            inp = jnp.where(i == 0, mb, state)
+            out, a = _stage_body(stage_layers, inp, c, cos, sin)
+            # aux only counts ticks where this stage held a real
+            # microbatch (stage i is busy for t in [i, i + M)).
+            aux = aux + jnp.where((t >= i) & (t < i + M), a, 0.0)
+            # The LAST stage banks microbatch t - (pp - 1).
+            widx = jnp.clip(t - (pp - 1), 0, M - 1)
+            cur = jax.lax.dynamic_index_in_dim(outbuf, widx, 0,
+                                               keepdims=False)
+            outbuf = jax.lax.dynamic_update_index_in_dim(
+                outbuf, jnp.where(t >= pp - 1, out, cur), widx, 0)
+            state = jax.lax.ppermute(out, "pp", perm)
+            return (state, outbuf, aux), None
+
+        zero = jnp.zeros(xm.shape[1:], c.compute_dtype)
+        (state, outbuf, aux), _ = jax.lax.scan(
+            tick, (zero, jnp.zeros((M,) + xm.shape[1:], c.compute_dtype),
+                   jnp.float32(0)),
+            jnp.arange(M + pp - 1))
+        # outbuf holds the finished stack only on the last stage; aux is
+        # per-stage partial.  One masked psum replicates/reduces both.
+        # Replicate the last stage's banked outputs to every pp shard.
+        # The collective runs in f32: XLA CPU's AllReducePromotion pass
+        # crashes cloning a bf16 all-reduce under partial-manual shard_map
+        # (both this gather's reduce-scatter transpose and a masked-psum
+        # formulation hit it), and on TPU one f32 hop on the pipeline's
+        # cold path costs nothing.
+        outbuf = jax.lax.all_gather(
+            outbuf.astype(jnp.float32), "pp", axis=0)[pp - 1].astype(outbuf.dtype)
+        # Average over the M microbatch routing groups so the aux scale
+        # matches unpipelined training (per-group stats remain per-group:
+        # a microbatch IS the MoE routing group under pipelining).
+        aux = jax.lax.psum(aux, "pp") / M
+        return outbuf, aux
+
+    # The microbatch stack crosses the shard_map boundary in f32: it is
+    # replicated over pp, so its gradient in the transpose is a pp-psum,
+    # and XLA CPU's AllReducePromotion crashes on bf16 all-reduces under
+    # partial-manual shard_map (same pass as the outbuf note above).
+    out, aux = run(params["layers"], xm.astype(jnp.float32), cos, sin)
+    x = out.reshape(B, S, D).astype(x.dtype)
+    return lm_head(params, x, c), aux

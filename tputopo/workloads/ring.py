@@ -264,10 +264,12 @@ def _ring_flash_bwd(axis_name, axis_size, causal, kv_group, block, interpret,
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
+_RING_FLASH_BLOCK = 256
+
 
 def ring_flash_attention_local(q, k, v, *, axis_name: str, axis_size: int,
                                causal: bool = True, kv_group: int = 1,
-                               block: int = 256,
+                               block: int = _RING_FLASH_BLOCK,
                                interpret: bool = False) -> jax.Array:
     """Flash-fused per-device ring body (call under shard_map) — same
     contract as :func:`ring_attention_local`, O(block^2) local working set
@@ -277,8 +279,11 @@ def ring_flash_attention_local(q, k, v, *, axis_name: str, axis_size: int,
                        block, interpret)
 
 
-def _flash_shapes_ok(Sc: int, block: int = 128) -> bool:
-    b = min(block, Sc)
+def _flash_shapes_ok(Sc: int) -> bool:
+    """Check against the SAME block the flash path will actually run with
+    (ring_flash_attention_local clips its default to min(256, Sc)) — a
+    smaller probe block would pass Sc values the kernel then rejects."""
+    b = min(_RING_FLASH_BLOCK, Sc)
     return Sc >= 16 and Sc % b == 0 and b % 8 == 0
 
 
@@ -297,7 +302,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, plan, *,
     spec = plan.spec("dp", "sp", "tp", None)
     Sc = q.shape[1] // max(1, n_sp)
     if impl == "auto":
-        impl = "flash" if _flash_shapes_ok(Sc) else "einsum"
+        # auto is TPU-only, matching model._use_flash: interpret-mode
+        # Pallas on CPU is orders of magnitude slower than the compiled
+        # einsum block (tests reach it via explicit impl="flash").
+        impl = ("flash" if jax.default_backend() == "tpu"
+                and _flash_shapes_ok(Sc) else "einsum")
     if impl == "flash":
         body = functools.partial(
             ring_flash_attention_local, axis_name="sp", axis_size=n_sp,

@@ -31,7 +31,14 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "sp", "tp")  # data, sequence, tensor — outermost to innermost
+# Logical mesh axes, outermost to innermost.  Innermost axes map to the
+# shortest physical rings (mesh_utils / row-major reshape both preserve
+# this), so the ordering is a bandwidth policy: tp (per-token collectives,
+# chattiest) innermost; ep (MoE all-to-all, per-layer) next; sp (ring
+# attention ppermute) and dp (one gradient all-reduce per step) outside;
+# pp outermost — pipeline traffic is point-to-point microbatch handoffs,
+# the only traffic that tolerates the longest paths.
+AXES = ("pp", "dp", "sp", "ep", "tp")
 
 
 @dataclass
@@ -91,28 +98,35 @@ def constrain(x: jax.Array, *names: str | None) -> jax.Array:
 
 
 def plan_mesh(n_devices: int, *, tp: int | None = None, sp: int | None = None,
+              pp: int = 1, ep: int = 1,
               heads: int | None = None) -> dict[str, int]:
-    """Choose (dp, sp, tp) sizes for ``n_devices``.
+    """Choose axis sizes for ``n_devices``.
 
     Policy: tensor parallelism up to the host boundary (4 chips on v5p — TP
     traffic is per-token and latency-bound, keep it on the shortest rings),
     bounded by the head count it must divide; remaining factor goes to DP;
-    SP only on explicit request (long-context runs).
+    SP, PP (pipeline stages) and EP (expert shards) only on explicit
+    request — they are workload-shape decisions, not device-count ones.
     """
+    if n_devices % (pp * ep):
+        raise ValueError(f"pp={pp} x ep={ep} does not divide "
+                         f"{n_devices} devices")
     if tp is None:
         tp = 1
         for cand in (4, 2):
-            if n_devices % cand == 0 and (heads is None or heads % cand == 0):
+            if (n_devices // (pp * ep)) % cand == 0 and \
+                    (heads is None or heads % cand == 0):
                 tp = cand
                 break
-    if n_devices % tp:
-        raise ValueError(f"tp={tp} does not divide {n_devices} devices")
-    rest = n_devices // tp
+    if n_devices % (pp * ep * tp):
+        raise ValueError(f"pp={pp} x ep={ep} x tp={tp} does not divide "
+                         f"{n_devices} devices")
+    rest = n_devices // (pp * ep * tp)
     if sp is None:
         sp = 1
     if rest % sp:
         raise ValueError(f"sp={sp} does not divide {rest} remaining devices")
-    return {"dp": rest // sp, "sp": sp, "tp": tp}
+    return {"pp": pp, "dp": rest // sp, "sp": sp, "ep": ep, "tp": tp}
 
 
 def build_mesh(axes: dict[str, int], devices=None) -> MeshPlan:
@@ -151,37 +165,60 @@ def mesh_for_slice(slice_dims: tuple[int, ...], devices=None,
 
 # ---- parameter shardings ----------------------------------------------------
 
-def param_specs(plan: MeshPlan) -> dict:
+def param_specs(plan: MeshPlan, config=None) -> dict:
     """Megatron-style TP layout for the model.py parameter pytree.
 
     Attention qkv projections and MLP up/gate split their output features
     over ``tp`` (column parallel); wo and w_down split input features (row
     parallel), so each block needs exactly one psum, which XLA inserts at
-    the constrained boundary.  The lm_head splits the vocab.  Stacked layer
-    tensors carry a leading (unsharded) layer axis for the scan.
+    the constrained boundary.  The lm_head splits the vocab.
+
+    Stacked layer tensors carry a leading layer axis for the scan; when the
+    plan runs pipeline parallelism (pp > 1) that axis is sharded over
+    ``pp`` — each stage holds exactly its own layers, which is both the
+    memory story (params / pp per device) and what the pipeline's
+    shard_map consumes directly (pipeline.py).  ``config`` (a ModelConfig)
+    switches the FFN leaves to the MoE layout (experts over ``ep``) when
+    its ``moe`` field is set.
     """
     s = plan.spec
+    pp = "pp" if plan.axes.get("pp", 1) > 1 else None
+
+    def layer(*names):
+        return s(pp, *names)
+
+    layers = {
+        "attn_norm": layer(None),
+        "wq": layer(None, "tp"),
+        "wk": layer(None, "tp"),
+        "wv": layer(None, "tp"),
+        "wo": layer("tp", None),
+        "mlp_norm": layer(None),
+    }
+    if config is not None and config.moe is not None:
+        layers["moe"] = {
+            "router": layer(None, None),
+            "w_gate": layer("ep", None, "tp"),
+            "w_up": layer("ep", None, "tp"),
+            "w_down": layer("ep", "tp", None),
+        }
+    else:
+        layers.update({
+            "w_gate": layer(None, "tp"),
+            "w_up": layer(None, "tp"),
+            "w_down": layer("tp", None),
+        })
     return {
         "embed": s(None, None),
-        "layers": {
-            "attn_norm": s(None, None),
-            "wq": s(None, None, "tp"),
-            "wk": s(None, None, "tp"),
-            "wv": s(None, None, "tp"),
-            "wo": s(None, "tp", None),
-            "mlp_norm": s(None, None),
-            "w_gate": s(None, None, "tp"),
-            "w_up": s(None, None, "tp"),
-            "w_down": s(None, "tp", None),
-        },
+        "layers": layers,
         "final_norm": s(None),
         "lm_head": s(None, "tp"),
     }
 
 
-def param_shardings(plan: MeshPlan) -> dict:
+def param_shardings(plan: MeshPlan, config=None) -> dict:
     return jax.tree.map(lambda spec: NamedSharding(plan.mesh, spec),
-                        param_specs(plan),
+                        param_specs(plan, config),
                         is_leaf=lambda x: isinstance(x, P))
 
 

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import optax
 
 from tputopo.workloads import sharding as shardlib
-from tputopo.workloads.model import ModelConfig, forward, init_params
+from tputopo.workloads.model import ModelConfig, forward_with_aux, init_params
 
 
 @jax.tree_util.register_dataclass
@@ -43,19 +43,24 @@ def make_train_state(config: ModelConfig, key: jax.Array,
                       step=jnp.zeros((), jnp.int32))
 
 
-def loss_fn(params: Any, tokens: jax.Array, config: ModelConfig) -> jax.Array:
-    """Next-token cross-entropy over [B, S] token ids (last position dropped)."""
-    logits = forward(params, tokens, config)  # [B, S, V] f32
+def loss_fn(params: Any, tokens: jax.Array, config: ModelConfig,
+            forward_fn=forward_with_aux) -> jax.Array:
+    """Next-token cross-entropy over [B, S] token ids (last position
+    dropped), plus the router load-balancing auxiliary for MoE configs.
+    ``forward_fn`` swaps in the pipelined forward (pipeline.py)."""
+    logits, aux = forward_fn(params, tokens, config)  # [B, S, V] f32
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return jnp.mean(nll) + aux
 
 
 def train_step(state: TrainState, tokens: jax.Array, config: ModelConfig,
-               lr: float = 3e-4) -> tuple[TrainState, jax.Array]:
+               lr: float = 3e-4,
+               forward_fn=forward_with_aux) -> tuple[TrainState, jax.Array]:
     """One optimizer step; jit-able as-is (config/lr static via closure)."""
-    loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, config)
+    loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, config,
+                                              forward_fn)
     opt = make_optimizer(lr)
     updates, opt_state = opt.update(grads, state.opt_state, state.params)
     params = optax.apply_updates(state.params, updates)
@@ -68,7 +73,7 @@ def state_shardings(plan: shardlib.MeshPlan, config: ModelConfig,
     """NamedSharding pytree for the full TrainState: params per the
     Megatron-style layout, AdamW moments mirroring the params they track,
     scalars replicated."""
-    pshard = shardlib.param_shardings(plan)
+    pshard = shardlib.param_shardings(plan, config)
 
     def fix(node):
         if isinstance(node, optax.ScaleByAdamState):
@@ -85,18 +90,26 @@ def state_shardings(plan: shardlib.MeshPlan, config: ModelConfig,
 
 
 def make_sharded_train_step(plan: shardlib.MeshPlan, config: ModelConfig,
-                            lr: float = 3e-4):
+                            lr: float = 3e-4, n_micro: int | None = None):
     """Compile train_step with explicit in/out shardings over ``plan``.
 
     Params (and therefore AdamW moments, which mirror the param pytree)
     shard per :func:`tputopo.workloads.sharding.param_specs`; batches shard
-    batch-over-dp, sequence-over-sp.  Donates the state buffers.
+    batch-over-dp, sequence-over-sp.  Donates the state buffers.  When the
+    plan has pp > 1 the forward pass runs the SPMD pipeline
+    (:mod:`tputopo.workloads.pipeline`) with ``n_micro`` microbatches.
     """
     shardings = state_shardings(plan, config, lr)
+    if plan.axes.get("pp", 1) > 1:
+        from tputopo.workloads.pipeline import pipelined_forward_with_aux
+
+        fwd = partial(pipelined_forward_with_aux, plan=plan, n_micro=n_micro)
+    else:
+        fwd = forward_with_aux
 
     def step_fn(state: TrainState, tokens: jax.Array):
         with shardlib.activate(plan):
-            return train_step(state, tokens, config, lr)
+            return train_step(state, tokens, config, lr, forward_fn=fwd)
 
     return jax.jit(
         step_fn,
