@@ -362,8 +362,18 @@ def bench_workload_mfu() -> dict | None:
         # Train step (fwd+bwd): flash always; einsum attempted — its
         # backward keeps every layer's S^2 probabilities resident, so at
         # this shape it is expected to exhaust HBM, which is the honest
-        # form of the "flash wins" claim.
-        t_train = _measure_train_s(flash_cfg, batch, seq, overhead_s=overhead)
+        # form of the "flash wins" claim.  The flash train prefers the
+        # "dots" remat policy (keep matmul outputs, ~5% faster on v5e)
+        # and falls back to full per-block remat if HBM refuses.
+        try:
+            t_train = _measure_train_s(
+                ModelConfig(**base, attn_impl="flash", remat="dots"),
+                batch, seq, overhead_s=overhead)
+            out["train_remat"] = "dots"
+        except Exception:
+            t_train = _measure_train_s(flash_cfg, batch, seq,
+                                       overhead_s=overhead)
+            out["train_remat"] = "block"
         train_flops = 3.0 * flops
         out["train_step_ms"] = round(t_train * 1e3, 3)
         out["train_tokens_per_s"] = round(batch * seq / t_train)

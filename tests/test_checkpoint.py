@@ -46,6 +46,40 @@ def test_save_restore_roundtrip_across_meshes(tmp_path):
     assert int(restored.step) == 4 and bool(jnp.isfinite(loss))
 
 
+def test_moe_pipeline_state_restores_across_plans(tmp_path):
+    """A pipelined-MoE TrainState (expert tables over ep, layer stacks over
+    pp) checkpointed from one plan restores onto a plain dp/tp plan — the
+    re-placement flow must not depend on the parallelism recipe."""
+    from tputopo.workloads.moe import MoEConfig
+
+    cfg = ModelConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=64, max_seq=32,
+                      compute_dtype=jnp.float32,
+                      moe=MoEConfig(n_experts=4, top_k=2,
+                                    capacity_factor=2.0))
+    plan = build_mesh({"pp": 2, "ep": 2, "tp": 2})
+    state = make_sharded_state(plan, cfg, jax.random.key(0))
+    step = make_sharded_train_step(plan, cfg)
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 64, (4, 16)))
+    state, _ = step(state, toks)
+    assert ckpt.save(tmp_path, state) == 1
+
+    plan2 = build_mesh({"dp": 4, "sp": 1, "tp": 2})
+    target = make_sharded_state(plan2, cfg, jax.random.key(9))
+    restored = ckpt.restore(tmp_path, target)
+    assert restored is not None and int(restored.step) == 1
+    for a, b in zip(jax.tree.leaves(jax.device_get(state.params)),
+                    jax.tree.leaves(jax.device_get(restored.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Expert tables land UNsplit on the ep-less plan, replicated layers.
+    wg = restored.params["layers"]["moe"]["w_gate"]  # [L, E, D, F]
+    assert {s.data.shape for s in wg.addressable_shards} == {
+        (cfg.n_layers, 4, cfg.d_model, cfg.d_ff // 2)}
+    step2 = make_sharded_train_step(plan2, cfg)
+    restored, loss = step2(restored, toks)
+    assert int(restored.step) == 2 and bool(jnp.isfinite(loss))
+
+
 def test_restore_empty_dir_returns_none(tmp_path):
     plan = build_mesh({"dp": 2, "sp": 1, "tp": 4})
     target = make_sharded_state(plan, CFG, jax.random.key(0))

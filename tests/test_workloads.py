@@ -75,6 +75,29 @@ def test_plan_mesh_policy():
         plan_mesh(8, pp=3)
 
 
+def test_remat_policies_agree():
+    """remat is a memory policy, not math: block/dots/none forwards and
+    grads must agree up to f32 noise."""
+    import dataclasses
+
+    toks = make_batch(TINY, batch=2, seq=16)
+    grads = {}
+    for remat in ("block", "dots", "none"):
+        cfg = dataclasses.replace(TINY, remat=remat)
+        params = init_params(cfg, jax.random.key(0))
+        loss, g = jax.value_and_grad(loss_fn)(params, toks, cfg)
+        grads[remat] = (float(loss), jax.tree.leaves(g))
+    for remat in ("dots", "none"):
+        assert grads[remat][0] == pytest.approx(grads["block"][0], rel=1e-6)
+        for a, b in zip(grads[remat][1], grads["block"][1]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+    cfg = dataclasses.replace(TINY, remat="bogus")
+    with pytest.raises(ValueError, match="remat"):
+        jax.eval_shape(lambda p: loss_fn(p, toks, cfg),
+                       init_params(cfg, jax.random.key(0)))
+
+
 def test_constrain_is_noop_without_plan():
     x = jnp.ones((4, 4))
     assert shardlib.constrain(x, "dp", None) is x

@@ -36,8 +36,8 @@ import jax.numpy as jnp
 
 from jax.sharding import PartitionSpec as P
 
-from tputopo.workloads.model import (ModelConfig, _rope_tables, embed_tokens,
-                                     lm_head, transformer_block)
+from tputopo.workloads.model import (ModelConfig, _rope_tables, apply_remat,
+                                     embed_tokens, lm_head, transformer_block)
 from tputopo.workloads.sharding import MeshPlan
 
 
@@ -50,10 +50,7 @@ def _stage_body(layers_local, x, config, cos, sin):
         out, a = transformer_block(x, layer, c, cos, sin)
         return (out, aux + a), None
 
-    if c.remat == "block":
-        block = jax.checkpoint(block)
-    elif c.remat != "none":
-        raise ValueError(f"unknown remat policy {c.remat!r}")
+    block = apply_remat(block, c.remat)
     (x, aux), _ = jax.lax.scan(block, (x, jnp.float32(0)), layers_local)
     return x, aux
 
