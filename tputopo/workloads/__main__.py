@@ -109,6 +109,24 @@ def cmd_train(args) -> int:
     return 0 if losses[-1] < losses[0] or resumed_from else 1
 
 
+def cmd_train_vision(args) -> int:
+    import jax
+
+    from tputopo.workloads.sharding import mesh_for_slice
+    from tputopo.workloads.vision import VisionConfig, train_vision
+
+    n = jax.device_count()
+    plan = mesh_for_slice((n,), tp=1)  # pure data parallel, the Exp.6 shape
+    batch = max(plan.axes["dp"], args.batch // plan.axes["dp"]
+                * plan.axes["dp"])
+    losses = train_vision(plan, VisionConfig(), steps=args.steps, batch=batch)
+    print(json.dumps({
+        "devices": n, "mesh": plan.axes, "steps": args.steps,
+        "first_loss": round(losses[0], 4), "last_loss": round(losses[-1], 4),
+    }))
+    return 0 if losses[-1] < losses[0] else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(prog="tputopo-workload")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -140,6 +158,12 @@ def main() -> int:
                         "(and every --save-every steps)")
     p.add_argument("--save-every", type=int, default=0)
     p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("train-vision",
+                       help="conv classifier, data parallel (Gaia Exp.6 analog)")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch", type=int, default=64)
+    p.set_defaults(fn=cmd_train_vision)
 
     args = ap.parse_args()
     return args.fn(args)

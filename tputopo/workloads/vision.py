@@ -1,0 +1,145 @@
+"""Second model family: a convolutional image classifier.
+
+The reference's only end-to-end workload evidence is MNIST classifiers
+trained under both schedulers (Gaia PDF §IV Exp.6, Fig. 11-12 — Caffe /
+PyTorch / TensorFlow wall-time A/B).  This module is that acceptance
+workload rebuilt TPU-first, so the framework ships the same *family* of
+proof (a small vision model converging on the scheduled slice) alongside
+the flagship LM:
+
+- NHWC bf16 convolutions: `lax.conv_general_dilated` with feature counts
+  in MXU-friendly multiples; compute dtype bf16 over f32 params, same
+  policy as the LM.
+- data parallel over ``dp`` (the parallelism Exp.6's jobs used), batch
+  sharded at the input, gradient all-reduce inserted by XLA at the
+  replicated-param boundary — riding the contiguous slice's ICI ring.
+- static shapes, one jitted train step, no Python in the hot path.
+
+Synthetic structured data (class-conditional patterns + noise) stands in
+for MNIST — the image has no dataset dependency, and the convergence
+check (loss must drop to near-zero memorization like Exp.6's short runs)
+is what the pod exit code reports.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tputopo.workloads import sharding as shardlib
+from tputopo.workloads.sharding import constrain
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 28
+    channels: int = 1
+    n_classes: int = 10
+    widths: tuple = (32, 64)   # conv feature counts, stride-2 stages
+    d_hidden: int = 128
+    compute_dtype: Any = jnp.bfloat16
+
+
+def init_vision_params(cfg: VisionConfig, key: jax.Array) -> dict:
+    ks = jax.random.split(key, len(cfg.widths) + 2)
+
+    def he(k, shape, fan_in):
+        return jax.random.normal(k, shape, jnp.float32) * math.sqrt(2.0 / fan_in)
+
+    params = {}
+    c_in = cfg.channels
+    for i, c_out in enumerate(cfg.widths):
+        params[f"conv{i}"] = he(ks[i], (3, 3, c_in, c_out), 9 * c_in)
+        c_in = c_out
+    side = cfg.image_size // (2 ** len(cfg.widths))
+    flat = side * side * c_in
+    params["fc1"] = he(ks[-2], (flat, cfg.d_hidden), flat)
+    params["fc2"] = he(ks[-1], (cfg.d_hidden, cfg.n_classes), cfg.d_hidden)
+    return params
+
+
+def vision_forward(params: dict, images: jax.Array,
+                   cfg: VisionConfig) -> jax.Array:
+    """images [B, H, W, C] float -> logits [B, n_classes] f32."""
+    x = constrain(images.astype(cfg.compute_dtype), "dp", None, None, None)
+    for i in range(len(cfg.widths)):
+        w = params[f"conv{i}"].astype(x.dtype)
+        x = jax.lax.conv_general_dilated(
+            x, w, window_strides=(2, 2), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = jax.nn.relu(x)
+        x = constrain(x, "dp", None, None, None)
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["fc1"].astype(x.dtype))
+    logits = x.astype(jnp.float32) @ params["fc2"]
+    return constrain(logits, "dp", None)
+
+
+def synthetic_batch(cfg: VisionConfig, batch: int, seed: int
+                    ) -> tuple[jax.Array, jax.Array]:
+    """Class-conditional structured images: class k gets a bright kxk-ish
+    block at a class-determined position plus noise — linearly separable
+    enough to converge fast, non-trivial enough that a broken grad path
+    shows up as a flat loss."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, cfg.n_classes, batch)
+    imgs = rng.normal(0, 0.3, (batch, cfg.image_size, cfg.image_size,
+                               cfg.channels)).astype(np.float32)
+    for i, k in enumerate(labels):
+        r = (k * 2) % (cfg.image_size - 6)
+        c = (k * 5) % (cfg.image_size - 6)
+        imgs[i, r:r + 6, c:c + 6, :] += 2.0
+    return jnp.asarray(imgs), jnp.asarray(labels)
+
+
+def vision_loss(params: dict, images: jax.Array, labels: jax.Array,
+                cfg: VisionConfig) -> jax.Array:
+    logits = vision_forward(params, images, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+
+
+def make_vision_train_step(plan: shardlib.MeshPlan, cfg: VisionConfig,
+                           lr: float = 1e-3):
+    """Data-parallel jitted train step: params replicated, batch over dp,
+    one gradient all-reduce per step (XLA-inserted) — the Exp.6 shape."""
+    opt = optax.adam(lr)
+
+    def step(params, opt_state, images, labels):
+        with shardlib.activate(plan):
+            loss, grads = jax.value_and_grad(vision_loss)(
+                params, images, labels, cfg)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    repl = plan.replicated()
+    batch_sh = plan.sharding("dp", None, None, None)
+    label_sh = plan.sharding("dp")
+    return jax.jit(step,
+                   in_shardings=(repl, repl, batch_sh, label_sh),
+                   out_shardings=(repl, repl, repl),
+                   donate_argnums=(0, 1)), opt
+
+
+def train_vision(plan: shardlib.MeshPlan, cfg: VisionConfig, *,
+                 steps: int = 20, batch: int = 64, lr: float = 1e-3,
+                 seed: int = 0) -> list[float]:
+    """Run ``steps`` memorization steps on one synthetic batch; returns the
+    loss trace (a working setup drives it sharply down, Exp.6-style)."""
+    params = init_vision_params(cfg, jax.random.key(seed))
+    step_fn, opt = make_vision_train_step(plan, cfg, lr)
+    opt_state = opt.init(params)
+    images, labels = synthetic_batch(cfg, batch, seed)
+    losses = []
+    for _ in range(steps):
+        params, opt_state, loss = step_fn(params, opt_state, images, labels)
+        losses.append(float(loss))
+    return losses
