@@ -42,6 +42,7 @@ def bench_scheduler(repeats: int = 5) -> dict:
     from tputopo.extender.scheduler import ExtenderScheduler
     from tputopo.extender.state import ClusterState
     from tputopo.k8s import make_pod
+    from tputopo.k8s.informer import Informer
     from tputopo.topology.score import predict_allreduce_gbps
     from tputopo.topology.slices import enumerate_shapes
 
@@ -50,7 +51,12 @@ def bench_scheduler(repeats: int = 5) -> dict:
 
     for rep in range(repeats):
         api, _ = build_cluster(spec="v5p:4x4x4", workers=16)
-        sched = ExtenderScheduler(api, ExtenderConfig())
+        # The deployed extender serves sort from the list+watch informer
+        # mirror (server.py main wires one); bench the same configuration.
+        # Short watch timeout only so the end-of-rep stop() is quick.
+        informer = Informer(api, watch_timeout_s=2.0).start()
+        informer.wait_synced()
+        sched = ExtenderScheduler(api, ExtenderConfig(), informer=informer)
         nodes = [n["metadata"]["name"] for n in api.list("nodes")]
 
         # True ideal bandwidth per request size: best box shape of volume k
@@ -108,6 +114,7 @@ def bench_scheduler(repeats: int = 5) -> dict:
 
         if len(set(gang_chips)) != 16:
             raise SystemExit("bench: gang replicas did not tile disjointly")
+        informer.stop()
 
     lat_ms.sort()
     return {

@@ -200,3 +200,58 @@ def test_end_to_end_schedule_through_watchful_rest_apiserver():
                 "pods", "job", "default")["spec"].get("nodeName") == best["Host"])
         finally:
             inf.stop()
+
+
+def test_bind_write_through_visible_without_watch():
+    """The assume-cache leg: a sort issued IMMEDIATELY after a bind must
+    plan against the bound state even if no watch event has been processed
+    (kube-scheduler cache pattern).  Proven by freezing the watch threads:
+    the informer is stopped after sync, so only bind's write-through
+    observe() can update the mirror."""
+    api = FakeApiServer()
+    build_cluster(api=api, spec="v5p:2x2x1", workers=1)
+    inf = Informer(api, watch_timeout_s=0.2).start()
+    assert inf.wait_synced(10)
+    inf.stop()  # freeze: watch can never deliver anything again
+    sched = ExtenderScheduler(api, ExtenderConfig(), informer=inf)
+
+    api.create("pods", make_pod("a", chips=2))
+    api.create("pods", make_pod("b", chips=2))
+    pod_a = api.get("pods", "a", "default")
+    pod_b = api.get("pods", "b", "default")
+
+    assert max(s["Score"] for s in sched.sort(pod_a, ["node-0"])) > 0
+    da = sched.bind("a", "default", "node-0")
+    assert sched.informer.metrics["observes"] >= 1
+    # The 2x2 slice has 2 free chips left; sort for b must reflect that
+    # (score from a half-used node), and bind b onto the OTHER pair.
+    scores = sched.sort(pod_b, ["node-0"])
+    assert max(s["Score"] for s in scores) > 0
+    db = sched.bind("b", "default", "node-0")
+    assert not (set(map(tuple, da["chips"])) & set(map(tuple, db["chips"]))), \
+        "write-through failed: second sort/bind reused assigned chips"
+
+
+def test_observe_newest_resource_version_wins():
+    """A delayed watch event older than a write-through observe must not
+    regress the mirror."""
+    api = FakeApiServer()
+    inf = Informer(api, kinds=("pods",), watch_timeout_s=0.2)
+    new = {"metadata": {"name": "p", "namespace": "default",
+                        "resourceVersion": "7",
+                        "annotations": {"x": "new"}}}
+    old_event = {"type": "MODIFIED", "rv": "3",
+                 "object": {"metadata": {"name": "p", "namespace": "default",
+                                         "resourceVersion": "3",
+                                         "annotations": {"x": "old"}}}}
+    inf._synced["pods"].set()
+    inf.observe("pods", new)
+    v1 = inf.version()
+    inf._apply("pods", old_event)
+    assert inf.get("pods", "p", "default")["metadata"]["annotations"]["x"] == "new"
+    # And a NEWER event does land.
+    inf._apply("pods", {"type": "MODIFIED", "rv": "9", "object": {
+        "metadata": {"name": "p", "namespace": "default",
+                     "resourceVersion": "9", "annotations": {"x": "newest"}}}})
+    assert inf.get("pods", "p", "default")["metadata"]["annotations"]["x"] == "newest"
+    assert inf.version() != v1  # observe/events both move the coherence token

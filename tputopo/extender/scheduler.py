@@ -307,7 +307,29 @@ class ExtenderScheduler:
         when the gang cannot fit.  One ICI-contiguous domain is always
         preferred; gangs labeled tpu.dev/allow-multislice=true may split
         across domains (replica sync rides DCN between slices) when no
-        single domain has room."""
+        single domain has room.
+
+        Memoized on the ``state`` instance: sorting an N-member gang calls
+        this once per member against the same derived state, and the state
+        object is rebuilt whenever the cluster mirror changes (the
+        informer-version cache key in ``_state``), so the memo can never
+        outlive the facts it was computed from."""
+        namespace, gang_id, size = gang
+        memo = getattr(state, "_gang_ctx_memo", None)
+        if memo is None:
+            memo = state._gang_ctx_memo = {}
+        memo_key = (namespace, gang_id, size, k, wanted_gen, reader is None)
+        if memo_key in memo:
+            self.metrics.inc("gang_ctx_memo_hits")
+            return memo[memo_key]
+        memo[memo_key] = result = self._gang_context_uncached(
+            state, gang, k, wanted_gen, reader)
+        return result
+
+    def _gang_context_uncached(self, state: ClusterState,
+                               gang: tuple[str, str, int], k: int,
+                               wanted_gen: str | None = None,
+                               reader=None) -> dict | None:
         namespace, gang_id, size = gang
         members = self._gang_members(namespace, gang_id, reader=reader)
         bound = [p for p in members if p["spec"].get("nodeName")]
@@ -555,6 +577,17 @@ class ExtenderScheduler:
         except (Conflict, NotFound) as e:
             self.metrics.inc("bind_errors")
             raise BindError(f"bind race on {pod_name}: {e}") from e
+        if self.informer is not None:
+            # Write-through assume cache: the NEXT sort must see this bind
+            # without waiting a watch round-trip, or it plans against
+            # pre-bind state and hands out already-assigned chips (the
+            # kube-scheduler cache pattern; bind itself stays authoritative
+            # against the API server either way).
+            try:
+                self.informer.observe(
+                    "pods", self.api.get("pods", pod_name, namespace))
+            except NotFound:  # deleted between bind and read-back: watch
+                pass          # will deliver the DELETE; nothing to assume
 
         decision = {
             "pod": f"{namespace}/{pod_name}",

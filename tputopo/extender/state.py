@@ -91,11 +91,20 @@ class ClusterState:
 
     # ---- sync (SURVEY.md §3.2: parse annotations -> in-memory model) -------
 
+    def _list(self, kind: str) -> list[dict]:
+        """List via the reader; sync only PARSES the objects (tuples/sets
+        of its own are what it keeps), so copy-free readers (the informer
+        mirror) are asked not to deepcopy."""
+        try:
+            return self.api.list(kind, copy=False)
+        except TypeError:  # reader without a copy kwarg (fake/REST client)
+            return self.api.list(kind)
+
     def sync(self) -> "ClusterState":
         self.domains = {}
         self.expired = []
         self.conflicts = []
-        for node in self.api.list("nodes"):
+        for node in self._list("nodes"):
             anns = node["metadata"].get("annotations", {})
             if ko.ANN_TOPOLOGY not in anns or ko.ANN_SLICE_ID not in anns:
                 continue  # not a TPU node
@@ -131,7 +140,7 @@ class ClusterState:
         valid_chips = {sid: set(dom.topology.chips)
                        for sid, dom in self.domains.items()}
         pods = sorted(
-            self.api.list("pods"),
+            self._list("pods"),
             key=lambda p: (
                 _assume_time_of(p),
                 p["metadata"].get("namespace", "default"),
