@@ -204,3 +204,38 @@ def test_largest_free_box_bounded_on_256_chip_torus():
     elapsed = time.perf_counter() - t0
     assert vol == 4 and sorted(dims) == [2, 2]
     assert elapsed < 1.0, f"largest_free_box took {elapsed:.2f}s"
+
+
+def test_mask_geometry_matches_set_semantics():
+    """The bitmask fast path (box_mask/free_mask/neighbor popcount) must be
+    observationally identical to the set-based definitions on random
+    occupancy states."""
+    import random
+
+    from tputopo.topology import parse_topology
+    from tputopo.topology.slices import (
+        Allocator, _boxes_for, _free_boundary, box_chips, chips_mask,
+        enumerate_placements, enumerate_shapes,
+    )
+
+    topo = parse_topology("v5p:4x4x4")
+    rng = random.Random(7)
+    chips = list(topo.chips)
+    for trial in range(20):
+        used = set(rng.sample(chips, rng.randint(0, 48)))
+        free = frozenset(c for c in chips if c not in used)
+        fmask = chips_mask(topo, free)
+        for k in (2, 4, 8):
+            for shape in enumerate_shapes(topo, k, Allocator(topo).cost):
+                placements = enumerate_placements(topo, shape, free)
+                # set-based reference for the same shape
+                ref = []
+                for o, bchips, mask, nbr in _boxes_for(topo, shape.dims):
+                    assert bchips == box_chips(topo, o, shape.dims)
+                    feasible_ref = all(c in free for c in bchips)
+                    assert feasible_ref == (mask & fmask == mask), (o, shape)
+                    if feasible_ref:
+                        ref.append(bchips)
+                        assert (nbr & fmask).bit_count() == _free_boundary(
+                            topo, frozenset(bchips), free)
+                assert [p.chips for p in placements] == ref

@@ -121,16 +121,77 @@ def box_chips(topo: ChipTopology, origin: Coord, dims: tuple[int, ...]) -> tuple
     return tuple(sorted(cells))
 
 
+# ---- static box geometry, precomputed per topology --------------------------
+#
+# The torus is regular and known, so the candidate-box vocabulary is STATIC:
+# every (shape, origin) pair's chip set and free-neighbor set can be computed
+# once per topology and reduced to bitmasks over the chip index.  The sort
+# hot loop's feasibility test then costs one big-int AND per candidate
+# instead of |box| set lookups (measured ~6 ms -> sub-ms per sort on the
+# bench's v5p-128 domain), and the fragmentation tiebreak is a popcount.
+# Keyed by the topology's value identity (generation/dims/wrap), never
+# by object id — Allocators are rebuilt per ClusterState sync.
+
+_GEO_CACHE: dict[tuple, dict] = {}
+
+
+def _topo_key(topo: ChipTopology) -> tuple:
+    return (topo.generation.name, tuple(topo.dims), tuple(topo.wrap))
+
+
+def _geometry(topo: ChipTopology) -> dict:
+    key = _topo_key(topo)
+    geo = _GEO_CACHE.get(key)
+    if geo is None:
+        geo = _GEO_CACHE[key] = {
+            "index": {c: i for i, c in enumerate(topo.chips)},
+            "boxes": {},
+        }
+    return geo
+
+
+def _boxes_for(topo: ChipTopology, dims: tuple[int, ...]
+               ) -> list[tuple[Coord, tuple[Coord, ...], int, int]]:
+    """[(origin, chips, box_mask, neighbor_mask)] for every placement of
+    ``dims``; neighbor_mask covers chips adjacent to the box, box excluded."""
+    geo = _geometry(topo)
+    entry = geo["boxes"].get(dims)
+    if entry is None:
+        idx = geo["index"]
+        entry = []
+        for o in _origins(topo, dims):
+            chips = box_chips(topo, o, dims)
+            mask = 0
+            for c in chips:
+                mask |= 1 << idx[c]
+            nbr = 0
+            for c in chips:
+                for n in topo.neighbors(c):
+                    nbr |= 1 << idx[n]
+            entry.append((o, chips, mask, nbr & ~mask))
+        geo["boxes"][dims] = entry
+    return entry
+
+
+def chips_mask(topo: ChipTopology, chips) -> int:
+    """Bitmask of a chip collection over the topology's chip index."""
+    idx = _geometry(topo)["index"]
+    m = 0
+    for c in chips:
+        m |= 1 << idx[c]
+    return m
+
+
 def enumerate_placements(topo: ChipTopology, shape: SliceShape,
                          free: frozenset[Coord],
                          cost: LinkCostModel | None = None) -> list[Placement]:
     """All placements of ``shape`` whose chips are entirely free."""
     cost = cost or LinkCostModel.for_generation(topo.generation.name)
     score = predict_allreduce_gbps(topo, shape.dims, cost)
+    fmask = chips_mask(topo, free)
     out = []
-    for o in _origins(topo, shape.dims):
-        chips = box_chips(topo, o, shape.dims)
-        if all(c in free for c in chips):
+    for o, chips, mask, _nbr in _boxes_for(topo, shape.dims):
+        if mask & fmask == mask:
             out.append(Placement(chips=chips, origin=o, dims=shape.dims,
                                  score_gbps=score))
     return out
@@ -213,17 +274,25 @@ class Allocator:
     def _pick_box(self, k: int, free: frozenset[Coord]) -> Placement | None:
         best: tuple | None = None
         best_p: Placement | None = None
+        fmask = chips_mask(self.topo, free)
         for shape in enumerate_shapes(self.topo, k, self.cost):
             shape_score = predict_allreduce_gbps(self.topo, shape.dims, self.cost)
             # Shapes arrive best-bandwidth-first; once a placement exists, a
             # strictly worse shape can never win the primary key.
             if best_p is not None and shape_score < best_p.score_gbps:
                 break
-            for p in enumerate_placements(self.topo, shape, free, self.cost):
-                frag = _free_boundary(self.topo, frozenset(p.chips), free)
-                key = (-p.score_gbps, frag, p.chips)
+            for o, chips, mask, nbr in _boxes_for(self.topo, shape.dims):
+                if mask & fmask != mask:
+                    continue
+                # Fragmentation damage == free chips adjacent to the box
+                # (_free_boundary semantics) as a popcount.
+                frag = (nbr & fmask).bit_count()
+                key = (-shape_score, frag, chips)
                 if best is None or key < best:
-                    best, best_p = key, p
+                    best = key
+                    best_p = Placement(chips=chips, origin=o,
+                                       dims=shape.dims,
+                                       score_gbps=shape_score)
         return best_p
 
     def _pick_blob(self, k: int, free: frozenset[Coord]) -> Placement | None:
