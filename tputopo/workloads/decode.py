@@ -1,0 +1,148 @@
+"""Autoregressive decoding with a KV cache — the LM workload's serving leg.
+
+Training proves placement quality by step time; serving proves it by
+decode throughput, and a KV-cached decode loop is the piece a user coming
+from any LM stack will look for.  TPU-first formulation:
+
+- the KV cache is a pair of PREALLOCATED [L, B, S_max, KV, H] buffers
+  updated in place with `lax.dynamic_update_index_in_dim` — static shapes
+  throughout, so the whole generate loop is ONE compiled `lax.scan` (no
+  per-token retrace, no growing arrays).
+- each step runs the stacked-layer scan with a single query position;
+  attention over the cache is masked by the current length (iota mask, no
+  host-side bookkeeping).
+- cache layout puts heads/features innermost so the per-step attention
+  reads are contiguous lanes; the cache shards like activations (batch
+  over ``dp``, heads over ``tp`` via the usual constraints).
+
+Greedy decoding only — sampling policies are orthogonal to the framework
+story and deliberately out of scope (README non-goals style).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from tputopo.workloads.model import (ModelConfig, _apply_rope, _rmsnorm,
+                                     _rope_tables)
+from tputopo.workloads.sharding import constrain
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # [L, B, S_max, KV, H]
+    v: jax.Array  # [L, B, S_max, KV, H]
+
+    @staticmethod
+    def create(config: ModelConfig, batch: int, max_len: int) -> "KVCache":
+        c = config
+        shape = (c.n_layers, batch, max_len, c.n_kv_heads, c.head_dim)
+        return KVCache(k=jnp.zeros(shape, c.compute_dtype),
+                       v=jnp.zeros(shape, c.compute_dtype))
+
+
+def _attend_cached(q, ck, cv, pos, group: int):
+    """q [B, 1, N, H] against cache [B, S_max, KV, H], positions > pos
+    masked.  Returns [B, 1, N, H]."""
+    if group > 1:
+        ck = jnp.repeat(ck, group, axis=2)
+        cv = jnp.repeat(cv, group, axis=2)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqnh,bknh->bnqk", q.astype(jnp.float32),
+                   ck.astype(jnp.float32)) * scale
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+    s = jnp.where(k_pos <= pos, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bnqk,bknh->bqnh", p,
+                      cv.astype(jnp.float32)).astype(q.dtype)
+
+
+def _decode_step(params: dict, config: ModelConfig, token: jax.Array,
+                 pos: jax.Array, cache: KVCache,
+                 cos: jax.Array, sin: jax.Array
+                 ) -> tuple[jax.Array, KVCache]:
+    """One token [B] at position ``pos`` -> (logits [B, V], updated cache)."""
+    c = config
+    B = token.shape[0]
+    group = c.n_heads // c.n_kv_heads
+    x = params["embed"].astype(c.compute_dtype)[token][:, None, :]  # [B,1,D]
+    x = constrain(x, "dp", None, None)
+    cos_t = jax.lax.dynamic_slice_in_dim(cos, pos, 1, axis=0)
+    sin_t = jax.lax.dynamic_slice_in_dim(sin, pos, 1, axis=0)
+
+    def layer_step(carry, inp):
+        x = carry
+        layer, ck_l, cv_l = inp
+        h = _rmsnorm(x, layer["attn_norm"], c.norm_eps)
+        q = (h @ layer["wq"].astype(h.dtype)).reshape(B, 1, c.n_heads, c.head_dim)
+        k = (h @ layer["wk"].astype(h.dtype)).reshape(B, 1, c.n_kv_heads, c.head_dim)
+        v = (h @ layer["wv"].astype(h.dtype)).reshape(B, 1, c.n_kv_heads, c.head_dim)
+        q = _apply_rope(q, cos_t, sin_t)
+        k = _apply_rope(k, cos_t, sin_t)
+        ck_l = jax.lax.dynamic_update_index_in_dim(ck_l, k[:, 0], pos, axis=1)
+        cv_l = jax.lax.dynamic_update_index_in_dim(cv_l, v[:, 0], pos, axis=1)
+        q = constrain(q, "dp", None, "tp", None)
+        out = _attend_cached(q, ck_l, cv_l, pos, group)
+        out = out.reshape(B, 1, c.n_heads * c.head_dim)
+        x = x + out @ layer["wo"].astype(x.dtype)
+        h2 = _rmsnorm(x, layer["mlp_norm"], c.norm_eps)
+        if c.moe is not None:
+            from tputopo.workloads.moe import moe_mlp
+
+            y, _ = moe_mlp(h2, layer["moe"], c)
+        else:
+            gate = jax.nn.silu(h2 @ layer["w_gate"].astype(h2.dtype))
+            up = h2 @ layer["w_up"].astype(h2.dtype)
+            y = (gate * up) @ layer["w_down"].astype(h2.dtype)
+        return x + y, (ck_l, cv_l)
+
+    x, (ck, cv) = jax.lax.scan(layer_step, x,
+                               (params["layers"], cache.k, cache.v))
+    x = _rmsnorm(x, params["final_norm"], c.norm_eps)
+    logits = (x.astype(jnp.float32) @ params["lm_head"])[:, 0]
+    return logits, KVCache(k=ck, v=cv)
+
+
+def generate(params: dict, prompt: jax.Array, config: ModelConfig, *,
+             max_new: int, max_len: int | None = None) -> jax.Array:
+    """Greedy decode: prompt [B, P] -> [B, P + max_new] token ids.
+
+    One jitted program: prompt prefill feeds tokens through the same
+    per-token step (simple and cache-exact; batch prefill is a future
+    fusion), then max_new greedy steps — all inside `lax.scan`."""
+    c = config
+    B, P = prompt.shape
+    total = P + max_new
+    max_len = max_len or total
+    if max_len < total:
+        raise ValueError(f"max_len {max_len} < prompt {P} + new {max_new}")
+    cos, sin = _rope_tables(c, max_len)
+    cache = KVCache.create(c, B, max_len)
+
+    def step(carry, t):
+        tokens, cache = carry
+        token_t = jax.lax.dynamic_index_in_dim(tokens, t, axis=1,
+                                               keepdims=False)
+        logits, cache = _decode_step(params, c, token_t, t, cache, cos, sin)
+        nxt = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+        # Positions < P - 1 keep the prompt; beyond it the greedy token
+        # becomes input t+1 (teacher forcing inside the prompt).
+        write_at = jnp.minimum(t + 1, total - 1)
+        cur = jax.lax.dynamic_index_in_dim(tokens, write_at, axis=1,
+                                           keepdims=False)
+        chosen = jnp.where(t + 1 < P, cur, nxt)
+        tokens = jax.lax.dynamic_update_index_in_dim(
+            tokens, chosen, write_at, axis=1)
+        return (tokens, cache), None
+
+    tokens0 = jnp.concatenate(
+        [prompt, jnp.zeros((B, max_new), prompt.dtype)], axis=1)
+    (tokens, _), _ = jax.lax.scan(step, (tokens0, cache),
+                                  jnp.arange(total - 1))
+    return tokens
+
+
+generate_jit = jax.jit(generate, static_argnames=("config", "max_new",
+                                                  "max_len"))
