@@ -47,6 +47,33 @@ def test_generate_matches_full_forward_moe():
     np.testing.assert_array_equal(out, ref)
 
 
+def test_moe_decode_is_drop_free_under_tight_capacity():
+    """Decode routes one token per step, so the training layer's capacity
+    truncation can never trigger: with a TIGHT capacity config, decode
+    must match the DROP-FREE forward (same model, ample capacity), not
+    the truncating one — the documented serving semantics."""
+    import dataclasses
+
+    tight = ModelConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                        n_kv_heads=2, d_ff=64, max_seq=64,
+                        compute_dtype=jnp.float32,
+                        moe=MoEConfig(n_experts=4, top_k=1,
+                                      capacity_factor=0.25))
+    ample = dataclasses.replace(
+        tight, moe=dataclasses.replace(tight.moe, capacity_factor=8.0))
+    params = init_params(tight, jax.random.key(2))
+    prompt = jnp.asarray(
+        np.random.default_rng(2).integers(0, 64, (2, 24)))
+    # Sanity: the tight config actually drops at this length (otherwise
+    # this test pins nothing).
+    tight_ref = _greedy_reference(params, prompt, tight, max_new=4)
+    ample_ref = _greedy_reference(params, prompt, ample, max_new=4)
+    assert not np.array_equal(tight_ref, ample_ref), \
+        "fixture too easy: no capacity drops occurred"
+    out = np.asarray(generate(params, prompt, tight, max_new=4))
+    np.testing.assert_array_equal(out, ample_ref)
+
+
 def test_cache_shapes_and_validation():
     cache = KVCache.create(CFG, batch=3, max_len=16)
     assert cache.k.shape == (2, 3, 16, 2, 8)

@@ -17,6 +17,14 @@ from any LM stack will look for.  TPU-first formulation:
 
 Greedy decoding only — sampling policies are orthogonal to the framework
 story and deliberately out of scope (README non-goals style).
+
+MoE semantics: decode routes ONE token per step, so the training layer's
+capacity truncation can never trigger — decode is exactly the drop-free
+top-k mixture (``moe_mlp_reference``).  That is the *correct* serving
+behavior (capacity drops are a training-throughput compromise, not model
+semantics); it means decode matches the training forward token-for-token
+wherever the forward dropped nothing, and upgrades dropped tokens to
+their full mixture otherwise.  The tests pin exactly this contract.
 """
 
 from __future__ import annotations
@@ -27,7 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from tputopo.workloads.model import (ModelConfig, _apply_rope, _rmsnorm,
-                                     _rope_tables)
+                                     _rope_tables, embed_tokens, lm_head)
 from tputopo.workloads.sharding import constrain
 
 
@@ -45,18 +53,24 @@ class KVCache(NamedTuple):
 
 def _attend_cached(q, ck, cv, pos, group: int):
     """q [B, 1, N, H] against cache [B, S_max, KV, H], positions > pos
-    masked.  Returns [B, 1, N, H]."""
-    if group > 1:
-        ck = jnp.repeat(ck, group, axis=2)
-        cv = jnp.repeat(cv, group, axis=2)
-    scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = jnp.einsum("bqnh,bknh->bnqk", q.astype(jnp.float32),
-                   ck.astype(jnp.float32)) * scale
-    k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
-    s = jnp.where(k_pos <= pos, s, -1e30)
+    masked.  Returns [B, 1, N, H].
+
+    GQA stays grouped: q reshapes to [B, 1, KV, group, H] and the einsums
+    read the cache at its native KV width — expanding the cache with
+    repeat would copy the entire [B, S_max, N, H] buffer per layer per
+    token, multiplying the decode loop's HBM traffic by ``group``."""
+    B, _, N, H = q.shape
+    KV = ck.shape[2]
+    scale = 1.0 / (H ** 0.5)
+    # Head n of N maps to kv head n // group (the repeat convention the
+    # training path uses) == reshape [KV, group] order.
+    qg = q.astype(jnp.float32).reshape(B, KV, group, H) * scale
+    s = jnp.einsum("bkgh,bskh->bkgs", qg, ck.astype(jnp.float32))
+    s_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+    s = jnp.where(s_pos <= pos, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bnqk,bknh->bqnh", p,
-                      cv.astype(jnp.float32)).astype(q.dtype)
+    out = jnp.einsum("bkgs,bskh->bkgh", p, cv.astype(jnp.float32))
+    return out.reshape(B, 1, N, H).astype(q.dtype)
 
 
 def _decode_step(params: dict, config: ModelConfig, token: jax.Array,
@@ -67,8 +81,7 @@ def _decode_step(params: dict, config: ModelConfig, token: jax.Array,
     c = config
     B = token.shape[0]
     group = c.n_heads // c.n_kv_heads
-    x = params["embed"].astype(c.compute_dtype)[token][:, None, :]  # [B,1,D]
-    x = constrain(x, "dp", None, None)
+    x = embed_tokens(params, token[:, None], c)  # [B, 1, D]
     cos_t = jax.lax.dynamic_slice_in_dim(cos, pos, 1, axis=0)
     sin_t = jax.lax.dynamic_slice_in_dim(sin, pos, 1, axis=0)
 
@@ -100,8 +113,7 @@ def _decode_step(params: dict, config: ModelConfig, token: jax.Array,
 
     x, (ck, cv) = jax.lax.scan(layer_step, x,
                                (params["layers"], cache.k, cache.v))
-    x = _rmsnorm(x, params["final_norm"], c.norm_eps)
-    logits = (x.astype(jnp.float32) @ params["lm_head"])[:, 0]
+    logits = lm_head(params, x, c)[:, 0]  # shared final-norm + head math
     return logits, KVCache(k=ck, v=cv)
 
 
