@@ -122,3 +122,40 @@ def test_pipeline_composed_with_moe_ep():
     for _ in range(6):
         state, loss = step(state, toks)
     assert float(loss) < float(first)
+
+
+def test_flash_attention_composes_with_pipeline():
+    """The Pallas dispatch's inner shard_map must nest inside the
+    pipeline's manual-pp region (it targets the context abstract mesh and
+    maps only the non-manual axes)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, attn_impl="flash")
+    plan = build_mesh({"pp": 2, "dp": 2, "tp": 2})
+    params = init_params(cfg, jax.random.key(0))
+    toks = _toks()
+    ref, _ = forward_with_aux(params, toks, cfg)
+    from tputopo.workloads.sharding import activate
+
+    with activate(plan):
+        logits, _ = jax.jit(
+            lambda p, t: pipelined_forward_with_aux(p, t, cfg, plan))(
+                params, toks)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_composes_with_pipeline():
+    """Context parallelism inside pipeline stages: pp x sp x tp."""
+    plan = build_mesh({"pp": 2, "sp": 2, "tp": 2})
+    params = init_params(TINY, jax.random.key(1))
+    toks = _toks(seed=4)
+    ref, _ = forward_with_aux(params, toks, TINY)
+    from tputopo.workloads.sharding import activate
+
+    with activate(plan):
+        logits, _ = jax.jit(
+            lambda p, t: pipelined_forward_with_aux(p, t, TINY, plan))(
+                params, toks)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)

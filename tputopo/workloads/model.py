@@ -271,9 +271,11 @@ def _flash_dispatch(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
 
     # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
     # annotation; the kernel is purely local per (dp, tp) shard.
-    return shard_map(lambda a, b, c_: kernel(a, b, c_), mesh=plan.mesh,
+    # shard_map_kwargs composes with an enclosing manual region (pipeline).
+    return shard_map(lambda a, b, c_: kernel(a, b, c_),
                      in_specs=(spec, spec, spec), out_specs=spec,
-                     check_vma=False)(q, k, v)
+                     check_vma=False,
+                     **shardlib.shard_map_kwargs(plan, {"dp", "tp"}))(q, k, v)
 
 
 def _mlp(x: jax.Array, p: dict) -> jax.Array:

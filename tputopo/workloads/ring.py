@@ -318,5 +318,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, plan, *,
                                  kv_group=kv_group)
     else:
         raise ValueError(f"unknown ring impl {impl!r}")
-    return shard_map(body, mesh=plan.mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+    from tputopo.workloads.sharding import shard_map_kwargs
+
+    # shard_map_kwargs composes with an enclosing manual region (pipeline).
+    return shard_map(body, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False,
+                     **shard_map_kwargs(plan, {"dp", "sp", "tp"}))(q, k, v)

@@ -84,6 +84,27 @@ def active_plan() -> MeshPlan | None:
     return _ACTIVE
 
 
+def shard_map_kwargs(plan: MeshPlan, axis_names: set[str]) -> dict:
+    """mesh/axis_names kwargs for a shard_map that must compose with an
+    enclosing partial-manual region (the pp pipeline runs layer math under
+    ``shard_map(..., axis_names={'pp'})``; an inner shard_map there must
+    target the CONTEXT abstract mesh and exclude already-manual axes, or
+    tracing fails with a mesh mismatch).  Outside any manual region this
+    returns the plan's concrete mesh with the requested axes."""
+    try:
+        ctx = jax.sharding.get_abstract_mesh()
+        manual = {n for n, t in zip(ctx.axis_names, ctx.axis_types)
+                  if str(t).endswith("Manual")}
+    except Exception:
+        ctx = None
+        manual = set()
+    if manual:
+        return {"mesh": ctx, "axis_names": set(axis_names) - manual}
+    # Top level: classic full-manual shard_map over the concrete mesh
+    # (partial axis_names here would demand specs over every size-1 axis).
+    return {"mesh": plan.mesh}
+
+
 def constrain(x: jax.Array, *names: str | None) -> jax.Array:
     """Logical activation-sharding constraint; no-op when no plan is active.
 
