@@ -423,6 +423,79 @@ def bench_workload_mfu() -> dict | None:
         return None
 
 
+def bench_decode() -> dict | None:
+    """Serving throughput of the bench model: steady-state KV-cache decode
+    tokens/s, isolated by differencing two generate lengths (prefill and
+    dispatch overhead cancel).  Decode is HBM-bound — the ceiling is
+    hbm_gbps / param_bytes — so achieved/ceiling is the serving analog of
+    MFU.  TPU-only, never fatal."""
+    try:
+        import time as _t
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        if jax.devices()[0].platform != "tpu":
+            return None
+        from tputopo.workloads.decode import generate_jit
+        from tputopo.workloads.model import ModelConfig, init_params
+
+        batch, prompt_len = 8, 128
+        short, long = 8, 72
+        cfg = ModelConfig(vocab_size=32768, d_model=2048, n_layers=8,
+                          n_heads=16, n_kv_heads=8, d_ff=8192,
+                          max_seq=prompt_len + long,
+                          compute_dtype=jnp.bfloat16)
+        params = init_params(cfg, jax.random.key(0))
+        prompt = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (batch, prompt_len)))
+
+        def run(n):
+            # int(...) forces a device-to-host fetch: through the tunnel,
+            # block_until_ready returns before execution finishes and
+            # would time the dispatch, not the decode.
+            int(generate_jit(params, prompt, cfg, max_new=n,
+                             max_len=prompt_len + long)[0, -1])
+            ts = []
+            for _ in range(3):
+                t0 = _t.perf_counter()
+                int(generate_jit(params, prompt, cfg, max_new=n,
+                                 max_len=prompt_len + long)[0, -1])
+                ts.append(_t.perf_counter() - t0)
+            return min(ts)
+
+        dt = (run(long) - run(short)) / (long - short)
+        # Streamed bytes per decode step: every weight except the embed
+        # table (gathered, not streamed) is read once, in bf16 (XLA hoists
+        # the weight casts out of the decode scan).
+        streamed = (sum(a.size for a in jax.tree.leaves(params))
+                    - params["embed"].size) * 2
+        from tputopo.topology.generations import get_generation
+
+        kind = jax.devices()[0].device_kind.lower()
+        gen = ("v5e" if "v5 lite" in kind or "v5e" in kind
+               else "v6e" if "v6" in kind
+               else "v5p" if "v5" in kind else "v4")
+        out = {
+            "batch": batch,
+            "decode_step_ms": round(dt * 1e3, 3),
+            "decode_tokens_per_s": round(batch / dt, 1),
+            "per_seq_tokens_per_s": round(1 / dt, 1),
+            "streamed_param_gb": round(streamed / 1e9, 2),
+            # Approximate (length-differencing; run-to-run chip variance
+            # is +-30% here): decode is HBM-bound, so the effective stream
+            # rate should sit near the generation's spec HBM bandwidth.
+            "effective_param_stream_gbps": round(streamed / dt / 1e9, 1),
+            "spec_hbm_gbps": get_generation(gen).hbm_gbps,
+        }
+        return out
+    except Exception as e:  # pragma: no cover - context only
+        print(f"bench: decode skipped: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return None
+
+
 def main() -> None:
     sched = bench_scheduler()
     workload = bench_workload_mfu()
@@ -442,6 +515,7 @@ def main() -> None:
             "placement_quality_vs_ideal": sched["quality_vs_ideal"],
             "bandwidth_gain_vs_count_only": bench_ab_gain(),
             "workload_fwd": workload,
+            "decode": bench_decode(),
             "hbm": bench_hbm_gbps(),
         },
     }

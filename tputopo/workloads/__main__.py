@@ -109,6 +109,39 @@ def cmd_train(args) -> int:
     return 0 if losses[-1] < losses[0] or resumed_from else 1
 
 
+def cmd_decode(args) -> int:
+    import time
+
+    import jax
+    import numpy as np
+
+    from tputopo.workloads.decode import generate_jit
+    from tputopo.workloads.model import ModelConfig, init_params
+
+    cfg = ModelConfig(vocab_size=2048, d_model=256, n_layers=4, n_heads=8,
+                      n_kv_heads=4, d_ff=512,
+                      max_seq=args.prompt_len + args.max_new)
+    params = init_params(cfg, jax.random.key(0))
+    prompt = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (args.batch, args.prompt_len))
+    import jax.numpy as jnp
+
+    prompt = jnp.asarray(prompt)
+    out = generate_jit(params, prompt, cfg, max_new=args.max_new)
+    out.block_until_ready()  # compile
+    t0 = time.perf_counter()
+    out = generate_jit(params, prompt, cfg, max_new=args.max_new)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "batch": args.batch, "prompt_len": args.prompt_len,
+        "max_new": args.max_new,
+        "decode_tokens_per_s": round(args.batch * args.max_new / dt, 1),
+        "wall_s": round(dt, 4),
+    }))
+    return 0
+
+
 def cmd_train_vision(args) -> int:
     import jax
 
@@ -158,6 +191,12 @@ def main() -> int:
                         "(and every --save-every steps)")
     p.add_argument("--save-every", type=int, default=0)
     p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("decode", help="KV-cache greedy decode throughput")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--prompt-len", type=int, default=64)
+    p.add_argument("--max-new", type=int, default=64)
+    p.set_defaults(fn=cmd_decode)
 
     p = sub.add_parser("train-vision",
                        help="conv classifier, data parallel (Gaia Exp.6 analog)")

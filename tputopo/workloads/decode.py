@@ -51,60 +51,67 @@ class KVCache(NamedTuple):
                        v=jnp.zeros(shape, c.compute_dtype))
 
 
-def _attend_cached(q, ck, cv, pos, group: int):
-    """q [B, 1, N, H] against cache [B, S_max, KV, H], positions > pos
-    masked.  Returns [B, 1, N, H].
+def _attend_cached(q, ck, cv, start, group: int):
+    """q [B, T, N, H] (query positions start..start+T-1) against cache
+    [B, S_max, KV, H]; cache positions beyond each query's own are masked
+    (causal).  Returns [B, T, N, H].
 
-    GQA stays grouped: q reshapes to [B, 1, KV, group, H] and the einsums
+    GQA stays grouped: q reshapes to [B, T, KV, group, H] and the einsums
     read the cache at its native KV width — expanding the cache with
     repeat would copy the entire [B, S_max, N, H] buffer per layer per
-    token, multiplying the decode loop's HBM traffic by ``group``."""
-    B, _, N, H = q.shape
+    step, multiplying the hot loop's HBM traffic by ``group``."""
+    B, T, N, H = q.shape
     KV = ck.shape[2]
     scale = 1.0 / (H ** 0.5)
     # Head n of N maps to kv head n // group (the repeat convention the
     # training path uses) == reshape [KV, group] order.
-    qg = q.astype(jnp.float32).reshape(B, KV, group, H) * scale
-    s = jnp.einsum("bkgh,bskh->bkgs", qg, ck.astype(jnp.float32))
-    s_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
-    s = jnp.where(s_pos <= pos, s, -1e30)
+    qg = q.astype(jnp.float32).reshape(B, T, KV, group, H) * scale
+    s = jnp.einsum("btkgh,bskh->bkgts", qg, ck.astype(jnp.float32))
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 4)
+    q_pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+    s = jnp.where(k_pos <= q_pos, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bkgs,bskh->bkgh", p, cv.astype(jnp.float32))
-    return out.reshape(B, 1, N, H).astype(q.dtype)
+    out = jnp.einsum("bkgts,bskh->btkgh", p, cv.astype(jnp.float32))
+    return out.reshape(B, T, N, H).astype(q.dtype)
 
 
-def _decode_step(params: dict, config: ModelConfig, token: jax.Array,
-                 pos: jax.Array, cache: KVCache,
-                 cos: jax.Array, sin: jax.Array
-                 ) -> tuple[jax.Array, KVCache]:
-    """One token [B] at position ``pos`` -> (logits [B, V], updated cache)."""
+def _block_step(params: dict, config: ModelConfig, tokens: jax.Array,
+                start: jax.Array, cache: KVCache,
+                cos: jax.Array, sin: jax.Array
+                ) -> tuple[jax.Array, KVCache]:
+    """Feed ``tokens`` [B, T] at positions start..start+T-1 through the
+    stack -> (logits [B, T, V], updated cache).  T == prompt length is
+    the prefill; T == 1 is one decode step — same code, same math."""
     c = config
-    B = token.shape[0]
+    B, T = tokens.shape
     group = c.n_heads // c.n_kv_heads
-    x = embed_tokens(params, token[:, None], c)  # [B, 1, D]
-    cos_t = jax.lax.dynamic_slice_in_dim(cos, pos, 1, axis=0)
-    sin_t = jax.lax.dynamic_slice_in_dim(sin, pos, 1, axis=0)
+    x = embed_tokens(params, tokens, c)  # [B, T, D]
+    cos_t = jax.lax.dynamic_slice_in_dim(cos, start, T, axis=0)
+    sin_t = jax.lax.dynamic_slice_in_dim(sin, start, T, axis=0)
 
     def layer_step(carry, inp):
         x = carry
         layer, ck_l, cv_l = inp
         h = _rmsnorm(x, layer["attn_norm"], c.norm_eps)
-        q = (h @ layer["wq"].astype(h.dtype)).reshape(B, 1, c.n_heads, c.head_dim)
-        k = (h @ layer["wk"].astype(h.dtype)).reshape(B, 1, c.n_kv_heads, c.head_dim)
-        v = (h @ layer["wv"].astype(h.dtype)).reshape(B, 1, c.n_kv_heads, c.head_dim)
+        q = (h @ layer["wq"].astype(h.dtype)).reshape(B, T, c.n_heads, c.head_dim)
+        k = (h @ layer["wk"].astype(h.dtype)).reshape(B, T, c.n_kv_heads, c.head_dim)
+        v = (h @ layer["wv"].astype(h.dtype)).reshape(B, T, c.n_kv_heads, c.head_dim)
         q = _apply_rope(q, cos_t, sin_t)
         k = _apply_rope(k, cos_t, sin_t)
-        ck_l = jax.lax.dynamic_update_index_in_dim(ck_l, k[:, 0], pos, axis=1)
-        cv_l = jax.lax.dynamic_update_index_in_dim(cv_l, v[:, 0], pos, axis=1)
+        ck_l = jax.lax.dynamic_update_slice_in_dim(ck_l, k, start, axis=1)
+        cv_l = jax.lax.dynamic_update_slice_in_dim(cv_l, v, start, axis=1)
         q = constrain(q, "dp", None, "tp", None)
-        out = _attend_cached(q, ck_l, cv_l, pos, group)
-        out = out.reshape(B, 1, c.n_heads * c.head_dim)
+        out = _attend_cached(q, ck_l, cv_l, start, group)
+        out = out.reshape(B, T, c.n_heads * c.head_dim)
         x = x + out @ layer["wo"].astype(x.dtype)
         h2 = _rmsnorm(x, layer["mlp_norm"], c.norm_eps)
         if c.moe is not None:
-            from tputopo.workloads.moe import moe_mlp
+            # Drop-free routing by construction (the documented serving
+            # semantics) — the capacity-dispatch training path would
+            # truncate tokens during a T>1 prefill.
+            from tputopo.workloads.moe import moe_mlp_reference
 
-            y, _ = moe_mlp(h2, layer["moe"], c)
+            y = moe_mlp_reference(h2, layer["moe"], c)
         else:
             gate = jax.nn.silu(h2 @ layer["w_gate"].astype(h2.dtype))
             up = h2 @ layer["w_up"].astype(h2.dtype)
@@ -113,7 +120,7 @@ def _decode_step(params: dict, config: ModelConfig, token: jax.Array,
 
     x, (ck, cv) = jax.lax.scan(layer_step, x,
                                (params["layers"], cache.k, cache.v))
-    logits = lm_head(params, x, c)[:, 0]  # shared final-norm + head math
+    logits = lm_head(params, x, c)  # shared final-norm + head math
     return logits, KVCache(k=ck, v=cv)
 
 
@@ -121,9 +128,9 @@ def generate(params: dict, prompt: jax.Array, config: ModelConfig, *,
              max_new: int, max_len: int | None = None) -> jax.Array:
     """Greedy decode: prompt [B, P] -> [B, P + max_new] token ids.
 
-    One jitted program: prompt prefill feeds tokens through the same
-    per-token step (simple and cache-exact; batch prefill is a future
-    fusion), then max_new greedy steps — all inside `lax.scan`."""
+    One jitted program: the prompt prefills the cache in a single batched
+    _block_step (MXU-shaped matmuls over all P positions at once), then
+    max_new - 1 single-token steps run inside `lax.scan`."""
     c = config
     B, P = prompt.shape
     total = P + max_new
@@ -133,27 +140,21 @@ def generate(params: dict, prompt: jax.Array, config: ModelConfig, *,
     cos, sin = _rope_tables(c, max_len)
     cache = KVCache.create(c, B, max_len)
 
-    def step(carry, t):
-        tokens, cache = carry
-        token_t = jax.lax.dynamic_index_in_dim(tokens, t, axis=1,
-                                               keepdims=False)
-        logits, cache = _decode_step(params, c, token_t, t, cache, cos, sin)
-        nxt = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
-        # Positions < P - 1 keep the prompt; beyond it the greedy token
-        # becomes input t+1 (teacher forcing inside the prompt).
-        write_at = jnp.minimum(t + 1, total - 1)
-        cur = jax.lax.dynamic_index_in_dim(tokens, write_at, axis=1,
-                                           keepdims=False)
-        chosen = jnp.where(t + 1 < P, cur, nxt)
-        tokens = jax.lax.dynamic_update_index_in_dim(
-            tokens, chosen, write_at, axis=1)
-        return (tokens, cache), None
+    logits, cache = _block_step(params, c, prompt, 0, cache, cos, sin)
+    first = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+    if max_new == 1:
+        return jnp.concatenate([prompt, first[:, None]], axis=1)
 
-    tokens0 = jnp.concatenate(
-        [prompt, jnp.zeros((B, max_new), prompt.dtype)], axis=1)
-    (tokens, _), _ = jax.lax.scan(step, (tokens0, cache),
-                                  jnp.arange(total - 1))
-    return tokens
+    def step(carry, i):
+        tok, cache = carry  # tok sits at position P + i
+        lg, cache = _block_step(params, c, tok[:, None], P + i, cache,
+                                cos, sin)
+        nxt = jnp.argmax(lg[:, -1], axis=-1).astype(prompt.dtype)
+        return (nxt, cache), nxt
+
+    (_, _), rest = jax.lax.scan(step, (first, cache),
+                                jnp.arange(max_new - 1))
+    return jnp.concatenate([prompt, first[:, None], rest.T], axis=1)
 
 
 generate_jit = jax.jit(generate, static_argnames=("config", "max_new",
