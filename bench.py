@@ -467,10 +467,13 @@ def bench_decode() -> dict | None:
 
         dt = (run(long) - run(short)) / (long - short)
         # Streamed bytes per decode step: every weight except the embed
-        # table (gathered, not streamed) is read once, in bf16 (XLA hoists
-        # the weight casts out of the decode scan).
-        streamed = (sum(a.size for a in jax.tree.leaves(params))
-                    - params["embed"].size) * 2
+        # table (gathered, not streamed) is read once — layer weights in
+        # bf16 (XLA hoists the casts out of the decode scan), the lm_head
+        # in f32 (model.lm_head never casts it).
+        total = sum(a.size for a in jax.tree.leaves(params))
+        streamed = ((total - params["embed"].size
+                     - params["lm_head"].size) * 2
+                    + params["lm_head"].size * 4)
         from tputopo.topology.generations import get_generation
 
         kind = jax.devices()[0].device_kind.lower()

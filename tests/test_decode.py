@@ -98,3 +98,13 @@ def test_generate_is_one_compiled_program():
     out2 = generate_jit(params, prompt2, CFG, max_new=5)
     assert out1.shape == out2.shape == (2, 8)
     assert generate_jit._cache_size() == 1
+
+
+def test_generate_rejects_zero_max_new():
+    params = init_params(CFG, jax.random.key(0))
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    try:
+        generate(params, prompt, CFG, max_new=0)
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass

@@ -255,3 +255,53 @@ def test_observe_newest_resource_version_wins():
                      "resourceVersion": "9", "annotations": {"x": "newest"}}}})
     assert inf.get("pods", "p", "default")["metadata"]["annotations"]["x"] == "newest"
     assert inf.version() != v1  # observe/events both move the coherence token
+
+
+def test_relist_preserves_newer_observed_objects():
+    """A relist snapshot taken at rv M must not erase write-through
+    observes newer than M (the bind-vs-relist race)."""
+    api = FakeApiServer()
+    inf = Informer(api, kinds=("pods",), watch_timeout_s=0.2)
+    api.create("pods", make_pod("a", chips=1))
+    inf._relist("pods")
+    snap_rv = int(inf._rv["pods"])
+    # Concurrent bind: newer object observed after the snapshot was taken.
+    newer = {"metadata": {"name": "a", "namespace": "default",
+                          "resourceVersion": str(snap_rv + 5),
+                          "annotations": {"tpu.dev/assigned": "false"}}}
+    fresh = {"metadata": {"name": "b", "namespace": "default",
+                          "resourceVersion": str(snap_rv + 6)}}
+    inf.observe("pods", newer)
+    inf.observe("pods", fresh)  # created after the snapshot entirely
+    # Replay a relist with the OLD snapshot rv (simulates the swap landing
+    # after the observes): both observed objects must survive.
+    items, _ = api.list_with_version("pods")
+    api_list_with_version = api.list_with_version
+    api.list_with_version = lambda kind: (items, str(snap_rv))
+    try:
+        inf._relist("pods")
+    finally:
+        api.list_with_version = api_list_with_version
+    a = inf.get("pods", "a", "default")
+    assert a["metadata"]["resourceVersion"] == str(snap_rv + 5), \
+        "relist regressed an observed bind"
+    assert inf.get("pods", "b", "default") is not None
+
+
+def test_lagging_delete_does_not_remove_newer_incarnation():
+    api = FakeApiServer()
+    inf = Informer(api, kinds=("pods",), watch_timeout_s=0.2)
+    inf._synced["pods"].set()
+    new = {"metadata": {"name": "p", "namespace": "default",
+                        "resourceVersion": "60"}}
+    inf.observe("pods", new)
+    # Lagging DELETE for the OLD incarnation (rv 50): must be ignored.
+    inf._apply("pods", {"type": "DELETED", "rv": "50", "object": {
+        "metadata": {"name": "p", "namespace": "default",
+                     "resourceVersion": "50"}}})
+    assert inf.get("pods", "p", "default") is not None
+    # A DELETE at/after the mirror's version does land.
+    inf._apply("pods", {"type": "DELETED", "rv": "61", "object": {
+        "metadata": {"name": "p", "namespace": "default",
+                     "resourceVersion": "61"}}})
+    assert inf.list("pods") == []

@@ -586,8 +586,12 @@ class ExtenderScheduler:
             try:
                 self.informer.observe(
                     "pods", self.api.get("pods", pod_name, namespace))
-            except NotFound:  # deleted between bind and read-back: watch
-                pass          # will deliver the DELETE; nothing to assume
+            except Exception:
+                # Best-effort only: the bind itself already succeeded, so a
+                # failed read-back (deleted pod, transient 5xx, network)
+                # must not surface as a bind error — the watch will deliver
+                # the authoritative event shortly either way.
+                self.metrics.inc("bind_observe_errors")
 
         decision = {
             "pod": f"{namespace}/{pod_name}",

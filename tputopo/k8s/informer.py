@@ -117,8 +117,22 @@ class Informer:
 
     def _relist(self, kind: str) -> None:
         items, rv = self.api.list_with_version(kind)
+        try:
+            snap_rv = int(rv)
+        except (TypeError, ValueError):
+            snap_rv = 0
         with self._lock:
-            self._store[kind] = {_key(o): o for o in items}
+            new_store = {_key(o): o for o in items}
+            # Newest-wins merge: the snapshot was taken at snap_rv OUTSIDE
+            # the lock, so a concurrent bind's write-through observe() may
+            # have installed strictly newer objects — a wholesale swap
+            # would regress the mirror to pre-bind state and re-offer
+            # just-assigned chips until the re-watch catches up.
+            for key, cur in self._store[kind].items():
+                cur_rv = _obj_rv(cur)
+                if cur_rv > snap_rv and cur_rv > _obj_rv(new_store.get(key, {})):
+                    new_store[key] = cur
+            self._store[kind] = new_store
             self._rv[kind] = rv
         self.metrics["lists"] += 1
         self._synced[kind].set()
@@ -129,7 +143,15 @@ class Informer:
             if event["type"] == "BOOKMARK":
                 pass  # rv checkpoint only; the object is not a real one
             elif event["type"] == "DELETED":
-                self._store[kind].pop(_key(obj), None)
+                # A lagging DELETE for an OLDER incarnation must not remove
+                # a newer object installed by observe() (delete-then-
+                # recreate under watch lag); keep only when both versions
+                # are known and the mirror's is strictly newer.
+                key = _key(obj)
+                cur = self._store[kind].get(key)
+                if not (cur is not None
+                        and _obj_rv(cur) > _obj_rv(obj) > 0):
+                    self._store[kind].pop(key, None)
             else:  # ADDED / MODIFIED — upsert, newest resourceVersion wins
                 # (an event older than a write-through observe() of the
                 # same object must not regress the mirror).

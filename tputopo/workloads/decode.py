@@ -133,6 +133,8 @@ def generate(params: dict, prompt: jax.Array, config: ModelConfig, *,
     max_new - 1 single-token steps run inside `lax.scan`."""
     c = config
     B, P = prompt.shape
+    if max_new < 1:
+        raise ValueError(f"max_new must be >= 1, got {max_new}")
     total = P + max_new
     max_len = max_len or total
     if max_len < total:
