@@ -8,9 +8,15 @@ allocated GPUs).  The TPU-native analog here is twofold:
   microbenchmark, the direct measurement of the north-star metric
   (BASELINE.md: ICI all-reduce GB/s of the scheduled slice vs ideal).
 - :mod:`tputopo.workloads.model` / :mod:`tputopo.workloads.train` — a
-  Llama-style decoder-only LM with a full sharded training step (DP x TP
-  x optional SP over a `jax.sharding.Mesh`), the BASELINE.json north-star
-  workload ("4-replica Llama-3-8B JAX job onto a v5p-32").
+  Llama-style decoder-only LM with a full sharded training step over the
+  five logical mesh axes (pp/dp/sp/ep/tp), the BASELINE.json north-star
+  workload ("4-replica Llama-3-8B JAX job onto a v5p-32").  MoE expert
+  parallelism lives in :mod:`tputopo.workloads.moe`, SPMD pipeline
+  parallelism in :mod:`tputopo.workloads.pipeline`, ring (context-
+  parallel) attention in :mod:`tputopo.workloads.ring`, KV-cache serving
+  in :mod:`tputopo.workloads.decode`, and the conv-classifier second
+  model family (the Gaia Exp.6 MNIST analog) in
+  :mod:`tputopo.workloads.vision`.
 
 :mod:`tputopo.workloads.sharding` is the bridge between the scheduler and
 JAX: it turns a scheduled slice shape (a `Placement` from
