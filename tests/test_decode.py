@@ -108,3 +108,29 @@ def test_generate_rejects_zero_max_new():
         raise AssertionError("expected ValueError")
     except ValueError:
         pass
+
+
+def test_sampling_modes():
+    params = init_params(CFG, jax.random.key(0))
+    prompt = jnp.asarray(np.random.default_rng(5).integers(0, 64, (2, 4)))
+    greedy = np.asarray(generate(params, prompt, CFG, max_new=6))
+    # top_k=1 sampling IS greedy regardless of temperature.
+    k1 = np.asarray(generate(params, prompt, CFG, max_new=6,
+                             temperature=1.0, top_k=1,
+                             key=jax.random.key(7)))
+    np.testing.assert_array_equal(k1, greedy)
+    # Same key -> deterministic; different keys -> (overwhelmingly) differ.
+    a = np.asarray(generate(params, prompt, CFG, max_new=6,
+                            temperature=5.0, key=jax.random.key(1)))
+    b = np.asarray(generate(params, prompt, CFG, max_new=6,
+                            temperature=5.0, key=jax.random.key(1)))
+    c = np.asarray(generate(params, prompt, CFG, max_new=6,
+                            temperature=5.0, key=jax.random.key(2)))
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    # Sampling without a key is a usage error.
+    try:
+        generate(params, prompt, CFG, max_new=2, temperature=1.0)
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass

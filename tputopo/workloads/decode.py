@@ -15,8 +15,10 @@ from any LM stack will look for.  TPU-first formulation:
   reads are contiguous lanes; the cache shards like activations (batch
   over ``dp``, heads over ``tp`` via the usual constraints).
 
-Greedy decoding only — sampling policies are orthogonal to the framework
-story and deliberately out of scope (README non-goals style).
+Decoding policies: greedy (temperature 0, the default) and temperature
+sampling with optional top-k truncation — the PRNG key threads through
+the decode `lax.scan` (`jax.random.fold_in` per step), so sampling stays
+one compiled program too.
 
 MoE semantics: decode routes ONE token per step, so the training layer's
 capacity truncation can never trigger — decode is exactly the drop-free
@@ -124,9 +126,29 @@ def _block_step(params: dict, config: ModelConfig, tokens: jax.Array,
     return logits, KVCache(k=ck, v=cv)
 
 
+def _select(logits: jax.Array, temperature: float, top_k: int | None,
+            key: jax.Array | None, step_idx, dtype) -> jax.Array:
+    """Next-token choice from [B, V] logits: argmax at temperature 0,
+    otherwise temperature sampling over the (optionally top-k-truncated)
+    distribution."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(dtype)
+    lg = logits / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
+        lg = jnp.where(lg < kth, -jnp.inf, lg)
+    step_key = jax.random.fold_in(key, step_idx)
+    return jax.random.categorical(step_key, lg, axis=-1).astype(dtype)
+
+
 def generate(params: dict, prompt: jax.Array, config: ModelConfig, *,
-             max_new: int, max_len: int | None = None) -> jax.Array:
-    """Greedy decode: prompt [B, P] -> [B, P + max_new] token ids.
+             max_new: int, max_len: int | None = None,
+             temperature: float = 0.0, top_k: int | None = None,
+             key: jax.Array | None = None) -> jax.Array:
+    """Decode: prompt [B, P] -> [B, P + max_new] token ids.
+
+    ``temperature`` 0 (default) is greedy; > 0 samples, optionally from
+    the ``top_k`` most likely tokens, using ``key`` (required then).
 
     One jitted program: the prompt prefills the cache in a single batched
     _block_step (MXU-shaped matmuls over all P positions at once), then
@@ -135,6 +157,8 @@ def generate(params: dict, prompt: jax.Array, config: ModelConfig, *,
     B, P = prompt.shape
     if max_new < 1:
         raise ValueError(f"max_new must be >= 1, got {max_new}")
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
     total = P + max_new
     max_len = max_len or total
     if max_len < total:
@@ -143,7 +167,7 @@ def generate(params: dict, prompt: jax.Array, config: ModelConfig, *,
     cache = KVCache.create(c, B, max_len)
 
     logits, cache = _block_step(params, c, prompt, 0, cache, cos, sin)
-    first = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+    first = _select(logits[:, -1], temperature, top_k, key, 0, prompt.dtype)
     if max_new == 1:
         return jnp.concatenate([prompt, first[:, None]], axis=1)
 
@@ -151,7 +175,8 @@ def generate(params: dict, prompt: jax.Array, config: ModelConfig, *,
         tok, cache = carry  # tok sits at position P + i
         lg, cache = _block_step(params, c, tok[:, None], P + i, cache,
                                 cos, sin)
-        nxt = jnp.argmax(lg[:, -1], axis=-1).astype(prompt.dtype)
+        nxt = _select(lg[:, -1], temperature, top_k, key, i + 1,
+                      prompt.dtype)
         return (nxt, cache), nxt
 
     (_, _), rest = jax.lax.scan(step, (first, cache),
@@ -160,4 +185,5 @@ def generate(params: dict, prompt: jax.Array, config: ModelConfig, *,
 
 
 generate_jit = jax.jit(generate, static_argnames=("config", "max_new",
-                                                  "max_len"))
+                                                  "max_len", "temperature",
+                                                  "top_k"))
