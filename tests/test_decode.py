@@ -134,3 +134,23 @@ def test_sampling_modes():
         raise AssertionError("expected ValueError")
     except ValueError:
         pass
+
+
+def test_sharded_decode_matches_single_device():
+    """Serving on a dp x tp mesh (batch over dp, KV heads over tp) must
+    reproduce the single-device greedy trajectory — sharded decode is
+    layout, not math."""
+    from tputopo.workloads import sharding as shardlib
+    from tputopo.workloads.sharding import build_mesh
+
+    params = init_params(CFG, jax.random.key(0))
+    prompt = jnp.asarray(np.random.default_rng(8).integers(0, 64, (4, 6)))
+    ref = np.asarray(generate(params, prompt, CFG, max_new=6))
+
+    plan = build_mesh({"dp": 4, "tp": 2})
+    sh_params = jax.device_put(params, shardlib.param_shardings(plan, CFG))
+    sh_prompt = jax.device_put(prompt, plan.sharding("dp", None))
+    with shardlib.activate(plan):
+        out = jax.jit(lambda p, t: generate(p, t, CFG, max_new=6))(
+            sh_params, sh_prompt)
+    np.testing.assert_array_equal(np.asarray(out), ref)

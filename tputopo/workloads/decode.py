@@ -165,6 +165,13 @@ def generate(params: dict, prompt: jax.Array, config: ModelConfig, *,
         raise ValueError(f"max_len {max_len} < prompt {P} + new {max_new}")
     cos, sin = _rope_tables(c, max_len)
     cache = KVCache.create(c, B, max_len)
+    # Multi-chip serving: batch over dp, KV heads over tp — under an
+    # active plan the cache shards like the activations it stores (and
+    # the per-layer attention stays local per (dp, tp) shard); on one
+    # chip these are no-ops.
+    cache = KVCache(
+        k=constrain(cache.k, None, "dp", None, "tp", None),
+        v=constrain(cache.v, None, "dp", None, "tp", None))
 
     logits, cache = _block_step(params, c, prompt, 0, cache, cos, sin)
     first = _select(logits[:, -1], temperature, top_k, key, 0, prompt.dtype)
