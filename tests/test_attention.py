@@ -105,3 +105,24 @@ def test_sharded_train_step_with_flash():
     toks = jnp.asarray(np.random.default_rng(0).integers(0, 64, (4, 32)))
     state, loss = step(state, toks)
     assert bool(jnp.isfinite(loss))
+
+
+def test_device_kind_allowlist_is_table_driven():
+    """The parallel-iq fast path gates on an explicit device_kind allowlist
+    resolved through the generation table (ADVICE r2) — never a substring
+    match that a future kind could trip into a cross-core write race."""
+    from tputopo.topology.generations import GENERATIONS
+    from tputopo.workloads.attention import (_DEVICE_KIND_TO_GENERATION,
+                                             _single_core_chip)
+
+    for kind, gen in _DEVICE_KIND_TO_GENERATION.items():
+        assert gen in GENERATIONS, f"{kind} maps to unknown generation {gen}"
+    single = {k for k, g in _DEVICE_KIND_TO_GENERATION.items()
+              if GENERATIONS[g].cores_per_chip == 1}
+    assert "tpu v5 lite" in single          # the real v5e kind string
+    assert "tpu v4" not in single           # megacore stays sequential
+    assert "tpu v5p" not in single
+    # Non-TPU test devices are not TPU kinds at all -> conservative
+    # megacore.  (On a real single-core TPU backend True is correct.)
+    if jax.default_backend() != "tpu":
+        assert _single_core_chip() is False

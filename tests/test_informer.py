@@ -305,3 +305,25 @@ def test_lagging_delete_does_not_remove_newer_incarnation():
         "metadata": {"name": "p", "namespace": "default",
                      "resourceVersion": "61"}}})
     assert inf.list("pods") == []
+
+
+def test_rvless_delete_is_unordered():
+    """A DELETE whose object carries no parseable resourceVersion (rv 0)
+    must not remove a strictly newer observed incarnation (ADVICE r2) —
+    but still removes an entry whose version is equally unknown."""
+    api = FakeApiServer()
+    inf = Informer(api, kinds=("pods",), watch_timeout_s=0.2)
+    inf._synced["pods"].set()
+    inf.observe("pods", {"metadata": {"name": "p", "namespace": "default",
+                                      "resourceVersion": "60"}})
+    inf._apply("pods", {"type": "DELETED", "object": {
+        "metadata": {"name": "p", "namespace": "default"}}})
+    assert inf.get("pods", "p", "default") is not None, \
+        "rv-less DELETE removed a newer observed object"
+    assert inf.metrics["unordered_deletes_kept"] == 1
+    # Both sides unversioned: the delete wins (can't order, honor intent).
+    inf._store["pods"][("default", "q")] = {
+        "metadata": {"name": "q", "namespace": "default"}}
+    inf._apply("pods", {"type": "DELETED", "object": {
+        "metadata": {"name": "q", "namespace": "default"}}})
+    assert all(p["metadata"]["name"] != "q" for p in inf.list("pods"))

@@ -318,7 +318,12 @@ class ExtenderScheduler:
         memo = getattr(state, "_gang_ctx_memo", None)
         if memo is None:
             memo = state._gang_ctx_memo = {}
-        memo_key = (namespace, gang_id, size, k, wanted_gen, reader is None)
+        # id(reader), not `reader is None`: two distinct informer readers
+        # against one state instance must not share cached member lists
+        # (ADVICE r2).  The id is safe as a key because the memo lives on
+        # the state object, whose lifetime the reader outlives.
+        memo_key = (namespace, gang_id, size, k, wanted_gen,
+                    id(reader) if reader is not None else None)
         if memo_key in memo:
             self.metrics.inc("gang_ctx_memo_hits")
             return memo[memo_key]

@@ -60,7 +60,8 @@ class Informer:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self.metrics = {"lists": 0, "watch_events": 0, "relists": 0,
-                        "watch_errors": 0, "observes": 0}
+                        "watch_errors": 0, "observes": 0,
+                        "unordered_deletes_kept": 0}
         self._observe_count = 0
 
     # ---- lifecycle ---------------------------------------------------------
@@ -145,12 +146,18 @@ class Informer:
             elif event["type"] == "DELETED":
                 # A lagging DELETE for an OLDER incarnation must not remove
                 # a newer object installed by observe() (delete-then-
-                # recreate under watch lag); keep only when both versions
-                # are known and the mirror's is strictly newer.
+                # recreate under watch lag); keep when the mirror's version
+                # is strictly newer.  An rv-less DELETE (rv 0 — real API
+                # servers always set one; this hardens the fake-API path)
+                # is unordered: it also must not remove a known-newer
+                # object, so it only wins against an rv-less mirror entry.
                 key = _key(obj)
                 cur = self._store[kind].get(key)
-                if not (cur is not None
-                        and _obj_rv(cur) > _obj_rv(obj) > 0):
+                del_rv = _obj_rv(obj)
+                if cur is not None and _obj_rv(cur) > del_rv:
+                    if del_rv == 0:
+                        self.metrics["unordered_deletes_kept"] += 1
+                else:
                     self._store[kind].pop(key, None)
             else:  # ADDED / MODIFIED — upsert, newest resourceVersion wins
                 # (an event older than a write-through observe() of the

@@ -113,30 +113,40 @@ def cmd_decode(args) -> int:
     import time
 
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
+    from tputopo.workloads import sharding as shardlib
     from tputopo.workloads.decode import generate_jit
     from tputopo.workloads.model import ModelConfig, init_params
+    from tputopo.workloads.sharding import mesh_for_slice
 
     cfg = ModelConfig(vocab_size=2048, d_model=256, n_layers=4, n_heads=8,
                       n_kv_heads=4, d_ff=512,
                       max_seq=args.prompt_len + args.max_new)
+    # Serving mesh: batch over dp, KV heads over tp (the cache's tp axis),
+    # mirroring cmd_train — a multi-chip serving pod actually shards the
+    # cache and weights (ADVICE r2; on one chip everything is a no-op).
+    n = jax.device_count()
+    plan = mesh_for_slice((n,), heads=cfg.n_kv_heads)
+    dp = max(1, plan.axes["dp"])
+    batch = max(dp, args.batch // dp * dp)
     params = init_params(cfg, jax.random.key(0))
+    params = jax.device_put(params, shardlib.param_shardings(plan, cfg))
     prompt = np.random.default_rng(0).integers(
-        0, cfg.vocab_size, (args.batch, args.prompt_len))
-    import jax.numpy as jnp
-
-    prompt = jnp.asarray(prompt)
-    out = generate_jit(params, prompt, cfg, max_new=args.max_new)
-    out.block_until_ready()  # compile
-    t0 = time.perf_counter()
-    out = generate_jit(params, prompt, cfg, max_new=args.max_new)
-    out.block_until_ready()
-    dt = time.perf_counter() - t0
+        0, cfg.vocab_size, (batch, args.prompt_len))
+    prompt = jax.device_put(jnp.asarray(prompt), plan.sharding("dp", None))
+    with shardlib.activate(plan):
+        out = generate_jit(params, prompt, cfg, max_new=args.max_new)
+        out.block_until_ready()  # compile
+        t0 = time.perf_counter()
+        out = generate_jit(params, prompt, cfg, max_new=args.max_new)
+        out.block_until_ready()
+        dt = time.perf_counter() - t0
     print(json.dumps({
-        "batch": args.batch, "prompt_len": args.prompt_len,
-        "max_new": args.max_new,
-        "decode_tokens_per_s": round(args.batch * args.max_new / dt, 1),
+        "batch": batch, "prompt_len": args.prompt_len,
+        "max_new": args.max_new, "mesh": plan.axes,
+        "decode_tokens_per_s": round(batch * args.max_new / dt, 1),
         "wall_s": round(dt, 4),
     }))
     return 0

@@ -63,14 +63,34 @@ def _compiler_params(interpret: bool):
         dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
+# Exact device_kind -> generation-table name.  An explicit allowlist, not a
+# substring heuristic: declaring iq ``parallel`` on a chip that actually has
+# two TensorCores is a silent cross-core write race, so every new TPU
+# generation must be classified here deliberately (consulting its spec)
+# before the fast path applies to it.  Unlisted kinds fall back to megacore
+# semantics — slower, always race-free.
+_DEVICE_KIND_TO_GENERATION = {
+    "tpu v4": "v4",
+    "tpu v5": "v5p",
+    "tpu v5p": "v5p",
+    "tpu v5 lite": "v5e",   # the kind string real v5e devices report
+    "tpu v5e": "v5e",
+    "tpu v6 lite": "v6e",
+    "tpu v6e": "v6e",
+}
+
+
 def _single_core_chip() -> bool:
     """Whether this backend's chips have one TensorCore (v5e/v6e) vs a
     megacore pair (v4/v5p), per the generation table.  Unknown kinds are
     treated as multi-core (the conservative direction)."""
     import jax as _jax
 
-    kind = _jax.devices()[0].device_kind.lower()
-    return "lite" in kind or "v5e" in kind or "v6e" in kind
+    from tputopo.topology.generations import GENERATIONS
+
+    kind = _jax.devices()[0].device_kind.strip().lower()
+    gen = _DEVICE_KIND_TO_GENERATION.get(kind)
+    return gen is not None and GENERATIONS[gen].cores_per_chip == 1
 
 
 def _fwd_compiler_params(interpret: bool):
