@@ -170,3 +170,76 @@ def test_failed_probe_refuses_to_start():
     bad = _to_host_probe(_probe_python(env))
     with pytest.raises(RuntimeError):
         TpuDevicePlugin("n0", "s", FakeKubelet(), FakeApiServer(), probe=bad)
+
+
+# ---- GetPreferredAllocation (VERDICT r2 #8) ---------------------------------
+
+def test_preferred_allocation_picks_adjacent_and_antifragments():
+    from tests.cluster import probe_for
+    from tputopo.deviceplugin.api import FakeKubelet
+    from tputopo.k8s import FakeApiServer
+
+    plugin = TpuDevicePlugin(
+        node_name="n", slice_id="s", kubelet=FakeKubelet(),
+        api_server=FakeApiServer(), probe=probe_for("v5p:2x2x1@0"),
+        clock=lambda: 0.0)
+    avail = ["0,0,0", "0,1,0", "1,1,0"]  # L-shape: corner pair is diagonal
+    pair = plugin.preferred_allocation(avail, [], 2)
+    assert pair in (["0,0,0", "0,1,0"], ["0,1,0", "1,1,0"])  # adjacent only
+    # must_include is honored.
+    assert plugin.preferred_allocation(avail, ["1,1,0"], 2) == [
+        "0,1,0", "1,1,0"]
+    # k=1 Singular policy: take the loner, preserve the adjacent pair.
+    # (0,1,0) has two available neighbors; the ends have one each.
+    one = plugin.preferred_allocation(avail, [], 1)
+    assert one != ["0,1,0"]
+    # Full-size request returns everything.
+    assert plugin.preferred_allocation(avail, [], 3) == sorted(avail)
+
+
+def test_preferred_allocation_input_validation():
+    from tests.cluster import probe_for
+    from tputopo.deviceplugin.api import FakeKubelet
+    from tputopo.k8s import FakeApiServer
+
+    plugin = TpuDevicePlugin(
+        node_name="n", slice_id="s", kubelet=FakeKubelet(),
+        api_server=FakeApiServer(), probe=probe_for("v5p:2x2x1@0"),
+        clock=lambda: 0.0)
+    with pytest.raises(ValueError, match="not on node"):
+        plugin.preferred_allocation(["9,9,9"], [], 1)
+    with pytest.raises(ValueError, match="missing from available"):
+        plugin.preferred_allocation(["0,0,0"], ["0,1,0"], 1)
+    with pytest.raises(ValueError, match="cannot pick"):
+        plugin.preferred_allocation(["0,0,0", "0,1,0"], [], 3)
+
+
+def test_preferred_allocation_avoids_reserved_chips():
+    """Chips a bound-but-unconfirmed pod reserves are steered around, so
+    the kubelet's pick survives Allocate's reserved-chip check."""
+    from tests.cluster import probe_for
+    from tputopo.deviceplugin.api import FakeKubelet
+    from tputopo.k8s import FakeApiServer, make_pod
+
+    api = FakeApiServer()
+    plugin = TpuDevicePlugin(
+        node_name="n", slice_id="s", kubelet=FakeKubelet(),
+        api_server=api, probe=probe_for("v5p:2x2x1@0"),
+        clock=lambda: 1000.0)
+    api.create("pods", make_pod(
+        "pending", chips=2, node_name="n",
+        annotations={ko.ANN_GROUP: "0,0,0;0,1,0",
+                     ko.ANN_ASSUME_TIME: "995", ko.ANN_ASSIGNED: "false"}))
+    everything = ["0,0,0", "0,1,0", "1,0,0", "1,1,0"]
+    # A size matching the live assumption returns ITS group: Allocate will
+    # mount exactly that group, so any other answer would desync the
+    # kubelet's device accounting from the mounted chips.
+    assert plugin.preferred_allocation(everything, [], 2) == [
+        "0,0,0", "0,1,0"]
+    # No matching assumption (size 1): steer around the reserved pair so
+    # the pick survives Allocate's reserved-chip check.
+    assert plugin.preferred_allocation(everything, [], 1)[0] in (
+        "1,0,0", "1,1,0")
+    # When only reserved chips can cover the request, fall back to them
+    # (Allocate remains the authority).
+    assert len(plugin.preferred_allocation(everything, [], 4)) == 4

@@ -162,3 +162,30 @@ def test_serve_cli_exits_on_kubelet_restart(tmp_path):
         assert "kubelet-restarted" in err
     finally:
         kubelet.stop()
+
+
+def test_preferred_allocation_over_the_wire(wire):
+    """VERDICT r2 #8: the plugin serves GetPreferredAllocation, so even an
+    unmanaged pod's kubelet pick is ICI-adjacent."""
+    kubelet, transport, apiserver, plugin = wire
+    kubelet.wait_for_devices()
+    assert kubelet.options.get_preferred_allocation_available is True
+    # 2-of-3 where one pair is diagonal: must come back adjacent.
+    picks = kubelet.get_preferred_allocation(
+        ko.RESOURCE_CHIPS, ["0,0,0", "0,1,0", "1,1,0"], [], 2)
+    assert picks == [["0,0,0", "0,1,0"]] or picks == [["0,1,0", "1,1,0"]]
+    # must_include pins the diagonal corner; its adjacent mate is chosen.
+    picks = kubelet.get_preferred_allocation(
+        ko.RESOURCE_CHIPS, ["0,0,0", "0,1,0", "1,1,0"], ["1,1,0"], 2)
+    assert picks == [["0,1,0", "1,1,0"]]
+
+
+def test_preferred_allocation_error_is_invalid_argument(wire):
+    import grpc
+
+    kubelet, transport, apiserver, plugin = wire
+    kubelet.wait_for_devices()
+    with pytest.raises(grpc.RpcError) as ei:
+        kubelet.get_preferred_allocation(
+            ko.RESOURCE_CHIPS, ["0,0,0"], [], 2)  # size > available
+    assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT

@@ -112,7 +112,7 @@ class DevicePluginGrpcServer:
     def _get_options(self, request, context):
         return _pb().DevicePluginOptions(
             pre_start_required=False,
-            get_preferred_allocation_available=False)
+            get_preferred_allocation_available=True)
 
     def _list_and_watch(self, request, context):
         """Initial device list, then every health/topology update — the
@@ -148,8 +148,18 @@ class DevicePluginGrpcServer:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
 
     def _get_preferred_allocation(self, request, context):
-        context.abort(_grpc().StatusCode.UNIMPLEMENTED,
-                      "preferred allocation is the extender's job")
+        pb = _pb()
+        out = pb.PreferredAllocationResponse()
+        try:
+            for c in request.container_requests:
+                ids = self.plugin.preferred_allocation(
+                    list(c.available_device_ids),
+                    list(c.must_include_device_ids),
+                    c.allocation_size)
+                out.container_responses.add(device_ids=ids)
+        except (ValueError, KeyError) as e:
+            context.abort(_grpc().StatusCode.INVALID_ARGUMENT, str(e))
+        return out
 
     def _pre_start_container(self, request, context):
         return _pb().PreStartContainerResponse()
@@ -327,6 +337,25 @@ class FakeKubeletGrpcServer:
 
     def clear_update_flag(self) -> None:
         self._seen_update.clear()
+
+    def get_preferred_allocation(self, resource: str, available: list[str],
+                                 must_include: list[str],
+                                 size: int) -> list[list[str]]:
+        """Forward GetPreferredAllocation over the wire, as the real kubelet
+        does before Allocate when the plugin advertises the option."""
+        pb = _pb()
+        endpoint = self._endpoint_by_resource[resource]
+        with self._plugin_channel(endpoint) as ch:
+            pref = ch.unary_unary(
+                f"/{_SERVICE_DEVICEPLUGIN}/GetPreferredAllocation",
+                request_serializer=pb.PreferredAllocationRequest.SerializeToString,
+                response_deserializer=pb.PreferredAllocationResponse.FromString)
+            msg = pb.PreferredAllocationRequest()
+            msg.container_requests.add(available_device_ids=available,
+                                       must_include_device_ids=must_include,
+                                       allocation_size=size)
+            resp = pref(msg, timeout=30)
+            return [list(c.device_ids) for c in resp.container_responses]
 
     def allocate(self, resource: str, device_ids: list[str]) -> api.AllocateResponse:
         pb = _pb()

@@ -177,6 +177,87 @@ class TpuDevicePlugin:
             responses.append(self._container_response(chip_ids))
         return api.AllocateResponse(container_responses=responses)
 
+    def preferred_allocation(self, available_ids: list[str],
+                             must_include_ids: list[str],
+                             size: int) -> list[str]:
+        """kubelet ``GetPreferredAllocation``: the best ICI-adjacent
+        ``size``-subset of the available chips, honoring must-includes.
+
+        This is the plugin-side topology duty the reference assigns the
+        device plugin (design.md:57-86): even a pod the extender never saw
+        (unmanaged, or scheduled while the extender was down) gets an
+        adjacent chip set instead of the kubelet's arbitrary pick.  Managed
+        pods are unaffected — Allocate's annotation honor overrides the
+        kubelet's id list either way.
+
+        Exact search: a host has at most 8 chips (v5e host bounds 4x2), so
+        scoring every candidate subset is at most C(8,4) = 70 evaluations —
+        cheaper than any heuristic worth testing.  Sets tie-break toward
+        fewer available neighbors around the chosen set (the Singular
+        anti-fragmentation policy, Gaia PDF Alg. 3), which also decides
+        k=1 where the collective score is 0 by definition.
+        """
+        from itertools import combinations
+
+        from tputopo.topology.cost import LinkCostModel
+        from tputopo.topology.score import score_chip_set
+
+        unknown = [c for c in [*available_ids, *must_include_ids]
+                   if c not in self._local_ids]
+        if unknown:
+            raise ValueError(
+                f"chips {unknown} are not on node {self.node_name}")
+        # Dedupe up front: a duplicated must-include id would otherwise pass
+        # the length validation yet collapse in the chip set, returning
+        # fewer than ``size`` devices.
+        must_include_ids = sorted(set(must_include_ids))
+        if not set(must_include_ids) <= set(available_ids):
+            raise ValueError("must-include chips missing from available set")
+        if not len(must_include_ids) <= size <= len(set(available_ids)):
+            raise ValueError(
+                f"cannot pick {size} of {len(set(available_ids))} available "
+                f"chips (must-include {len(must_include_ids)})")
+        # A live assumption with this exact size IS the preferred pick:
+        # Allocate will mount that group regardless of the kubelet's ids
+        # (_find_pending_pod), so steering the kubelet anywhere else would
+        # desynchronize its device accounting from the chips actually
+        # mounted — and strand the reserved chips in its "free" pool.
+        pending = self._find_pending_pod(size)
+        if pending is not None:
+            group = [coord_id(c) for c in ko.ann_to_coords(
+                pending["metadata"]["annotations"][ko.ANN_GROUP])]
+            if (set(must_include_ids) <= set(group)
+                    and set(group) <= set(available_ids)):
+                return sorted(group)
+        # The kubelet's "available" view lags the extender's: a bound-but-
+        # not-yet-Allocated pod's chip group is still in the kubelet's free
+        # pool, and steering an unmanaged pod onto it would make that
+        # Allocate fail its reserved-chip check even though an unreserved
+        # adjacent set exists.  Prefer unreserved chips; fall back to the
+        # full set when the unreserved pool alone cannot cover the request
+        # (Allocate stays the authority either way).
+        reserved = self._reserved_chip_ids() - set(must_include_ids)
+        pool = set(available_ids) - reserved
+        if len(pool | set(must_include_ids)) < size:
+            pool = set(available_ids)
+        avail = {tuple(int(x) for x in cid.split(",")): cid
+                 for cid in pool | set(must_include_ids)}
+        must = [tuple(int(x) for x in cid.split(","))
+                for cid in must_include_ids]
+        topo = self.probe.topology()
+        cost = LinkCostModel.for_generation(self.probe.generation)
+        rest = sorted(set(avail) - set(must))
+        best = None
+        for combo in combinations(rest, size - len(must)):
+            chips = frozenset(must).union(combo)
+            frag = sum(1 for c in chips for n in topo.neighbors(c)
+                       if n in avail and n not in chips)
+            key = (-score_chip_set(topo, chips, cost), frag,
+                   tuple(sorted(chips)))
+            if best is None or key < best[0]:
+                best = (key, chips)
+        return [avail[c] for c in sorted(best[1])]
+
     # ---- internals ---------------------------------------------------------
 
     def _is_live_assumption(self, pod: dict) -> bool:
