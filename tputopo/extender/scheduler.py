@@ -554,6 +554,10 @@ class ExtenderScheduler:
                                  acc + ([(dom, m)] if m else []))
 
             compositions(0, remaining, [])
+            # Observability for the budget (scale bench): how much of the
+            # 512-composition search this gang actually consumed.
+            self.metrics.inc("gang_multislice_compositions_considered",
+                             512 - budget[0])
             if best_plans is not None:
                 self.metrics.inc("gang_multislice_plans")
                 return ctx(best_plans)

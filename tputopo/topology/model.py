@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from functools import cached_property
+from functools import cached_property, lru_cache
 
 from tputopo.topology.generations import TpuGeneration, get_generation
 
@@ -92,6 +92,19 @@ class ChipTopology:
 
     def neighbors(self, coord: Coord) -> list[Coord]:
         """ICI-adjacent chips (±1 along each axis, honoring wraparound)."""
+        try:
+            return self.neighbor_map[coord]
+        except KeyError:
+            return self._neighbors_uncached(coord)
+
+    @cached_property
+    def neighbor_map(self) -> dict[Coord, list[Coord]]:
+        """Precomputed adjacency — the sort hot loop asks for neighbors of
+        every free chip on every node per verb, which at 256-node fleet
+        scale is tens of thousands of lookups per scheduling cycle."""
+        return {c: self._neighbors_uncached(c) for c in self.chips}
+
+    def _neighbors_uncached(self, coord: Coord) -> list[Coord]:
         out: list[Coord] = []
         for ax, (d, w) in enumerate(zip(self.dims, self.wrap)):
             if d == 1:
@@ -157,12 +170,18 @@ class ChipTopology:
         return f"{self.generation.name} {w} ({self.num_chips} chips, {self.num_hosts} hosts)"
 
 
+@lru_cache(maxsize=512)
 def parse_topology(spec: str) -> ChipTopology:
     """Parse ``"v5p:2x2x4"`` (with optional ``:wrap=101`` axis mask) into a topology.
 
     This string form is what the device plugin publishes in node annotations
     (the analog of the reference's per-edge ``GPU_<ABBR>_<i>_<j>`` annotation
     scheme, design.md:76-82 — a torus is described by its shape, not edges).
+
+    Cached: every node of a slice publishes the same spec, so a cluster
+    sync would otherwise rebuild the same frozen topology (and its derived
+    chips/hosts/neighbor tables) once per node.  Safe because ChipTopology
+    is frozen and all its cached derivations are value-determined.
     """
     parts = spec.split(":")
     if len(parts) < 2:
