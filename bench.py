@@ -141,9 +141,14 @@ def bench_scale(n_domains: int = 4, spec: str = "v5p:8x8x4",
 
     Defaults: 4 x v5p:8x8x4 domains = 1024 chips over 256 nodes.  Refuses
     to return (SystemExit) on any double-booked chip, non-contiguous
-    multi-chip placement, or sort/bind p95 over ``p95_gate_ms`` — scale
-    must not cost correctness, and latency is the claim under test (the
-    reference's own cost axis, Gaia PDF Fig. 10).
+    multi-chip placement, or steady-state LISTs — scale must not cost
+    correctness.  Latency (the reference's own cost axis, Gaia PDF
+    Fig. 10) is REPORTED AS DATA: the sort/bind p95s are compared to
+    ``p95_gate_ms`` in the returned ``p95_gate`` field, never raised —
+    absolute wall-clock on a shared host varies ~2x run to run, and a
+    timing miss must not suppress the measurement itself (VERDICT r3 #1:
+    round 3 published no numbers at all because this gate used to
+    SystemExit).
 
     Small pods arrive in WAVES — the whole wave is scored back-to-back and
     members are assigned via a local assume ledger before the binds land
@@ -334,11 +339,18 @@ def bench_scale(n_domains: int = 4, spec: str = "v5p:8x8x4",
              if len(st.free_chips_on_node(node)) >= 4)
          for dom in st.domains.values()),
         reverse=True)
-    multi_gang = min(multi_gang, sum(caps) - 4, caps[0] + max(2, caps[1] // 2))
-    if multi_gang <= caps[0]:
+    if len(caps) < 2:
+        # Parameterization guard (ADVICE r3): multislice needs a second
+        # domain to split into; caps[1] below would otherwise IndexError.
         raise SystemExit(
-            f"bench scale: trace left a domain with {caps[0]} free hosts — "
-            f"a {multi_gang}-gang would not exercise multislice (caps {caps})")
+            f"bench scale: multislice phase needs n_domains >= 2 (got "
+            f"{len(caps)} domain(s))")
+    multi_gang = min(multi_gang, sum(caps) - 4, caps[0] + max(2, caps[1] // 2))
+    if multi_gang < 2 or multi_gang <= caps[0]:
+        raise SystemExit(
+            f"bench scale: trace parameters left {caps[0]} free hosts in "
+            f"the widest domain — a {multi_gang}-gang would not exercise "
+            f"multislice (caps {caps}; retune fill/churn parameters)")
     for m in range(multi_gang):
         schedule(make_pod(f"wide-{m}", chips=4, labels={
             "tpu.dev/gang-id": "wide",
@@ -394,10 +406,15 @@ def bench_scale(n_domains: int = 4, spec: str = "v5p:8x8x4",
                                "watch_errors")},
         "setup_s": round(setup_s, 2),
     }
+    # Latency vs gate is DATA, not a verdict (see docstring): correctness
+    # violations abort above; a timing miss on a noisy host must never
+    # suppress the measurements.
+    out["p95_gate_ms"] = p95_gate_ms
     if out["sort_p95_ms"] > p95_gate_ms or out["bind_p95_ms"] > p95_gate_ms:
-        raise SystemExit(
-            f"bench scale: p95 over gate ({out['sort_p95_ms']} / "
-            f"{out['bind_p95_ms']} ms vs {p95_gate_ms})")
+        out["p95_gate"] = (f"fail: p95 {out['sort_p95_ms']} / "
+                           f"{out['bind_p95_ms']} ms vs {p95_gate_ms}")
+    else:
+        out["p95_gate"] = "pass"
     if out["informer"]["lists"] != len(informer.kinds):
         raise SystemExit(
             f"bench scale: {out['informer']['lists']} LISTs — steady state "
@@ -780,8 +797,40 @@ def bench_decode() -> dict | None:
 
 
 def main() -> None:
-    sched = bench_scheduler()
-    workload = bench_workload_mfu()
+    """Headline first, extras fault-isolated (VERDICT r3 #1: a failing
+    extras sub-bench must never suppress the headline JSON line).  Exit
+    code: 0 normally — including when a latency gate reports "fail" as
+    data — and 1 ONLY when the headline itself could not be computed or an
+    extras sub-bench hit a correctness violation (its SystemExit is
+    recorded in the JSON, which still prints)."""
+    correctness_failures: list[str] = []
+
+    def isolated(name: str, fn, *args, strict: bool = False):
+        try:
+            return fn(*args)
+        except KeyboardInterrupt:
+            raise
+        except SystemExit as e:
+            # Sub-benches reserve SystemExit for correctness violations
+            # (double-booking, non-contiguity, steady-state LISTs) and
+            # trace-parameterization errors — report AND flag rc.
+            correctness_failures.append(f"{name}: {e}")
+            print(f"bench: {name} correctness failure: {e}", file=sys.stderr)
+            return {"error": f"correctness: {e}"}
+        except BaseException as e:
+            # strict sub-benches are pure-Python correctness traces: ANY
+            # crash there means the trace's invariants went unvalidated —
+            # flag rc.  Non-strict ones depend on accelerator hardware; a
+            # hiccup there loses a data point, headline still publishes,
+            # rc stays 0.
+            if strict:
+                correctness_failures.append(
+                    f"{name}: {type(e).__name__}: {e}")
+            print(f"bench: {name} failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    sched = bench_scheduler()  # headline — if this dies, rc != 0 (nothing to publish)
     p50 = sched["p50_ms"]
     out = {
         "metric": "scheduler_sort_bind_p50_latency",
@@ -796,14 +845,17 @@ def main() -> None:
             "pods_scheduled": sched["pods_scheduled"],
             "cluster": "fake v5p-128 (4x4x4 chips, 16 hosts)",
             "placement_quality_vs_ideal": sched["quality_vs_ideal"],
-            "scale": bench_scale(),
-            "bandwidth_gain_vs_count_only": bench_ab_gain(),
-            "workload_fwd": workload,
-            "decode": bench_decode(),
-            "hbm": bench_hbm_gbps(),
+            "scale": isolated("scale", bench_scale, strict=True),
+            "bandwidth_gain_vs_count_only": isolated("ab_gain", bench_ab_gain,
+                                                     strict=True),
+            "workload_fwd": isolated("workload_mfu", bench_workload_mfu),
+            "decode": isolated("decode", bench_decode),
+            "hbm": isolated("hbm", bench_hbm_gbps),
         },
     }
     print(json.dumps(out))
+    if correctness_failures:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

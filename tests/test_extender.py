@@ -746,22 +746,88 @@ def test_gang_rank_scaling_no_tie_at_any_size():
 
 
 def test_duplicate_bind_of_full_gang_does_not_wipe_members():
-    """A retried/duplicate bind against a fully bound gang must raise
-    without releasing the members' live assumptions (they are healthy —
-    only genuinely infeasible gangs get the prompt wipe)."""
+    """Retried/duplicate binds against a fully bound gang (ADVICE r3):
+
+    - a retry for a member on ITS OWN node is idempotent — it returns the
+      recorded decision (a kube-scheduler retry after a timed-out-but-
+      successful bind must not surface a spurious failure);
+    - a retry naming a DIFFERENT node raises without re-placing;
+    - an EXTRA pod wearing the gang label raises "nothing left to bind";
+    - none of these release the members' live assumptions (they are
+      healthy — only genuinely infeasible gangs get the prompt wipe)."""
     clock = Clock(1000.0)
     api, _ = build_cluster(clock=clock)
     sched = make_scheduler(api, clock=clock)
     for i in range(2):
         api.create("pods", gang_pod(f"d-{i}", "job-e", 2, 4))
+    decisions = {}
     for i in range(2):
         pod = api.get("pods", f"d-{i}", "default")
         best = max(sched.sort(pod, all_nodes(api)), key=lambda s: s["Score"])
-        sched.bind(f"d-{i}", "default", best["Host"])
+        decisions[f"d-{i}"] = sched.bind(f"d-{i}", "default", best["Host"])
+    # Same node -> idempotent replay of the recorded decision.
+    own_node = decisions["d-0"]["node"]
+    replay = sched.bind("d-0", "default", own_node)
+    assert replay["replayed"] is True
+    assert replay["chips"] == decisions["d-0"]["chips"]
+    assert sched.metrics.counters["bind_idempotent_replays"] == 1
+    # Different node -> error, no re-placement, annotations untouched.
+    other = next(n for n in all_nodes(api) if n != own_node)
+    with pytest.raises(BindError, match="already bound"):
+        sched.bind("d-0", "default", other)
+    # Extra pod wearing the label of a full gang -> nothing left to bind.
+    api.create("pods", gang_pod("d-extra", "job-e", 2, 4))
     with pytest.raises(BindError, match="nothing left to bind"):
-        sched.bind("d-0", "default", "node-0")  # duplicate (kubelet retry)
+        sched.bind("d-extra", "default", own_node)
     for i in range(2):
         anns = api.get("pods", f"d-{i}", "default")["metadata"]["annotations"]
         assert ko.ANN_GROUP in anns, "duplicate bind wiped a live assumption"
+        assert anns[ko.ANN_GROUP] == ko.coords_to_ann(
+            [tuple(c) for c in decisions[f"d-{i}"]["chips"]]), \
+            "a retried bind re-placed a healthy member"
     assert "gang_assumptions_released" not in sched.metrics.counters
     assert sched.metrics.counters["bind_gang_already_bound"] == 1
+
+
+def test_retried_single_pod_bind_is_idempotent():
+    """ADVICE r3: a bind replayed after a timed-out-but-successful earlier
+    bind (kube-scheduler retry) returns the recorded decision verbatim —
+    it must NOT re-run selection, which could overwrite the GROUP
+    annotation with different chips while the kubelet is already
+    allocating the original group."""
+    api, _ = build_cluster()
+    sched = make_scheduler(api)
+    api.create("pods", make_pod("solo", chips=2))
+    first = sched.bind("solo", "default", "node-1")
+    anns_before = api.get("pods", "solo", "default")["metadata"]["annotations"]
+    replay = sched.bind("solo", "default", "node-1")
+    assert replay["replayed"] is True
+    assert replay["chips"] == first["chips"]
+    assert replay["node"] == first["node"]
+    assert replay["contiguous"] == first["contiguous"]
+    anns_after = api.get("pods", "solo", "default")["metadata"]["annotations"]
+    assert anns_after == anns_before, "replay mutated the recorded handshake"
+    # Naming the wrong node is an error, still without mutation.
+    with pytest.raises(BindError, match="already bound"):
+        sched.bind("solo", "default", "node-2")
+    assert api.get("pods", "solo", "default")["metadata"]["annotations"] == anns_before
+
+
+def test_bogus_node_chip_annotation_does_not_wedge_sort():
+    """Code-review r4: a hand-written node chips annotation naming a coord
+    outside the topology must not crash the verb — the bogus coord simply
+    cannot be placed on (the same tolerance sync applies to UNHEALTHY)."""
+    api, _ = build_cluster()
+    import json as _json
+    chips = _json.loads(
+        api.get("nodes", "node-1")["metadata"]["annotations"][ko.ANN_CHIPS])
+    chips.append({"id": "9,9,9", "path": "/dev/bogus"})
+    api.patch_annotations("nodes", "node-1",
+                          {ko.ANN_CHIPS: _json.dumps(chips)})
+    sched = make_scheduler(api)
+    pod = make_pod("p", chips=2)
+    api.create("pods", pod)
+    scores = {s["Host"]: s["Score"] for s in sched.sort(pod, all_nodes(api))}
+    assert scores["node-1"] > 0  # real chips still schedulable
+    decision = sched.bind("p", "default", "node-1")
+    assert all(tuple(c) != (9, 9, 9) for c in decision["chips"])

@@ -126,17 +126,24 @@ def test_sort_zero_lists_in_steady_state():
         # cache (the informer mirror did not change between sorts).
         assert sched.metrics.counters["state_from_informer"] == 1
         assert sched.metrics.counters["state_cache_hits"] == 24
-        # bind is the authoritative leg: it re-syncs (LISTs expected), and
-        # still lands correctly.
+        # bind serves from the mirror too (writes stay authoritative via
+        # the API server's CAS): zero LISTs, and it publishes its own
+        # delta so the next sort needs no rebuild either.
         decision = sched.bind("p", "default", "node-0")
         assert decision["node"] == "node-0"
-        assert api.list_calls > baseline
-        # The bind's own patches flow back via watch and invalidate the
-        # cached state: the next sort rebuilds from the changed mirror.
+        assert api.list_calls == baseline, "bind must not LIST the API server"
+        assert sched.metrics.counters.get("bind_state_delta", 0) == 1
+        # The bind's own watch echo must NOT invalidate the delta-applied
+        # state: the next sort is a cache hit, not a rebuild.
         assert wait_until(lambda: inf.get(
             "pods", "p", "default")["spec"].get("nodeName") == "node-0")
-        sched.sort(pod, [f"node-{i}" for i in range(4)])
-        assert sched.metrics.counters["state_from_informer"] == 2
+        scores = sched.sort(pod, [f"node-{i}" for i in range(4)])
+        assert sched.metrics.counters["state_from_informer"] == 1
+        # ...and that state reflects the bind: the pod's 4 chips are taken,
+        # so an identical request now scores 0 everywhere on this 4-chip-
+        # per-node cluster node-0 sat on.
+        assert all(s["Score"] == 0 for s in scores
+                   if s["Host"] == "node-0"), scores
     finally:
         inf.stop()
 
@@ -327,3 +334,40 @@ def test_rvless_delete_is_unordered():
     inf._apply("pods", {"type": "DELETED", "object": {
         "metadata": {"name": "q", "namespace": "default"}}})
     assert all(p["metadata"]["name"] != "q" for p in inf.list("pods"))
+
+
+def test_watch_echo_of_observe_does_not_move_version_token():
+    """The content-version contract the bind delta fast path relies on:
+    the watch echo of an object the mirror already installed via
+    write-through observe() (same resourceVersion) changes nothing, so
+    the coherence token must not move — while a genuinely newer event,
+    a delete, and an observe each move it by exactly one."""
+    api = FakeApiServer()
+    inf = Informer(api, kinds=("pods",), watch_timeout_s=0.2)
+    inf._synced["pods"].set()
+    v0 = inf.version()
+    obj = {"metadata": {"name": "p", "namespace": "default",
+                        "resourceVersion": "5"}}
+    v1 = inf.observe("pods", obj)
+    assert v1 != v0 and v1 == (str(int(v0[0]) + 1),)
+    # Echo: same object, same rv, arriving through the watch.
+    inf._apply("pods", {"type": "MODIFIED", "rv": "5", "object": dict(obj)})
+    assert inf.version() == v1, "echo event invalidated derived state"
+    # Re-observing the identical object is also a no-op.
+    assert inf.observe("pods", obj) == v1
+    # A genuinely newer event moves the token.
+    inf._apply("pods", {"type": "MODIFIED", "rv": "6", "object": {
+        "metadata": {"name": "p", "namespace": "default",
+                     "resourceVersion": "6"}}})
+    v2 = inf.version()
+    assert v2 == (str(int(v1[0]) + 1),)
+    # A removing delete moves it; a no-op delete does not.
+    inf._apply("pods", {"type": "DELETED", "rv": "7", "object": {
+        "metadata": {"name": "p", "namespace": "default",
+                     "resourceVersion": "7"}}})
+    v3 = inf.version()
+    assert v3 == (str(int(v2[0]) + 1),)
+    inf._apply("pods", {"type": "DELETED", "rv": "8", "object": {
+        "metadata": {"name": "ghost", "namespace": "default",
+                     "resourceVersion": "8"}}})
+    assert inf.version() == v3

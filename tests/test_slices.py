@@ -239,3 +239,44 @@ def test_mask_geometry_matches_set_semantics():
                         assert (nbr & fmask).bit_count() == _free_boundary(
                             topo, frozenset(bchips), free)
                 assert [p.chips for p in placements] == ref
+
+
+def test_find_within_hint_is_result_identical():
+    """The ``within`` performance hint (the per-node candidate pruning the
+    sort hot loop uses) must never change the result — including when the
+    hint does not actually cover the free set (it is then ignored)."""
+    import itertools
+    import random
+
+    t = ChipTopology.build("v5p", (4, 4, 4))
+    rng = random.Random(7)
+    hosts = list(t.hosts.values())
+    for trial in range(40):
+        host_chips = tuple(rng.choice(hosts))
+        n_free = rng.randint(0, len(host_chips))
+        free = frozenset(rng.sample(list(host_chips), n_free))
+        alloc = Allocator(t)
+        for k in (1, 2, 3, 4):
+            base = alloc.find(k, free)
+            hinted = alloc.find(k, free, within=host_chips)
+            assert base == hinted, (trial, k, sorted(free))
+        # A hint that does NOT cover the free set must be ignored, not
+        # corrupt the search.
+        wide_free = free | {c for c in t.chips if c not in host_chips and rng.random() < 0.1}
+        for k in (2, 4):
+            assert alloc.find(k, frozenset(wide_free)) == \
+                alloc.find(k, frozenset(wide_free), within=host_chips)
+
+
+def test_free_cache_tracks_mutations():
+    t = v5p32()
+    a = Allocator(t)
+    assert len(a.free) == 16
+    a.mark_used([(0, 0, 0), (0, 0, 1)])
+    assert len(a.free) == 14 and (0, 0, 0) not in a.free
+    a.release([(0, 0, 0)])
+    assert (0, 0, 0) in a.free and len(a.free) == 15
+    b = a.clone()
+    b.mark_used([(0, 0, 0)])
+    assert (0, 0, 0) in a.free and (0, 0, 0) not in b.free, \
+        "clone must not share occupancy with its source"

@@ -26,8 +26,11 @@ class ExtenderConfig:
     resource_name: str = RESOURCE_CHIPS
     # Reuse the synced cluster state for `sort` scoring for this many
     # seconds (0 = always fresh).  Against a real API server every sync is
-    # two cluster-wide LISTs; a sub-second cache bounds that load.  `bind`
-    # always re-syncs — placement decisions never run on stale occupancy.
+    # two cluster-wide LISTs; a sub-second cache bounds that load.  This
+    # TTL only governs the informer-less fallback: with an informer wired
+    # (the deployed shape), both verbs serve from the mirror-coherent
+    # derived state, bind write-throughs its own delta, and the API
+    # server's optimistic concurrency remains the authority on writes.
     state_cache_s: float = 0.0
     # Per-generation LinkCostModel field overrides, e.g.
     # {"v5p": {"ici_link_gbps": 95.0, "dcn_host_gbps": 42.0}} — the explicit,

@@ -16,6 +16,7 @@ import json
 import math
 import time
 from dataclasses import dataclass, field
+from functools import lru_cache
 
 from tputopo.k8s import objects as ko
 from tputopo.k8s.fakeapi import FakeApiServer
@@ -33,6 +34,15 @@ class PodAssignment:
     assigned: bool
     assume_time: float
     gang_id: str | None
+
+
+@lru_cache(maxsize=4096)
+def _parse_chips_ann(s: str) -> tuple[Coord, ...]:
+    """Node ANN_CHIPS JSON -> chip coords, memoized on the (stable)
+    annotation string: every sync re-reads every node's chip list, which
+    at fleet scale was ~10^5 json.loads per trace."""
+    return tuple(tuple(int(x) for x in c["id"].split(","))
+                 for c in json.loads(s))
 
 
 def _assume_time_of(pod: dict) -> float:
@@ -88,6 +98,7 @@ class ClusterState:
         # Sync must tolerate them — a poisoned annotation would otherwise
         # wedge every verb AND the GC that could clean it up.
         self.conflicts: list[PodAssignment] = []
+        self._dom_by_node: dict[str, SliceDomain] = {}
 
     # ---- sync (SURVEY.md §3.2: parse annotations -> in-memory model) -------
 
@@ -104,6 +115,7 @@ class ClusterState:
         self.domains = {}
         self.expired = []
         self.conflicts = []
+        self._dom_by_node = {}
         for node in self._list("nodes"):
             anns = node["metadata"].get("annotations", {})
             if ko.ANN_TOPOLOGY not in anns or ko.ANN_SLICE_ID not in anns:
@@ -127,11 +139,10 @@ class ClusterState:
             host = tuple(int(x) for x in anns[ko.ANN_HOST_COORD].split(","))
             dom.node_by_host[host] = name
             dom.host_by_node[name] = host
-            dom.chips_by_node[name] = [
-                tuple(int(x) for x in c["id"].split(","))
-                for c in json.loads(anns.get(ko.ANN_CHIPS, "[]"))
-            ]
-            valid = set(dom.topology.chips)
+            self._dom_by_node[name] = dom
+            dom.chips_by_node[name] = list(
+                _parse_chips_ann(anns.get(ko.ANN_CHIPS, "[]")))
+            valid = dom.topology.chip_set
             dom.unhealthy.update(
                 c for c in ko.ann_to_coords(anns.get(ko.ANN_UNHEALTHY, ""))
                 if c in valid)  # a bogus coord must not wedge sync
@@ -197,10 +208,57 @@ class ClusterState:
         return self
 
     def _domain_of_node(self, node_name: str) -> SliceDomain | None:
-        for dom in self.domains.values():
-            if node_name in dom.host_by_node:
-                return dom
-        return None
+        return self._dom_by_node.get(node_name)
+
+    # ---- delta application (the bind fast path) ----------------------------
+
+    def with_bind(self, pa: PodAssignment) -> "ClusterState":
+        """A new state equal to this one plus one just-bound assignment —
+        the extender's bind delta (VERDICT r3 #1: bind used to pay a full
+        O(pods) cluster re-sync per call; applying its own delta to the
+        informer-coherent derived state is O(chips)).
+
+        Copy-on-write: the receiver and its domains are never mutated, so
+        concurrently running sorts holding the old state keep a consistent
+        snapshot; the caller atomically publishes the returned state.
+        Raises ValueError when the assignment's chips are not free here
+        (the caller falls back to a full re-sync)."""
+        new = ClusterState.__new__(ClusterState)
+        new.api = self.api
+        new.assume_ttl_s = self.assume_ttl_s
+        new.clock = self.clock
+        new._cost_for_generation = self._cost_for_generation
+        new.expired = list(self.expired)
+        new.conflicts = list(self.conflicts)
+        new.domains = {}
+        new._dom_by_node = {}
+        for sid, dom in self.domains.items():
+            # Topology, node maps, chip lists, and the unhealthy set are
+            # immutable after sync — shared; occupancy and assignment lists
+            # are copied.  Per-state memos (gang plans, node scores) are
+            # attribute-attached by the scheduler and deliberately NOT
+            # carried over: the delta invalidates them.
+            nd = SliceDomain(
+                slice_id=sid, topology=dom.topology,
+                allocator=dom.allocator.clone(),
+                node_by_host=dom.node_by_host,
+                host_by_node=dom.host_by_node,
+                chips_by_node=dom.chips_by_node,
+                assignments=list(dom.assignments),
+                conflicts=list(dom.conflicts),
+                expired=list(dom.expired),
+                unhealthy=dom.unhealthy,
+                on_unhealthy=list(dom.on_unhealthy),
+            )
+            new.domains[sid] = nd
+            for node in nd.host_by_node:
+                new._dom_by_node[node] = nd
+        dom = new._dom_by_node.get(pa.node_name)
+        if dom is None:
+            raise ValueError(f"node {pa.node_name} not in any domain")
+        dom.allocator.mark_used(pa.chips)  # raises if any chip is taken
+        dom.assignments.append(pa)
+        return new
 
     # ---- views -------------------------------------------------------------
 

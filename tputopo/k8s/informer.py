@@ -15,8 +15,10 @@ The informer exposes the read half of the FakeApiServer surface
 (``list``/``get``), so :class:`~tputopo.extender.state.ClusterState` can
 sync *from the cache* unchanged.  Writes keep going to the real API — the
 cache is eventually consistent, which is safe where it is used: ``sort``
-scores from the cache, ``bind`` always re-syncs authoritatively (placement
-decisions never run on stale occupancy, ExtenderConfig docstring).
+scores from the cache; ``bind`` plans from the cache too but its writes go
+through the API server's optimistic concurrency and are written through to
+the mirror immediately (``observe``), so the extender's own placements are
+never stale in its own view (ExtenderConfig docstring).
 """
 
 from __future__ import annotations
@@ -55,6 +57,16 @@ class Informer:
         self._store: dict[str, dict[tuple[str, str], dict]] = {
             k: {} for k in kinds}
         self._rv: dict[str, str] = {}
+        # Content version: bumped ONLY when the mirror's content actually
+        # changes (install of a new/newer object, a delete that removed
+        # something, a relist).  The watch position (_rv) advances on every
+        # event, but an event that is the echo of a write-through observe()
+        # re-delivers an object the mirror already holds at the same
+        # resourceVersion — content identical, so derived state (the
+        # extender's ClusterState) stays coherent and must not be
+        # invalidated.  This is what lets bind apply its own delta instead
+        # of paying an O(pods) re-sync per call (VERDICT r3 #1).
+        self._content = 0
         self._lock = threading.Lock()
         self._synced = {k: threading.Event() for k in kinds}
         self._stop = threading.Event()
@@ -87,32 +99,43 @@ class Informer:
         return all(ev.is_set() for ev in self._synced.values())
 
     def version(self) -> tuple[str, ...]:
-        """Cache-coherence token: changes iff the mirror changed (by watch
-        event OR write-through observe).  Lets consumers reuse derived
-        state (e.g. the extender's ClusterState) across verbs until an
-        event actually lands."""
+        """Cache-coherence token: changes iff the mirror's CONTENT changed
+        (install of a new/newer object, a removing delete, a relist, a
+        write-through observe).  The echo watch event of an object the
+        mirror already holds at the same resourceVersion does NOT move the
+        token — derived state stays reusable across a verb's own write
+        coming back through the watch.  Lets consumers reuse derived state
+        (e.g. the extender's ClusterState) until content actually moves."""
         with self._lock:
-            return tuple(self._rv.get(k, "") for k in self.kinds) + (
-                str(self._observe_count),)
+            return (str(self._content),)
 
-    def observe(self, kind: str, obj: dict) -> None:
+    def observe(self, kind: str, obj: dict) -> tuple[str, ...]:
         """Assume-cache write-through (the kube-scheduler cache pattern):
         the caller just wrote ``obj`` successfully (its own PATCH/bind) and
         must not wait a watch round-trip to see its own write — the next
         ``sort`` would otherwise plan against pre-bind state and hand out
         already-assigned chips.  Upsert is keyed, so the eventual watch
         event is idempotent; a *stale* concurrent event cannot regress the
-        mirror because older resourceVersions lose."""
+        mirror because older resourceVersions lose.
+
+        Returns the post-install version token (atomically, under the
+        mirror lock): a caller whose pre-write token was exactly one step
+        older knows its own write is the ONLY content change in between
+        and may delta-apply it to derived state instead of re-syncing."""
 
         with self._lock:
-            if kind not in self._store:
-                return
-            key = _key(obj)
-            cur = self._store[kind].get(key)
-            if cur is None or _obj_rv(obj) >= _obj_rv(cur):
-                self._store[kind][key] = obj
-                self._observe_count += 1
-                self.metrics["observes"] += 1
+            if kind in self._store:
+                key = _key(obj)
+                cur = self._store[kind].get(key)
+                obj_rv, cur_rv = _obj_rv(obj), _obj_rv(cur or {})
+                # Same escape hatch as _apply: two rv-less objects are
+                # unordered — install (can't prove identity) and bump.
+                if cur is None or obj_rv > cur_rv or obj_rv == cur_rv == 0:
+                    self._store[kind][key] = obj
+                    self._content += 1
+                    self._observe_count += 1
+                    self.metrics["observes"] += 1
+            return (str(self._content),)
 
     # ---- list+watch loop ---------------------------------------------------
 
@@ -135,6 +158,7 @@ class Informer:
                     new_store[key] = cur
             self._store[kind] = new_store
             self._rv[kind] = rv
+            self._content += 1  # conservative: a relist may change anything
         self.metrics["lists"] += 1
         self._synced[kind].set()
 
@@ -158,14 +182,21 @@ class Informer:
                     if del_rv == 0:
                         self.metrics["unordered_deletes_kept"] += 1
                 else:
-                    self._store[kind].pop(key, None)
+                    if self._store[kind].pop(key, None) is not None:
+                        self._content += 1
             else:  # ADDED / MODIFIED — upsert, newest resourceVersion wins
                 # (an event older than a write-through observe() of the
-                # same object must not regress the mirror).
+                # same object must not regress the mirror).  An event at
+                # the SAME resourceVersion as the mirror entry is the echo
+                # of an observe(): identical content, skip entirely so the
+                # version token doesn't move.  Two rv-less objects are
+                # unordered — install (can't prove identity) and bump.
                 key = _key(obj)
                 cur = self._store[kind].get(key)
-                if cur is None or _obj_rv(obj) >= _obj_rv(cur):
+                obj_rv, cur_rv = _obj_rv(obj), _obj_rv(cur or {})
+                if cur is None or obj_rv > cur_rv or obj_rv == cur_rv == 0:
                     self._store[kind][key] = obj
+                    self._content += 1
             if event.get("rv"):
                 self._rv[kind] = event["rv"]
         self.metrics["watch_events"] += 1

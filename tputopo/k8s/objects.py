@@ -15,6 +15,7 @@ the real thing.
 from __future__ import annotations
 
 import json
+from functools import lru_cache
 from typing import Any
 
 # -- The one resource name (fixes the reference's aliyun.com/gpu vs
@@ -109,10 +110,20 @@ def coords_to_ann(coords) -> str:
     return ";".join(",".join(str(x) for x in c) for c in coords)
 
 
+@lru_cache(maxsize=8192)
+def _ann_to_coords_cached(s: str) -> tuple[tuple[int, ...], ...]:
+    return tuple(tuple(int(x) for x in part.split(","))
+                 for part in s.split(";"))
+
+
 def ann_to_coords(s: str) -> list[tuple[int, ...]]:
+    """Parse an ANN_GROUP-style coord list.  Parsing is memoized on the
+    annotation string: a cluster sync re-reads every pod's (stable) GROUP
+    annotation, which at fleet scale was ~10^5 re-parses per trace; the
+    returned list is a fresh copy, safe to mutate."""
     if not s:
         return []
-    return [tuple(int(x) for x in part.split(",")) for part in s.split(";")]
+    return list(_ann_to_coords_cached(s))
 
 
 def chips_json(coords_with_paths: list[dict]) -> str:

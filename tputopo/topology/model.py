@@ -72,6 +72,13 @@ class ChipTopology:
             coords = [c + (i,) for c in coords for i in range(d)]
         return coords
 
+    @cached_property
+    def chip_set(self) -> frozenset[Coord]:
+        """Membership view of :attr:`chips` — validity checks in the
+        allocator hot path run per mark_used call, and rebuilding the set
+        each time measured ~0.7 s across one fleet-scale trace."""
+        return frozenset(self.chips)
+
     def index(self, coord: Coord) -> int:
         """Row-major flat index of a coordinate — the stable device id."""
         idx = 0
@@ -138,8 +145,18 @@ class ChipTopology:
         Analog of the reference's CPU-affinity grouping used as the k=1
         tiebreak (design.md:145-146): same host == same NUMA/DCN attachment.
         """
+        got = self.host_map.get(coord)
+        if got is not None:
+            return got
         hb = self.generation.host_bounds
         return tuple(c // b for c, b in zip(coord, hb))
+
+    @cached_property
+    def host_map(self) -> dict[Coord, Coord]:
+        """Precomputed chip -> host lookup (the k=1 Singular tiebreak reads
+        it per free chip per verb)."""
+        hb = self.generation.host_bounds
+        return {c: tuple(x // b for x, b in zip(c, hb)) for c in self.chips}
 
     @cached_property
     def hosts(self) -> dict[Coord, list[Coord]]:
