@@ -13,8 +13,10 @@ allocated GPUs).  The TPU-native analog here is twofold:
   workload ("4-replica Llama-3-8B JAX job onto a v5p-32").  MoE expert
   parallelism lives in :mod:`tputopo.workloads.moe`, SPMD pipeline
   parallelism in :mod:`tputopo.workloads.pipeline`, ring (context-
-  parallel) attention in :mod:`tputopo.workloads.ring`, KV-cache serving
-  in :mod:`tputopo.workloads.decode`, and the conv-classifier second
+  parallel) attention in :mod:`tputopo.workloads.ring`, KV-cache decode
+  in :mod:`tputopo.workloads.decode`, the continuous-batching serving
+  engine (ragged prompts, EOS, slot reuse) in
+  :mod:`tputopo.workloads.serving`, and the conv-classifier second
   model family (the Gaia Exp.6 MNIST analog) in
   :mod:`tputopo.workloads.vision`.
 
