@@ -143,3 +143,46 @@ def test_moe_training_reduces_loss():
     for _ in range(8):
         state, loss = step(state, toks)
     assert float(loss) < float(first)
+
+
+def test_reference_path_matches_per_expert_unroll():
+    """VERDICT r3 #5: the batched drop-free mixture must equal the naive
+    per-expert unroll it replaced, token for token."""
+    cfg = MOE_TINY
+    params = init_params(cfg, jax.random.key(3))
+    p = _layer0(params)
+    x = jax.random.normal(jax.random.key(4), (2, 6, cfg.d_model), jnp.float32)
+
+    got = moe_mlp_reference(x, p, cfg)
+
+    m = cfg.moe
+    x32 = x.astype(jnp.float32)
+    probs = jax.nn.softmax(x32 @ p["router"].astype(jnp.float32), -1)
+    gates, idx = jax.lax.top_k(probs, m.top_k)
+    gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    ys = jnp.stack([
+        (jax.nn.silu(x32 @ p["w_gate"][e]) * (x32 @ p["w_up"][e]))
+        @ p["w_down"][e]
+        for e in range(m.n_experts)])
+    w = (jax.nn.one_hot(idx, m.n_experts) * gates[..., None]).sum(2)
+    want = jnp.einsum("bte,ebtd->btd", w, ys).astype(x.dtype)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_reference_path_hlo_is_constant_in_expert_count():
+    """The decode serving path must compile O(1) in E (the old unroll was
+    O(E) HLO — wrong shape at E=64)."""
+    def hlo_len(n_experts):
+        cfg = ModelConfig(
+            vocab_size=128, d_model=32, n_layers=1, n_heads=4, n_kv_heads=2,
+            d_ff=64, max_seq=64, compute_dtype=jnp.float32,
+            moe=MoEConfig(n_experts=n_experts, top_k=2, capacity_factor=2.0))
+        params = init_params(cfg, jax.random.key(0))
+        p = _layer0(params)
+        x = jnp.ones((1, 2, cfg.d_model), jnp.float32)
+        fn = jax.jit(lambda x, p: moe_mlp_reference(x, p, cfg))
+        return len(fn.lower(x, p).as_text())
+
+    small, big = hlo_len(4), hlo_len(64)
+    assert big < small * 2, (small, big)
