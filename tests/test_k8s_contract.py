@@ -1,0 +1,286 @@
+"""Wire-contract tests for KubeApiClient against recorded apiserver
+transcripts (VERDICT r2 #9 / r3 #7 fallback).
+
+A real control-plane leg is impossible in this environment — the image
+ships no kube-apiserver/etcd/kind/envtest binaries (verified: none on
+PATH).  This suite is the prescribed fallback: each test replays a CANNED
+request/response transcript through a strict-sequencing HTTP server and
+asserts both halves of the wire contract — what the client SENDS (paths,
+query parameters, content types, body shapes, ordering) and how it
+interprets what a real apiserver RETURNS.
+
+Capture provenance: no live capture was possible here, so the canned
+responses are hand-transcribed from the published Kubernetes API contract
+(shapes follow the core/v1 API reference and the "API Concepts" docs):
+
+- chunked LIST: ``metadata.continue`` / ``remainingItemCount`` /
+  snapshot ``resourceVersion`` semantics per "Retrieving large results
+  sets in chunks" (kubernetes.io/docs/reference/using-api/api-concepts);
+  continue tokens are opaque base64 (may contain ``=``), every chunk
+  repeats the same snapshot resourceVersion.
+- 410 Gone: both forms a real server emits — an HTTP 410 with a
+  ``Status`` body (``reason: Expired``), and a mid-stream watch ERROR
+  event whose object is that same Status (api-concepts "410 Gone
+  responses" / "Efficient detection of changes").
+- optimistic concurrency: a merge-patch carrying
+  ``metadata.resourceVersion`` answered with HTTP 409 ``Status``
+  (``reason: Conflict``), per the API conventions' concurrency-control
+  section.
+- Binding subresource: POST ``pods/{name}/binding`` returns a ``Status``
+  (success), NOT the pod object.
+- deletes inside ``application/merge-patch+json`` are JSON ``null``
+  values (RFC 7386, which the PATCH endpoint implements).
+
+Every assertion about OUR side of the wire (the requests list) is exact;
+a drift in the client's encoding or sequencing fails here before it
+would fail against a live cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+import pytest
+
+from tputopo.k8s.client import KubeApiClient
+from tputopo.k8s.fakeapi import Conflict, Gone, NotFound
+
+
+class Transcript:
+    """Strict-sequence canned server: responses are consumed in order;
+    every request is recorded (method, path, query, content-type, body)."""
+
+    def __init__(self, responses: list[dict]):
+        self.responses = list(responses)
+        self.records: list[dict] = []
+        self._lock = threading.Lock()
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _handle(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                raw = self.rfile.read(n) if n else b""
+                split = urlsplit(self.path)
+                with outer._lock:
+                    outer.records.append({
+                        "method": self.command,
+                        "path": split.path,
+                        "query": parse_qs(split.query),
+                        "content_type": self.headers.get("Content-Type"),
+                        "body": json.loads(raw) if raw else None,
+                    })
+                    if not outer.responses:
+                        resp = {"status": 500, "body": {
+                            "kind": "Status", "message": "transcript exhausted"}}
+                    else:
+                        resp = outer.responses.pop(0)
+                if "stream" in resp:
+                    self.send_response(resp.get("status", 200))
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    for line in resp["stream"]:
+                        self.wfile.write(json.dumps(line).encode() + b"\n")
+                        self.wfile.flush()
+                    return
+                body = json.dumps(resp.get("body", {})).encode()
+                self.send_response(resp.get("status", 200))
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = do_POST = do_PATCH = do_DELETE = _handle
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+
+    @property
+    def base_url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def __enter__(self) -> "Transcript":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def _pod(name: str, rv: str) -> dict:
+    # List items omit kind/apiVersion, exactly as real PodList items do.
+    return {"metadata": {"name": name, "namespace": "default",
+                         "resourceVersion": rv},
+            "spec": {}, "status": {}}
+
+
+# Opaque continue token as real apiservers mint them: base64 with padding.
+_CONT = "eyJ2IjoibWV0YS5rOHMuaW8vdjEiLCJydiI6MTIzNDUsInN0YXJ0Ijoib25lXHUwMDAwIn0="
+
+
+def test_chunked_list_follows_continue_and_keeps_snapshot_rv():
+    """The client must page with limit/continue and report the SNAPSHOT
+    resourceVersion (identical on every chunk), merging all items."""
+    with Transcript([
+        {"body": {"kind": "PodList", "apiVersion": "v1",
+                  "metadata": {"resourceVersion": "12345",
+                               "continue": _CONT,
+                               "remainingItemCount": 1},
+                  "items": [_pod("a", "12001"), _pod("b", "12002")]}},
+        {"body": {"kind": "PodList", "apiVersion": "v1",
+                  "metadata": {"resourceVersion": "12345"},
+                  "items": [_pod("c", "12003")]}},
+    ]) as t:
+        client = KubeApiClient(base_url=t.base_url)
+        items, rv = client.list_with_version("pods")
+        assert [p["metadata"]["name"] for p in items] == ["a", "b", "c"]
+        assert rv == "12345"
+        first, second = t.records
+        assert first["path"] == "/api/v1/pods"
+        assert first["query"]["limit"] == ["500"]
+        assert "continue" not in first["query"]
+        # The continue token must round-trip verbatim (it contains '='
+        # which must be percent-encoded on the wire, decoded back here).
+        assert second["query"]["continue"] == [_CONT]
+        assert second["query"]["limit"] == ["500"], \
+            "chunked follow-up must keep the same limit"
+
+
+def test_list_label_selector_pushdown_encoding():
+    with Transcript([
+        {"body": {"kind": "PodList", "apiVersion": "v1",
+                  "metadata": {"resourceVersion": "7"}, "items": []}},
+    ]) as t:
+        client = KubeApiClient(base_url=t.base_url)
+        client.list("pods", label_selector={"tpu.dev/gang-id": "g1",
+                                            "team": "x"})
+        (req,) = t.records
+        # parse_qs decodes percent-encoding; selector terms are sorted.
+        assert req["query"]["labelSelector"] == ["team=x,tpu.dev/gang-id=g1"]
+
+
+def test_watch_http_410_raises_gone():
+    """A watch from an expired resourceVersion: real servers answer HTTP
+    410 with a Status body (reason Expired)."""
+    with Transcript([
+        {"status": 410, "body": {
+            "kind": "Status", "apiVersion": "v1", "status": "Failure",
+            "reason": "Expired", "code": 410,
+            "message": "too old resource version: 1 (12345)"}},
+    ]) as t:
+        client = KubeApiClient(base_url=t.base_url)
+        with pytest.raises(Gone):
+            list(client.watch("pods", "1", timeout_s=1.0))
+        (req,) = t.records
+        assert req["query"]["watch"] == ["1"]
+        assert req["query"]["resourceVersion"] == ["1"]
+        assert req["query"]["allowWatchBookmarks"] == ["true"]
+
+
+def test_watch_instream_error_410_raises_gone_and_bookmark_passes():
+    """Mid-stream expiry arrives as an ERROR event whose object is the
+    Status; bookmarks arrive as BOOKMARK events carrying only a
+    resourceVersion — the client must surface both correctly."""
+    status_410 = {"kind": "Status", "apiVersion": "v1", "status": "Failure",
+                  "reason": "Expired", "code": 410,
+                  "message": "too old resource version: 5 (99)"}
+    with Transcript([
+        {"stream": [
+            {"type": "ADDED", "object": _pod("a", "42")},
+            {"type": "BOOKMARK", "object": {
+                "metadata": {"resourceVersion": "50"}}},
+            {"type": "ERROR", "object": status_410},
+        ]},
+    ]) as t:
+        client = KubeApiClient(base_url=t.base_url)
+        events = []
+        with pytest.raises(Gone):
+            for ev in client.watch("pods", "5", timeout_s=2.0):
+                events.append(ev)
+        assert [e["type"] for e in events] == ["ADDED", "BOOKMARK"]
+        assert events[0]["rv"] == "42"
+        assert events[1]["rv"] == "50"
+
+
+def test_cas_patch_shape_and_conflict():
+    """The optimistic-concurrency leg: the merge patch must carry
+    metadata.resourceVersion and the merge-patch content type; a 409
+    Status (reason Conflict) maps to Conflict.  Annotation deletes are
+    JSON nulls (RFC 7386)."""
+    with Transcript([
+        {"status": 409, "body": {
+            "kind": "Status", "apiVersion": "v1", "status": "Failure",
+            "reason": "Conflict", "code": 409,
+            "message": 'Operation cannot be fulfilled on pods "p": '
+                       'the object has been modified'}},
+    ]) as t:
+        client = KubeApiClient(base_url=t.base_url)
+        with pytest.raises(Conflict):
+            client.patch_annotations(
+                "pods", "p", {"tpu.dev/chip-group": "0,0;0,1",
+                              "tpu.dev/assume-time": None},
+                namespace="default", expect_version="41")
+        (req,) = t.records
+        assert req["method"] == "PATCH"
+        assert req["path"] == "/api/v1/namespaces/default/pods/p"
+        assert req["content_type"] == "application/merge-patch+json"
+        md = req["body"]["metadata"]
+        assert md["resourceVersion"] == "41"
+        assert md["annotations"]["tpu.dev/chip-group"] == "0,0;0,1"
+        assert md["annotations"]["tpu.dev/assume-time"] is None, \
+            "merge-patch deletes must serialize as JSON null"
+
+
+def test_binding_subresource_returns_status_not_pod():
+    """Real apiservers answer the binding subresource with a Status —
+    consumers must not assume the pod object comes back."""
+    with Transcript([
+        {"status": 201, "body": {"kind": "Status", "apiVersion": "v1",
+                                 "status": "Success", "code": 201}},
+    ]) as t:
+        client = KubeApiClient(base_url=t.base_url)
+        out = client.bind_pod("p", "node-3", namespace="default")
+        assert out["kind"] == "Status"
+        (req,) = t.records
+        assert req["method"] == "POST"
+        assert req["path"] == "/api/v1/namespaces/default/pods/p/binding"
+        body = req["body"]
+        assert body["kind"] == "Binding"
+        assert body["target"] == {"apiVersion": "v1", "kind": "Node",
+                                  "name": "node-3"}
+
+
+def test_404_status_maps_to_notfound():
+    with Transcript([
+        {"status": 404, "body": {
+            "kind": "Status", "apiVersion": "v1", "status": "Failure",
+            "reason": "NotFound", "code": 404,
+            "message": 'pods "ghost" not found'}},
+    ]) as t:
+        client = KubeApiClient(base_url=t.base_url)
+        with pytest.raises(NotFound):
+            client.get("pods", "ghost", "default")
+
+
+def test_create_posts_to_namespaced_collection():
+    with Transcript([
+        {"status": 201, "body": _pod("newpod", "100")},
+    ]) as t:
+        client = KubeApiClient(base_url=t.base_url)
+        out = client.create("pods", {"metadata": {"name": "newpod"},
+                                     "spec": {}, "status": {}})
+        assert out["metadata"]["resourceVersion"] == "100"
+        (req,) = t.records
+        assert req["method"] == "POST"
+        assert req["path"] == "/api/v1/namespaces/default/pods"
+        assert req["body"]["metadata"]["name"] == "newpod"
