@@ -203,6 +203,10 @@ def test_largest_free_box_bounded_on_256_chip_torus():
     vol, dims = alloc.largest_free_box()
     elapsed = time.perf_counter() - t0
     assert vol == 4 and sorted(dims) == [2, 2]
+    # Absolute-time gate policy (VERDICT r3 #8): typical elapsed is a few
+    # ms; the 1 s bound only guards against a complexity regression (the
+    # former shape x origin rescan was unbounded) with ~100x headroom for
+    # shared-host variance.
     assert elapsed < 1.0, f"largest_free_box took {elapsed:.2f}s"
 
 
