@@ -470,12 +470,10 @@ def _fwd_flops(c, batch: int, seq: int) -> float:
     return per_tok * batch * seq + attn
 
 
-def _measure_fwd_s(config, batch: int, seq: int, *, steps: int = 6,
-                   reps: int = 3, overhead_s: float = 0.0) -> float:
-    """Per-forward-step seconds: ``steps`` forwards chained inside ONE jit
-    call (the tunnel to the chip costs ~70 ms per dispatch — unamortized
-    timing would measure the RPC, not the chip), minus the measured
-    trivial-roundtrip overhead, divided by ``steps``."""
+def _fwd_runner(config, batch: int, seq: int, steps: int):
+    """A zero-arg callable running ``steps`` chained forwards in one jit
+    dispatch (tokens vary per scan iteration so loop-invariant code
+    motion cannot hoist the forward), compiled on first call."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -489,21 +487,58 @@ def _measure_fwd_s(config, batch: int, seq: int, *, steps: int = 6,
     @jax.jit
     def multi(p, t):
         def body(acc, i):
-            # Tokens vary per iteration — loop-invariant code motion must
-            # not hoist the forward out of the scan.
             toks = (t + i) % config.vocab_size
             return acc + jnp.sum(forward(p, toks, config)
                                  .astype(jnp.float32)), None
         acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(steps))
         return acc
 
-    float(multi(params, base))  # compile
+    return lambda: float(multi(params, base))
+
+
+def _measure_fwd_s(config, batch: int, seq: int, *, steps: int = 6,
+                   reps: int = 3, overhead_s: float = 0.0) -> float:
+    """Per-forward-step seconds: ``steps`` forwards chained inside ONE jit
+    call (the tunnel to the chip costs ~70 ms per dispatch — unamortized
+    timing would measure the RPC, not the chip), minus the measured
+    trivial-roundtrip overhead, divided by ``steps``."""
+    run = _fwd_runner(config, batch, seq, steps)
+    run()  # compile
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        float(multi(params, base))
+        run()
         times.append(time.perf_counter() - t0)
     return max(min(times) - overhead_s, 1e-9) / steps
+
+
+def _measure_fwd_pair(cfg_a, cfg_b, batch: int, seq: int, *, steps: int = 6,
+                      reps: int = 3, overhead_s: float = 0.0
+                      ) -> tuple[float, float, float]:
+    """Interleaved A/B forward timing: reps alternate A,B,A,B so a chip
+    clock shift mid-measurement hits both sides equally (this host's
+    measured drift has skewed sequentially-timed ratios by >2x).
+
+    Returns (t_a, t_b, b_over_a): the per-side times are min-over-reps
+    (best absolute estimate for MFU math), but the RATIO is the median of
+    per-rep ratios — a regime change between the two halves of one rep
+    skews only that rep's ratio, and the median outvotes it, where
+    min-per-side could pair times from different regimes."""
+    run_a = _fwd_runner(cfg_a, batch, seq, steps)
+    run_b = _fwd_runner(cfg_b, batch, seq, steps)
+    run_a(), run_b()  # compile both before timing either
+    ta, tb = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        run_a()
+        ta.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_b()
+        tb.append(time.perf_counter() - t0)
+    net_a = [max(t - overhead_s, 1e-9) for t in ta]
+    net_b = [max(t - overhead_s, 1e-9) for t in tb]
+    ratio = statistics.median(b / a for a, b in zip(net_a, net_b))
+    return min(net_a) / steps, min(net_b) / steps, ratio
 
 
 def _measure_matmul_mfu(overhead_s: float) -> float | None:
@@ -682,8 +717,8 @@ def bench_workload_mfu() -> dict | None:
         overhead = _measure_dispatch_overhead_s()
         flash_cfg = ModelConfig(**base, attn_impl="flash")
         einsum_cfg = ModelConfig(**base, attn_impl="einsum")
-        t_flash = _measure_fwd_s(flash_cfg, batch, seq, overhead_s=overhead)
-        t_einsum = _measure_fwd_s(einsum_cfg, batch, seq, overhead_s=overhead)
+        t_flash, t_einsum, einsum_over_flash = _measure_fwd_pair(
+            flash_cfg, einsum_cfg, batch, seq, overhead_s=overhead)
         flops = _fwd_flops(flash_cfg, batch, seq)
         achieved = flops / t_flash
         out = {
@@ -695,7 +730,9 @@ def bench_workload_mfu() -> dict | None:
             "fwd_tokens_per_s": round(batch * seq / t_flash),
             "achieved_tflops": round(achieved / 1e12, 1),
             "dispatch_overhead_ms": round(overhead * 1e3, 1),
-            "flash_speedup_vs_einsum": round(t_einsum / t_flash, 3),
+            # Median of interleaved per-rep ratios (drift-robust), not
+            # min(einsum)/min(flash).
+            "flash_speedup_vs_einsum": round(einsum_over_flash, 3),
             "einsum_fwd_step_ms": round(t_einsum * 1e3, 3),
         }
         if peak is not None:
@@ -762,18 +799,16 @@ def bench_workload_mfu() -> dict | None:
         try:
             long_seq, long_batch = 4096, 4
             lbase = dict(base, max_seq=long_seq)
-            tl_flash = _measure_fwd_s(ModelConfig(**lbase, attn_impl="flash"),
-                                      long_batch, long_seq, steps=4,
-                                      overhead_s=overhead)
-            tl_einsum = _measure_fwd_s(ModelConfig(**lbase, attn_impl="einsum"),
-                                       long_batch, long_seq, steps=4,
-                                       overhead_s=overhead)
+            tl_flash, tl_einsum, l_einsum_over_flash = _measure_fwd_pair(
+                ModelConfig(**lbase, attn_impl="flash"),
+                ModelConfig(**lbase, attn_impl="einsum"),
+                long_batch, long_seq, steps=4, overhead_s=overhead)
             lflops = _fwd_flops(ModelConfig(**lbase), long_batch, long_seq)
             out["long_seq"] = {
                 "seq": long_seq, "tokens": long_batch * long_seq,
                 "fwd_step_ms": round(tl_flash * 1e3, 3),
                 "einsum_fwd_step_ms": round(tl_einsum * 1e3, 3),
-                "flash_speedup_vs_einsum": round(tl_einsum / tl_flash, 3),
+                "flash_speedup_vs_einsum": round(l_einsum_over_flash, 3),
             }
             if peak is not None:
                 out["long_seq"]["mfu"] = round(lflops / tl_flash / peak, 3)

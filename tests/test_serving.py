@@ -175,3 +175,72 @@ def test_state_invariants_empty():
     st = init_state(CFG, slots=3, max_len=8)
     assert not bool(np.asarray(st.active).any())
     assert np.asarray(st.seq_id).tolist() == [-1, -1, -1]
+
+
+def test_sharded_serving_matches_single_device():
+    """The engine on a dp x tp mesh (slots over dp, KV heads over tp)
+    must reproduce the single-device results — sharded continuous
+    batching is layout, not math."""
+    from tputopo.workloads import sharding as shardlib
+    from tputopo.workloads.sharding import build_mesh
+
+    params = _params()
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(0, 64, (n,)).tolist() for n in (3, 5, 2, 4)]
+    refs = [_one_shot(params, p, 4) for p in prompts]
+
+    plan = build_mesh({"dp": 4, "tp": 2})
+    sh_params = jax.device_put(params, shardlib.param_shardings(plan, CFG))
+    with shardlib.activate(plan):
+        eng = ServingEngine(sh_params, CFG, slots=4, max_len=12,
+                            prompt_pad=5)
+        ids = [eng.submit(p, max_new=4) for p in prompts]
+        results = eng.run()
+    for rid, ref in zip(ids, refs):
+        assert results[rid] == ref, rid
+
+
+def test_bucketed_prefill_parity_and_trace_count():
+    """Multi-bucket prefill: each admission pads to the smallest covering
+    bucket (one compiled prefill per bucket), outputs unchanged."""
+    from tputopo.workloads import serving
+
+    params = _params()
+    rng = np.random.default_rng(10)
+    prompts = [rng.integers(0, 64, (n,)).tolist() for n in (2, 3, 7, 8, 4, 2)]
+    admit_traces = serving.admit_jit._cache_size()
+    eng = ServingEngine(params, CFG, slots=2, max_len=20,
+                        prompt_pad=(4, 8))
+    ids = [eng.submit(p, max_new=4) for p in prompts]
+    results = eng.run()
+    for rid, p in zip(ids, prompts):
+        assert results[rid] == _one_shot(params, p, 4), (rid, len(p))
+    assert serving.admit_jit._cache_size() - admit_traces <= 2, \
+        "one compiled admit per bucket"
+    with pytest.raises(ValueError, match="prompt length"):
+        eng.submit([1] * 9, max_new=2)
+    with pytest.raises(ValueError, match="bad prompt_pad"):
+        ServingEngine(params, CFG, slots=1, max_len=8, prompt_pad=())
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_randomized_schedules_match_per_request_generate(seed):
+    """Property test: any mix of prompt lengths, budgets, slot counts,
+    tick chunking, and buckets must reproduce per-request generate
+    token-for-token (greedy).  Randomized but seeded — failures replay."""
+    rng = np.random.default_rng(100 + seed)
+    params = _params()
+    slots = int(rng.integers(1, 4))
+    steps_per_tick = int(rng.integers(1, 5))
+    buckets = (4, 8) if rng.integers(2) else 8
+    n_req = int(rng.integers(4, 9))
+    prompts = [rng.integers(0, 64, (int(rng.integers(1, 9)),)).tolist()
+               for _ in range(n_req)]
+    news = [int(rng.integers(1, 7)) for _ in range(n_req)]
+    eng = ServingEngine(params, CFG, slots=slots, max_len=16,
+                        prompt_pad=buckets, steps_per_tick=steps_per_tick)
+    ids = [eng.submit(p, max_new=m) for p, m in zip(prompts, news)]
+    results = eng.run()
+    for rid, p, m in zip(ids, prompts, news):
+        assert results[rid] == _one_shot(params, p, m), \
+            (seed, rid, len(p), m, slots, steps_per_tick, buckets)

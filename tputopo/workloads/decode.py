@@ -20,6 +20,12 @@ sampling with optional top-k truncation — the PRNG key threads through
 the decode `lax.scan` (`jax.random.fold_in` per step), so sampling stays
 one compiled program too.
 
+This module is the ONE-SHOT path (fixed batch, uniform prompts, run to
+completion) — the building block.  Production serving (ragged prompts,
+EOS early-exit, continuous batching over slots) lives in
+:mod:`tputopo.workloads.serving`, which reuses ``_block_step`` for its
+per-admission prefill.
+
 MoE semantics: decode routes ONE token per step, so the training layer's
 capacity truncation can never trigger — decode is exactly the drop-free
 top-k mixture (``moe_mlp_reference``).  That is the *correct* serving

@@ -300,18 +300,27 @@ class ServingEngine:
     decode state.  All device work happens in exactly two compiled
     programs (admit, decode_step); this class only moves bookkeeping.
 
-    ``prompt_pad`` is the static prefill bucket: prompts longer than it
-    are rejected (callers pick the bucket; one bucket == one compiled
-    prefill).  ``eos_id`` < 0 disables EOS (budget-only termination).
+    ``prompt_pad`` is the static prefill bucket — an int, or a tuple of
+    bucket lengths: each admission pads to the SMALLEST bucket covering
+    its prompt (one compiled prefill per bucket), so short prompts in a
+    long-prompt service don't pay the full-pad prefill.  Prompts longer
+    than the largest bucket are rejected.  ``eos_id`` < 0 disables EOS
+    (budget-only termination).
     """
 
     def __init__(self, params: dict, config: ModelConfig, *, slots: int,
-                 max_len: int, prompt_pad: int, eos_id: int = -1,
+                 max_len: int, prompt_pad: int | tuple[int, ...],
+                 eos_id: int = -1,
                  temperature: float = 0.0, top_k: int | None = None,
                  key: jax.Array | None = None,
                  steps_per_tick: int = 1) -> None:
-        if prompt_pad + 1 > max_len:
-            raise ValueError(f"prompt_pad {prompt_pad} + 1 exceeds max_len {max_len}")
+        buckets = ((prompt_pad,) if isinstance(prompt_pad, int)
+                   else tuple(sorted(set(prompt_pad))))
+        if not buckets or any(b < 1 for b in buckets):
+            raise ValueError(f"bad prompt_pad buckets {prompt_pad!r}")
+        if buckets[-1] + 1 > max_len:
+            raise ValueError(
+                f"prompt_pad {buckets[-1]} + 1 exceeds max_len {max_len}")
         if temperature > 0.0 and key is None:
             raise ValueError("sampling (temperature > 0) needs a PRNG key")
         if steps_per_tick < 1:
@@ -320,7 +329,8 @@ class ServingEngine:
         self.config = config
         self.slots = slots
         self.max_len = max_len
-        self.prompt_pad = prompt_pad
+        self.buckets = buckets
+        self.prompt_pad = buckets[-1]
         self.eos_id = eos_id
         self.temperature = temperature
         self.top_k = top_k
@@ -363,7 +373,10 @@ class ServingEngine:
             if not self._queue:
                 break
             rid, prompt, max_new = self._queue.pop(0)
-            padded = np.zeros((self.prompt_pad,), np.int32)
+            # Smallest bucket covering the prompt: one compiled prefill
+            # per bucket length, chosen per admission.
+            pad = next(b for b in self.buckets if b >= len(prompt))
+            padded = np.zeros((pad,), np.int32)
             padded[: len(prompt)] = prompt
             self.state = admit_jit(
                 self.params, self.state, self.config,
