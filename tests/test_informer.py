@@ -371,3 +371,103 @@ def test_watch_echo_of_observe_does_not_move_version_token():
         "metadata": {"name": "ghost", "namespace": "default",
                      "resourceVersion": "8"}}})
     assert inf.version() == v3
+
+
+def test_bind_write_through_failure_forces_authoritative_path():
+    """If a bind's mirror write-through fails, later binds must NOT plan
+    from the (now incomplete) mirror — they fall back to authoritative
+    API sync until the gap is repaired, so a double-book through the
+    stale mirror is impossible (code-review r4)."""
+    api = FakeApiServer()
+    build_cluster(api=api, spec="v5p:2x2x1", workers=1)
+    inf = Informer(api, watch_timeout_s=0.2).start()
+    assert inf.wait_synced(10)
+    inf.stop()  # freeze the watch: only write-through can update the mirror
+    sched = ExtenderScheduler(api, ExtenderConfig(), informer=inf)
+
+    api.create("pods", make_pod("a", chips=2))
+    api.create("pods", make_pod("b", chips=2))
+
+    # A real apiserver's binding subresource returns a Status, not the
+    # pod — force that shape so bind must read the pod back, and fail
+    # that read-back so the write-through cannot happen.
+    real_bind_pod = api.bind_pod
+    api.bind_pod = lambda *a, **kw: (real_bind_pod(*a, **kw),
+                                     {"kind": "Status",
+                                      "status": "Success"})[1]
+    real_get = api.get
+    calls = {"fail": True}
+
+    def flaky_get(kind, name, namespace=None):
+        if kind == "pods" and name == "a" and calls["fail"]:
+            # First get (bind entry) must work; fail only the read-back.
+            calls["n"] = calls.get("n", 0) + 1
+            if calls["n"] > 1:
+                calls["fail"] = False
+                raise RuntimeError("transient 5xx")
+        return real_get(kind, name, namespace)
+
+    api.get = flaky_get
+    da = sched.bind("a", "default", "node-0")
+    api.get = real_get
+    api.bind_pod = real_bind_pod
+    assert sched.metrics.counters.get("bind_observe_errors", 0) == 1
+    assert sched._unmirrored_binds, "failed write-through must be recorded"
+    # The mirror is stale (watch frozen, observe failed) — but bind b must
+    # still see a's chips as used, via the authoritative fallback.
+    db = sched.bind("b", "default", "node-0")
+    assert not (set(map(tuple, da["chips"])) & set(map(tuple, db["chips"]))), \
+        "bind planned from the stale mirror and double-booked"
+    # The repair leg ran during bind b and closed the gap.
+    assert not sched._unmirrored_binds
+    assert sched.metrics.counters.get("bind_write_through_repaired", 0) == 1
+
+
+def test_assume_ttl_expiry_visible_under_sustained_bind_traffic():
+    """Delta-published bind states must not postpone TTL-expiry judgment:
+    the derived state's age is judged from its last full sync, so an
+    unconfirmed assumption older than the TTL frees its chips within the
+    5 s staleness bound even when binds keep the delta path hot
+    (code-review r4)."""
+    class Clock:
+        def __init__(self, t): self.t = t
+        def __call__(self): return self.t
+
+    clock = Clock(1000.0)
+    api = FakeApiServer()
+    build_cluster(api=api, spec="v5p:2x2x4", workers=4, clock=clock)
+    inf = Informer(api, watch_timeout_s=0.5).start()
+    assert inf.wait_synced(10)
+    sched = ExtenderScheduler(api, ExtenderConfig(assume_ttl_s=60.0),
+                              informer=inf, clock=clock)
+    try:
+        # ghost binds but never confirms (no Allocate).
+        api.create("pods", make_pod("ghost", chips=4))
+        assert wait_until(lambda: inf.list("pods"))
+        sched.bind("ghost", "default", "node-0")
+        # Sustained bind traffic ON OTHER NODES: each tick advances the
+        # clock and delta-publishes a bind; eventually the ghost's
+        # assumption is past the TTL and node-0 (which the fillers never
+        # touch) must become placeable again.
+        for i in range(20):
+            clock.t += 6.0  # 120 s total, well past TTL + staleness bound
+            api.create("pods", make_pod(f"t-{i}", chips=2))
+            assert wait_until(
+                lambda: any(p["metadata"]["name"] == f"t-{i}"
+                            for p in inf.list("pods")))
+            scores = {s["Host"]: s["Score"]
+                      for s in sched.sort(api.get("pods", f"t-{i}", "default"),
+                                          [f"node-{n}" for n in range(1, 4)])}
+            best = max(scores, key=lambda h: (scores[h], h))
+            if scores[best] > 0:
+                sched.bind(f"t-{i}", "default", best)
+        pod = make_pod("reclaim", chips=4)
+        api.create("pods", pod)
+        assert wait_until(lambda: any(p["metadata"]["name"] == "reclaim"
+                                      for p in inf.list("pods")))
+        scores = {s["Host"]: s["Score"]
+                  for s in sched.sort(pod, [f"node-{n}" for n in range(4)])}
+        assert scores["node-0"] > 0, \
+            "expired assumption stayed occupying under sustained binds"
+    finally:
+        inf.stop()
