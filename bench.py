@@ -641,6 +641,8 @@ def bench_hbm_gbps() -> dict | None:
     elementwise op reads + writes HBM once each; achieved bytes/s over 2x
     the array size approximates stream bandwidth."""
     try:
+        from functools import partial
+
         import jax
         import jax.numpy as jnp
 
@@ -648,10 +650,18 @@ def bench_hbm_gbps() -> dict | None:
             return None
         n = 512 * 1024 * 1024 // 2  # 512 MB of bf16
         x = jnp.ones((n,), jnp.bfloat16)
-        steps = 8
+        # Step-count differencing (same method as bench_decode): time the
+        # scan at two step counts and take the slope.  Subtracting a
+        # separately-measured dispatch overhead is NOT robust here — on
+        # this tunnel the overhead is ~100x the per-step compute and
+        # varies by tens of ms between calls, which is exactly how
+        # BENCH_r04's first draft "measured" 215 GB/s on a chip that
+        # decode was observably streaming at 687 GB/s.  The slope cancels
+        # the constant overhead term exactly.
+        lo_steps, hi_steps = 8, 48
 
-        @jax.jit
-        def multi(x):
+        @partial(jax.jit, static_argnames="steps")
+        def multi(x, steps):
             # The full array is the loop carry: every step must read it and
             # write the next one — a reduction-only body would let XLA skip
             # the write, and an unused product would be dead code entirely.
@@ -660,14 +670,26 @@ def bench_hbm_gbps() -> dict | None:
             y, _ = jax.lax.scan(body, x, jnp.arange(steps))
             return y[0].astype(jnp.float32)
 
-        float(multi(x))
-        overhead = _measure_dispatch_overhead_s()
-        times = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            float(multi(x))
-            times.append(time.perf_counter() - t0)
-        t = max(min(times) - overhead, 1e-9) / steps
+        float(multi(x, lo_steps))
+        float(multi(x, hi_steps))
+
+        def timed(steps: int) -> float:
+            best = math.inf
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(multi(x, steps))
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        slope = timed(hi_steps) - timed(lo_steps)
+        if slope <= 0:
+            # Overhead noise swamped the 40-step signal (host badly
+            # loaded); a clamped slope would fabricate ~1e13 GB/s and
+            # poison decode's in-run ceiling — skip honestly instead.
+            print("bench: hbm skipped: non-positive differencing slope "
+                  f"({slope * 1e3:.1f} ms)", file=sys.stderr)
+            return None
+        t = slope / (hi_steps - lo_steps)
         measured = 2 * n * 2 / t / 1e9  # read + write, bf16 = 2 bytes
         from tputopo.topology.generations import get_generation
 
@@ -898,8 +920,17 @@ def bench_decode(measured_hbm_gbps: float | None = None) -> dict | None:
             # run (in-run control — absolute spec sheets are not the
             # comparison basis on this host).
             out["measured_hbm_gbps"] = round(measured_hbm_gbps, 1)
-            out["achieved_over_measured_ceiling"] = round(
-                (streamed / dt / 1e9) / measured_hbm_gbps, 3)
+            ratio = (streamed / dt / 1e9) / measured_hbm_gbps
+            out["achieved_over_measured_ceiling"] = round(ratio, 3)
+            if ratio > 1.0:
+                # Both numbers are independent differenced estimates taken
+                # minutes apart on a shared tunnel; a few percent over 1.0
+                # is cross-run noise, far over 1.0 would mean the HBM
+                # measurement under-read (the r04-draft failure mode).
+                out["ceiling_note"] = (
+                    "ratio > 1: decode's stream estimate exceeded the "
+                    "separately-measured HBM bandwidth within cross-run "
+                    "noise; treat min(the two) as the conservative floor")
         return out
     except Exception as e:  # pragma: no cover - context only
         print(f"bench: decode skipped: {type(e).__name__}: {e}",
