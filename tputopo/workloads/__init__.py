@@ -16,8 +16,9 @@ allocated GPUs).  The TPU-native analog here is twofold:
   parallel) attention in :mod:`tputopo.workloads.ring`, KV-cache decode
   in :mod:`tputopo.workloads.decode`, the continuous-batching serving
   engine (ragged prompts, EOS, slot reuse) in
-  :mod:`tputopo.workloads.serving`, and the conv-classifier second
-  model family (the Gaia Exp.6 MNIST analog) in
+  :mod:`tputopo.workloads.serving`, weight-only int8 serving
+  quantization in :mod:`tputopo.workloads.quant`, and the
+  conv-classifier second model family (the Gaia Exp.6 MNIST analog) in
   :mod:`tputopo.workloads.vision`.
 
 :mod:`tputopo.workloads.sharding` is the bridge between the scheduler and

@@ -109,6 +109,20 @@ def cmd_train(args) -> int:
     return 0 if losses[-1] < losses[0] or resumed_from else 1
 
 
+def _maybe_quantize(params, plan, int8: bool):
+    """Weight-only int8 for the serving CLIs: quantize ON device under the
+    mesh so GSPMD propagates the weight shardings onto the int8/scale pair
+    (no hand-written spec tree for the quantized layout)."""
+    if not int8:
+        return params
+    import jax
+
+    from tputopo.workloads.quant import quantize_params
+
+    with plan.mesh:
+        return jax.jit(quantize_params)(params)
+
+
 def cmd_decode(args) -> int:
     import time
 
@@ -133,6 +147,7 @@ def cmd_decode(args) -> int:
     batch = max(dp, args.batch // dp * dp)
     params = init_params(cfg, jax.random.key(0))
     params = jax.device_put(params, shardlib.param_shardings(plan, cfg))
+    params = _maybe_quantize(params, plan, args.int8)
     prompt = np.random.default_rng(0).integers(
         0, cfg.vocab_size, (batch, args.prompt_len))
     prompt = jax.device_put(jnp.asarray(prompt), plan.sharding("dp", None))
@@ -172,6 +187,7 @@ def cmd_serve(args) -> int:
     plan = mesh_for_slice((n,), heads=cfg.n_kv_heads)
     params = init_params(cfg, jax.random.key(0))
     params = jax.device_put(params, shardlib.param_shardings(plan, cfg))
+    params = _maybe_quantize(params, plan, args.int8)
     rng = np.random.default_rng(0)
     lens = rng.integers(max(1, args.prompt_len // 4), args.prompt_len + 1,
                         args.requests)
@@ -251,6 +267,9 @@ def main() -> int:
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt-len", type=int, default=64)
     p.add_argument("--max-new", type=int, default=64)
+    p.add_argument("--int8", action="store_true",
+                   help="weight-only int8 serving (halves streamed bytes; "
+                        "decode is HBM-bound)")
     p.set_defaults(fn=cmd_decode)
 
     p = sub.add_parser("serve", help="continuous-batching serving engine "
@@ -261,6 +280,8 @@ def main() -> int:
                    help="prefill bucket; prompts sample 1/4..1x of it")
     p.add_argument("--max-new", type=int, default=32)
     p.add_argument("--steps-per-tick", type=int, default=8)
+    p.add_argument("--int8", action="store_true",
+                   help="weight-only int8 serving (halves streamed bytes)")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("train-vision",

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 
 from tputopo.workloads.model import (ModelConfig, _apply_rope, _rmsnorm,
                                      _rope_tables, embed_tokens, lm_head)
+from tputopo.workloads.quant import qdot
 from tputopo.workloads.sharding import constrain
 
 
@@ -101,9 +102,9 @@ def _block_step(params: dict, config: ModelConfig, tokens: jax.Array,
         x = carry
         layer, ck_l, cv_l = inp
         h = _rmsnorm(x, layer["attn_norm"], c.norm_eps)
-        q = (h @ layer["wq"].astype(h.dtype)).reshape(B, T, c.n_heads, c.head_dim)
-        k = (h @ layer["wk"].astype(h.dtype)).reshape(B, T, c.n_kv_heads, c.head_dim)
-        v = (h @ layer["wv"].astype(h.dtype)).reshape(B, T, c.n_kv_heads, c.head_dim)
+        q = qdot(h, layer["wq"]).reshape(B, T, c.n_heads, c.head_dim)
+        k = qdot(h, layer["wk"]).reshape(B, T, c.n_kv_heads, c.head_dim)
+        v = qdot(h, layer["wv"]).reshape(B, T, c.n_kv_heads, c.head_dim)
         q = _apply_rope(q, cos_t, sin_t)
         k = _apply_rope(k, cos_t, sin_t)
         ck_l = jax.lax.dynamic_update_slice_in_dim(ck_l, k, start, axis=1)
@@ -111,7 +112,7 @@ def _block_step(params: dict, config: ModelConfig, tokens: jax.Array,
         q = constrain(q, "dp", None, "tp", None)
         out = _attend_cached(q, ck_l, cv_l, start, group)
         out = out.reshape(B, T, c.n_heads * c.head_dim)
-        x = x + out @ layer["wo"].astype(x.dtype)
+        x = x + qdot(out, layer["wo"])
         h2 = _rmsnorm(x, layer["mlp_norm"], c.norm_eps)
         if c.moe is not None:
             # Drop-free routing by construction (the documented serving
@@ -121,9 +122,9 @@ def _block_step(params: dict, config: ModelConfig, tokens: jax.Array,
 
             y = moe_mlp_reference(h2, layer["moe"], c)
         else:
-            gate = jax.nn.silu(h2 @ layer["w_gate"].astype(h2.dtype))
-            up = h2 @ layer["w_up"].astype(h2.dtype)
-            y = (gate * up) @ layer["w_down"].astype(h2.dtype)
+            gate = jax.nn.silu(qdot(h2, layer["w_gate"]))
+            up = qdot(h2, layer["w_up"])
+            y = qdot(gate * up, layer["w_down"])
         return x + y, (ck_l, cv_l)
 
     x, (ck, cv) = jax.lax.scan(layer_step, x,

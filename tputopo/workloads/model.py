@@ -30,6 +30,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from tputopo.workloads.quant import deq_rows, qdot
 from tputopo.workloads.sharding import constrain
 
 
@@ -153,9 +154,9 @@ def _attention(x: jax.Array, p: dict, config: ModelConfig,
                cos: jax.Array, sin: jax.Array) -> jax.Array:
     c = config
     B, S, D = x.shape
-    q = (x @ p["wq"].astype(x.dtype)).reshape(B, S, c.n_heads, c.head_dim)
-    k = (x @ p["wk"].astype(x.dtype)).reshape(B, S, c.n_kv_heads, c.head_dim)
-    v = (x @ p["wv"].astype(x.dtype)).reshape(B, S, c.n_kv_heads, c.head_dim)
+    q = qdot(x, p["wq"]).reshape(B, S, c.n_heads, c.head_dim)
+    k = qdot(x, p["wk"]).reshape(B, S, c.n_kv_heads, c.head_dim)
+    v = qdot(x, p["wv"]).reshape(B, S, c.n_kv_heads, c.head_dim)
     q = _apply_rope(q, cos, sin)
     k = _apply_rope(k, cos, sin)
     group = c.n_heads // c.n_kv_heads
@@ -181,7 +182,7 @@ def _attention(x: jax.Array, p: dict, config: ModelConfig,
         out = ring_attention(q, k, v, ring_plan, causal=True,
                              kv_group=kv_group)
         out = out.reshape(B, S, c.n_heads * c.head_dim)
-        return out @ p["wo"].astype(x.dtype)
+        return qdot(out, p["wo"])
 
     # Expand KV groups to full head count BEFORE the TP constraint: KV heads
     # may be fewer than the tp degree, and sharding the narrow tensor forces
@@ -206,7 +207,7 @@ def _attention(x: jax.Array, p: dict, config: ModelConfig,
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
         out = jnp.einsum("bnqk,bknh->bqnh", probs, v)
     out = out.reshape(B, S, c.n_heads * c.head_dim)
-    return out @ p["wo"].astype(x.dtype)
+    return qdot(out, p["wo"])
 
 
 def _ring_plan(c: ModelConfig, qshape: tuple[int, ...]):
@@ -279,10 +280,10 @@ def _flash_dispatch(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
 
 
 def _mlp(x: jax.Array, p: dict) -> jax.Array:
-    gate = jax.nn.silu(x @ p["w_gate"].astype(x.dtype))
-    up = x @ p["w_up"].astype(x.dtype)
+    gate = jax.nn.silu(qdot(x, p["w_gate"]))
+    up = qdot(x, p["w_up"])
     h = constrain(gate * up, "dp", None, "tp")
-    return h @ p["w_down"].astype(x.dtype)
+    return qdot(h, p["w_down"])
 
 
 def transformer_block(x: jax.Array, layer: dict, config: ModelConfig,
@@ -339,13 +340,13 @@ def _block_scan(x: jax.Array, layers: dict, config: ModelConfig,
 
 
 def embed_tokens(params: dict, tokens: jax.Array, config: ModelConfig) -> jax.Array:
-    x = params["embed"].astype(config.compute_dtype)[tokens]
+    x = deq_rows(params["embed"], tokens, config.compute_dtype)
     return constrain(x, "dp", "sp", None)
 
 
 def lm_head(params: dict, x: jax.Array, config: ModelConfig) -> jax.Array:
     x = _rmsnorm(x, params["final_norm"], config.norm_eps)
-    logits = x.astype(jnp.float32) @ params["lm_head"]
+    logits = qdot(x.astype(jnp.float32), params["lm_head"])
     return constrain(logits, "dp", "sp", None)
 
 

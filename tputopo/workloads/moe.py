@@ -35,6 +35,7 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
+from tputopo.workloads.quant import deq, qdot
 from tputopo.workloads.sharding import constrain
 
 
@@ -126,9 +127,12 @@ def moe_mlp(x: jax.Array, p: dict, cfg) -> tuple[jax.Array, jax.Array]:
     xe = jnp.einsum("btkec,btd->ebcd", disp, x)
     xe = constrain(xe, "ep", "dp", None, None)
 
-    wg = p["w_gate"].astype(dt)
-    wu = p["w_up"].astype(dt)
-    wd = p["w_down"].astype(dt)
+    # deq (not qdot): the dispatch einsums contract over d with an expert
+    # batch axis; this is the training path, which keeps f32 masters —
+    # quantized weights only reach it through parity tests.
+    wg = deq(p["w_gate"], dt)
+    wu = deq(p["w_up"], dt)
+    wd = deq(p["w_down"], dt)
     h = jax.nn.silu(jnp.einsum("ebcd,edf->ebcf", xe, wg))
     h = h * jnp.einsum("ebcd,edf->ebcf", xe, wu)
     h = constrain(h, "ep", "dp", None, "tp")
@@ -164,13 +168,12 @@ def moe_mlp_reference(x: jax.Array, p: dict, cfg) -> jax.Array:
 
     def expert_step(acc, inp):
         wg, wu, wd, we = inp  # [D,F], [D,F], [F,D], [B,T,1]
-        # Upcast ONE expert's tables inside the step: upcasting the whole
-        # [E, ...] stacks outside the scan would materialize a full f32
-        # copy of every expert at once — the bounded-memory point of the
-        # scan form.
-        h = (jax.nn.silu(x32 @ wg.astype(jnp.float32))
-             * (x32 @ wu.astype(jnp.float32)))
-        return acc + we * (h @ wd.astype(jnp.float32)), None
+        # qdot upcasts ONE expert's tables inside the step (or streams
+        # them int8 when serving-quantized): upcasting the whole [E, ...]
+        # stacks outside the scan would materialize a full f32 copy of
+        # every expert at once — the bounded-memory point of the scan form.
+        h = jax.nn.silu(qdot(x32, wg)) * qdot(x32, wu)
+        return acc + we * qdot(h, wd), None
 
     out, _ = jax.lax.scan(
         expert_step, jnp.zeros_like(x32),

@@ -1,0 +1,144 @@
+"""Weight-only int8 quantization for the serving path.
+
+Decode is HBM-bound: BENCH_r04 measures the bf16 decode loop at ~99% of
+the chip's measured HBM stream bandwidth, so the only remaining lever on
+tokens/s is streaming fewer bytes.  Weight-only int8 (symmetric,
+per-output-channel) halves the streamed weight bytes for a near-lossless
+accuracy cost — the standard serving trade, expressed TPU-first:
+
+- A quantized weight is the pair ``{"int8": q, "scale": s}`` where ``q``
+  is int8 and ``s`` is float32 with a kept (size-1) reduction axis, so
+  every leaf still scans over the leading layer axis exactly like its
+  unquantized twin — the decode/prefill `lax.scan` machinery is unchanged.
+- Matmul sites use :func:`qdot`, which computes ``(x @ q) * s`` — the
+  per-output-channel scale commutes with the contraction over the input
+  axis, so the MXU dot reads the int8 tensor directly (XLA fuses the
+  int8->bf16 convert into the dot operand) and the scale lands as one
+  cheap output-row multiply.  Dequantize-then-dot would materialize a
+  bf16 copy of the weight and stream HBM at the unquantized rate.
+- Gather sites (the embedding) use :func:`deq_rows`: rows are quantized
+  per-row so the gather fetches int8 rows + one scale each.
+
+Scope: **inference only** (decode / serving / forward for parity checks).
+Training keeps float32 masters — quantization is a deployment step, not
+an optimizer state format.  The reference has no serving leg at all (it
+schedules training containers, Gaia PDF §IV Exp.6); this module is part
+of the workload layer (SURVEY §1 L5) that placement serves.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: Weight names quantized in the stacked-layer tree (dense + MoE FFN).
+#: Router and norm weights stay float32: they are O(D) or O(E) — streaming
+#: them quantized saves nothing and the router's softmax is scale-sensitive.
+_LAYER_WEIGHTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def is_quantized(w) -> bool:
+    """True for a ``{"int8": ..., "scale": ...}`` quantized-leaf dict."""
+    return isinstance(w, dict) and "int8" in w
+
+
+def _quantize_leaf(w: jax.Array, axis: int) -> dict:
+    """Symmetric absmax int8 over ``axis`` (kept), scale in float32.
+
+    Zero channels get scale 1/127 so q is exactly 0 and dequant exact.
+    """
+    amax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    scale = jnp.where(amax > 0, amax, 1.0) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return {"int8": q, "scale": scale.astype(jnp.float32)}
+
+
+def quantize_params(params: dict) -> dict:
+    """Quantize an LM parameter tree (init_params layout) for serving.
+
+    Dense/MoE matmul weights ``[.., in, out]`` quantize per output channel
+    (absmax over the contraction axis, ``axis=-2``); the embedding
+    quantizes per row (``axis=-1``) because it is gathered, not
+    contracted.  Norm weights and the MoE router stay float32.
+    """
+    layers = dict(params["layers"])
+    for name in _LAYER_WEIGHTS:
+        if name in layers:
+            layers[name] = _quantize_leaf(layers[name], axis=-2)
+    if "moe" in layers:
+        moe = dict(layers["moe"])
+        for name in ("w_gate", "w_up", "w_down"):
+            moe[name] = _quantize_leaf(moe[name], axis=-2)
+        layers["moe"] = moe
+    out = dict(params)
+    out["layers"] = layers
+    out["embed"] = _quantize_leaf(params["embed"], axis=-1)
+    out["lm_head"] = _quantize_leaf(params["lm_head"], axis=-2)
+    return out
+
+
+def qdot(x: jax.Array, w) -> jax.Array:
+    """``x @ w`` for a raw or quantized weight.
+
+    Quantized: ``(x @ q) * s`` — scale applied after the contraction, so
+    the dot's HBM read is the int8 tensor.  ``w`` may carry leading batch
+    axes (a scan slice or a stacked expert table); the scale's kept
+    ``in`` axis is squeezed to broadcast over the dot output.
+    """
+    if is_quantized(w):
+        s = jnp.squeeze(w["scale"], axis=-2).astype(x.dtype)
+        return (x @ w["int8"].astype(x.dtype)) * s
+    return x @ w.astype(x.dtype)
+
+
+def deq(w, dtype) -> jax.Array:
+    """Materialize a weight at ``dtype`` (for einsum sites that contract
+    over a non-standard axis — e.g. the MoE capacity dispatch)."""
+    if is_quantized(w):
+        return w["int8"].astype(dtype) * w["scale"].astype(dtype)
+    return w.astype(dtype)
+
+
+def deq_rows(w, idx: jax.Array, dtype) -> jax.Array:
+    """Row-gather (embedding lookup) for a raw or row-quantized table."""
+    if is_quantized(w):
+        return w["int8"][idx].astype(dtype) * w["scale"][idx].astype(dtype)
+    return w.astype(dtype)[idx]
+
+
+def streamed_bytes(params: dict) -> int:
+    """Bytes a decode step streams from HBM for this parameter tree.
+
+    Every weight except the embedding (gathered, O(B) rows) is read once
+    per step: quantized leaves stream int8 + their f32 scales; raw matmul
+    weights stream at bf16 (the cast XLA hoists out of the decode scan);
+    the raw lm_head streams f32 (model.lm_head never casts it); norms are
+    f32.  Mirrors the accounting bench_decode uses for the ceiling.
+    """
+    def leaf_bytes(name: str, v, in_moe: bool) -> int:
+        if is_quantized(v):
+            return v["int8"].size + v["scale"].size * 4
+        # Raw dense matmul weights stream as their bf16 casts (qdot's
+        # astype of the bf16 activations, which XLA hoists out of the
+        # decode scan).  Everything else is consumed at f32: norms, the
+        # raw lm_head, the MoE router — AND raw MoE expert tables, because
+        # the drop-free decode mixture contracts them against f32
+        # activations (moe_mlp_reference's x32), so their astype is a
+        # no-op on the f32 masters.
+        dense_bf16 = name in _LAYER_WEIGHTS and not in_moe
+        return v.size * (2 if dense_bf16 else 4)
+
+    total = 0
+
+    def walk(tree: dict, in_moe: bool = False):
+        nonlocal total
+        for k, v in tree.items():
+            if isinstance(v, dict) and not is_quantized(v):
+                walk(v, in_moe=in_moe or k == "moe")
+            else:
+                total += leaf_bytes(k, v, in_moe)
+
+    walk(params["layers"])
+    total += leaf_bytes("final_norm", params["final_norm"], False)
+    total += leaf_bytes("lm_head", params["lm_head"], False)
+    return total

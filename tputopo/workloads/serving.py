@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tputopo.workloads.decode import KVCache, _block_step, _select
+from tputopo.workloads.quant import qdot
 from tputopo.workloads.model import (ModelConfig, _rmsnorm, _rope_tables,
                                      embed_tokens, lm_head)
 from tputopo.workloads.sharding import constrain
@@ -215,9 +216,9 @@ def decode_step(params: dict, state: DecodeState, config: ModelConfig,
         x = carry
         layer, ck_l, cv_l = inp
         h = _rmsnorm(x, layer["attn_norm"], c.norm_eps)
-        q = (h @ layer["wq"].astype(h.dtype)).reshape(B, 1, c.n_heads, c.head_dim)
-        k = (h @ layer["wk"].astype(h.dtype)).reshape(B, 1, c.n_kv_heads, c.head_dim)
-        v = (h @ layer["wv"].astype(h.dtype)).reshape(B, 1, c.n_kv_heads, c.head_dim)
+        q = qdot(h, layer["wq"]).reshape(B, 1, c.n_heads, c.head_dim)
+        k = qdot(h, layer["wk"]).reshape(B, 1, c.n_kv_heads, c.head_dim)
+        v = qdot(h, layer["wv"]).reshape(B, 1, c.n_kv_heads, c.head_dim)
         q = _apply_rope_at(q, cos_b, sin_b)
         k = _apply_rope_at(k, cos_b, sin_b)
         ck_l = _write_kv_at(ck_l, k, pos)
@@ -225,16 +226,16 @@ def decode_step(params: dict, state: DecodeState, config: ModelConfig,
         q = constrain(q, "dp", None, "tp", None)
         out = _attend_ragged(q, ck_l, cv_l, pos, group)
         out = out.reshape(B, 1, c.n_heads * c.head_dim)
-        x = x + out @ layer["wo"].astype(x.dtype)
+        x = x + qdot(out, layer["wo"])
         h2 = _rmsnorm(x, layer["mlp_norm"], c.norm_eps)
         if c.moe is not None:
             from tputopo.workloads.moe import moe_mlp_reference
 
             y = moe_mlp_reference(h2, layer["moe"], c)
         else:
-            gate = jax.nn.silu(h2 @ layer["w_gate"].astype(h2.dtype))
-            up = h2 @ layer["w_up"].astype(h2.dtype)
-            y = (gate * up) @ layer["w_down"].astype(h2.dtype)
+            gate = jax.nn.silu(qdot(h2, layer["w_gate"]))
+            up = qdot(h2, layer["w_up"])
+            y = qdot(gate * up, layer["w_down"])
         return x + y, (ck_l, cv_l)
 
     x, (ck, cv) = jax.lax.scan(layer_step, x,
