@@ -137,7 +137,8 @@ def cmd_decode(args) -> int:
 
     cfg = ModelConfig(vocab_size=2048, d_model=256, n_layers=4, n_heads=8,
                       n_kv_heads=4, d_ff=512,
-                      max_seq=args.prompt_len + args.max_new)
+                      max_seq=args.prompt_len + args.max_new,
+                      kv_dtype="int8" if args.int8 else "bf16")
     # Serving mesh: batch over dp, KV heads over tp (the cache's tp axis),
     # mirroring cmd_train — a multi-chip serving pod actually shards the
     # cache and weights (ADVICE r2; on one chip everything is a no-op).
@@ -182,7 +183,8 @@ def cmd_serve(args) -> int:
 
     cfg = ModelConfig(vocab_size=2048, d_model=256, n_layers=4, n_heads=8,
                       n_kv_heads=4, d_ff=512,
-                      max_seq=args.prompt_len + args.max_new)
+                      max_seq=args.prompt_len + args.max_new,
+                      kv_dtype="int8" if args.int8 else "bf16")
     n = jax.device_count()
     plan = mesh_for_slice((n,), heads=cfg.n_kv_heads)
     params = init_params(cfg, jax.random.key(0))
@@ -268,8 +270,8 @@ def main() -> int:
     p.add_argument("--prompt-len", type=int, default=64)
     p.add_argument("--max-new", type=int, default=64)
     p.add_argument("--int8", action="store_true",
-                   help="weight-only int8 serving (halves streamed bytes; "
-                        "decode is HBM-bound)")
+                   help="full int8 serving stack: weight-only int8 + int8 "
+                        "KV cache (decode is HBM-bound; bytes are the lever)")
     p.set_defaults(fn=cmd_decode)
 
     p = sub.add_parser("serve", help="continuous-batching serving engine "
@@ -281,7 +283,7 @@ def main() -> int:
     p.add_argument("--max-new", type=int, default=32)
     p.add_argument("--steps-per-tick", type=int, default=8)
     p.add_argument("--int8", action="store_true",
-                   help="weight-only int8 serving (halves streamed bytes)")
+                   help="full int8 serving stack: weights + KV cache")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("train-vision",

@@ -44,23 +44,48 @@ import jax.numpy as jnp
 
 from tputopo.workloads.model import (ModelConfig, _apply_rope, _rmsnorm,
                                      _rope_tables, embed_tokens, lm_head)
-from tputopo.workloads.quant import qdot
+from tputopo.workloads.quant import fold_kv_scale, qdot, quantize_kv
 from tputopo.workloads.sharding import constrain
 
 
 class KVCache(NamedTuple):
-    k: jax.Array  # [L, B, S_max, KV, H]
+    k: jax.Array  # [L, B, S_max, KV, H]  compute_dtype, or int8
     v: jax.Array  # [L, B, S_max, KV, H]
+    # int8 cache only (kv_dtype="int8"): per-(batch, position, kv-head)
+    # absmax scales, [L, B, S_max, KV, 1] f32.  None for bf16 caches —
+    # None is an empty pytree, so scan/jit structures stay consistent
+    # per config (a static property).
+    k_scale: "jax.Array | None" = None
+    v_scale: "jax.Array | None" = None
 
     @staticmethod
     def create(config: ModelConfig, batch: int, max_len: int) -> "KVCache":
         c = config
         shape = (c.n_layers, batch, max_len, c.n_kv_heads, c.head_dim)
+        if c.kv_dtype == "int8":
+            sshape = shape[:-1] + (1,)
+            return KVCache(k=jnp.zeros(shape, jnp.int8),
+                           v=jnp.zeros(shape, jnp.int8),
+                           k_scale=jnp.zeros(sshape, jnp.float32),
+                           v_scale=jnp.zeros(sshape, jnp.float32))
+        if c.kv_dtype != "bf16":
+            raise ValueError(f"unknown kv_dtype {c.kv_dtype!r}")
         return KVCache(k=jnp.zeros(shape, c.compute_dtype),
                        v=jnp.zeros(shape, c.compute_dtype))
 
 
-def _attend_cached(q, ck, cv, start, group: int):
+def _store_kv(buf: jax.Array, sbuf, kv: jax.Array, start) -> tuple:
+    """Write freshly-computed K or V rows [B, T, KV, H] into a cache
+    leaf at position ``start``, quantizing when the cache is int8
+    (``sbuf`` is its scale buffer, None for bf16)."""
+    if sbuf is None:
+        return jax.lax.dynamic_update_slice_in_dim(buf, kv, start, axis=1), None
+    q, s = quantize_kv(kv)
+    return (jax.lax.dynamic_update_slice_in_dim(buf, q, start, axis=1),
+            jax.lax.dynamic_update_slice_in_dim(sbuf, s, start, axis=1))
+
+
+def _attend_cached(q, ck, cv, start, group: int, ck_s=None, cv_s=None):
     """q [B, T, N, H] (query positions start..start+T-1) against cache
     [B, S_max, KV, H]; cache positions beyond each query's own are masked
     (causal).  Returns [B, T, N, H].
@@ -68,7 +93,12 @@ def _attend_cached(q, ck, cv, start, group: int):
     GQA stays grouped: q reshapes to [B, T, KV, group, H] and the einsums
     read the cache at its native KV width — expanding the cache with
     repeat would copy the entire [B, S_max, N, H] buffer per layer per
-    step, multiplying the hot loop's HBM traffic by ``group``."""
+    step, multiplying the hot loop's HBM traffic by ``group``.
+
+    int8 cache (``ck_s``/``cv_s`` scale buffers present): the per-key-
+    position scale multiplies the logits after the q·k contraction, and
+    the per-value-position scale folds into the probabilities before p·v
+    — both exact, so the einsums read the cache at int8."""
     B, T, N, H = q.shape
     KV = ck.shape[2]
     scale = 1.0 / (H ** 0.5)
@@ -76,10 +106,14 @@ def _attend_cached(q, ck, cv, start, group: int):
     # training path uses) == reshape [KV, group] order.
     qg = q.astype(jnp.float32).reshape(B, T, KV, group, H) * scale
     s = jnp.einsum("btkgh,bskh->bkgts", qg, ck.astype(jnp.float32))
+    if ck_s is not None:
+        s = s * fold_kv_scale(ck_s)
     k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 4)
     q_pos = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
     s = jnp.where(k_pos <= q_pos, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
+    if cv_s is not None:
+        p = p * fold_kv_scale(cv_s)
     out = jnp.einsum("bkgts,bskh->btkgh", p, cv.astype(jnp.float32))
     return out.reshape(B, T, N, H).astype(q.dtype)
 
@@ -100,17 +134,17 @@ def _block_step(params: dict, config: ModelConfig, tokens: jax.Array,
 
     def layer_step(carry, inp):
         x = carry
-        layer, ck_l, cv_l = inp
+        layer, ck_l, cv_l, cks_l, cvs_l = inp
         h = _rmsnorm(x, layer["attn_norm"], c.norm_eps)
         q = qdot(h, layer["wq"]).reshape(B, T, c.n_heads, c.head_dim)
         k = qdot(h, layer["wk"]).reshape(B, T, c.n_kv_heads, c.head_dim)
         v = qdot(h, layer["wv"]).reshape(B, T, c.n_kv_heads, c.head_dim)
         q = _apply_rope(q, cos_t, sin_t)
         k = _apply_rope(k, cos_t, sin_t)
-        ck_l = jax.lax.dynamic_update_slice_in_dim(ck_l, k, start, axis=1)
-        cv_l = jax.lax.dynamic_update_slice_in_dim(cv_l, v, start, axis=1)
+        ck_l, cks_l = _store_kv(ck_l, cks_l, k, start)
+        cv_l, cvs_l = _store_kv(cv_l, cvs_l, v, start)
         q = constrain(q, "dp", None, "tp", None)
-        out = _attend_cached(q, ck_l, cv_l, start, group)
+        out = _attend_cached(q, ck_l, cv_l, start, group, cks_l, cvs_l)
         out = out.reshape(B, T, c.n_heads * c.head_dim)
         x = x + qdot(out, layer["wo"])
         h2 = _rmsnorm(x, layer["mlp_norm"], c.norm_eps)
@@ -125,12 +159,21 @@ def _block_step(params: dict, config: ModelConfig, tokens: jax.Array,
             gate = jax.nn.silu(qdot(h2, layer["w_gate"]))
             up = qdot(h2, layer["w_up"])
             y = qdot(gate * up, layer["w_down"])
-        return x + y, (ck_l, cv_l)
+        return x + y, (ck_l, cv_l, cks_l, cvs_l)
 
-    x, (ck, cv) = jax.lax.scan(layer_step, x,
-                               (params["layers"], cache.k, cache.v))
+    x, (ck, cv, cks, cvs) = jax.lax.scan(
+        layer_step, x,
+        (params["layers"], cache.k, cache.v, cache.k_scale, cache.v_scale))
     logits = lm_head(params, x, c)  # shared final-norm + head math
-    return logits, KVCache(k=ck, v=cv)
+    return logits, KVCache(k=ck, v=cv, k_scale=cks, v_scale=cvs)
+
+
+def _constrain_cache(cache: KVCache) -> KVCache:
+    """Serving-mesh layout for every cache leaf: batch over dp, KV heads
+    over tp (scale buffers carry the same leading axes as their cache)."""
+    spec = (None, "dp", None, "tp", None)
+    return KVCache(*(None if b is None else constrain(b, *spec)
+                     for b in cache))
 
 
 def _select(logits: jax.Array, temperature: float, top_k: int | None,
@@ -175,10 +218,8 @@ def generate(params: dict, prompt: jax.Array, config: ModelConfig, *,
     # Multi-chip serving: batch over dp, KV heads over tp — under an
     # active plan the cache shards like the activations it stores (and
     # the per-layer attention stays local per (dp, tp) shard); on one
-    # chip these are no-ops.
-    cache = KVCache(
-        k=constrain(cache.k, None, "dp", None, "tp", None),
-        v=constrain(cache.v, None, "dp", None, "tp", None))
+    # chip these are no-ops.  int8 scale buffers shard like their cache.
+    cache = _constrain_cache(cache)
 
     logits, cache = _block_step(params, c, prompt, 0, cache, cos, sin)
     first = _select(logits[:, -1], temperature, top_k, key, 0, prompt.dtype)

@@ -67,6 +67,12 @@ class ModelConfig:
     # expert-parallel MoE block (tputopo.workloads.moe) routed top-k with
     # a capacity limit; None keeps the dense SwiGLU MLP.
     moe: "object | None" = None
+    # KV-cache element type for decode/serving: "bf16" (compute_dtype) or
+    # "int8" (per-position absmax scales, folded exactly into the
+    # attention einsums — quant.quantize_kv).  At long context the cache
+    # read dominates decode's HBM traffic; int8 halves it.  Training and
+    # prefill math are unaffected (they hold no cache).
+    kv_dtype: str = "bf16"
 
     @property
     def head_dim(self) -> int:

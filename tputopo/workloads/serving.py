@@ -48,7 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tputopo.workloads.decode import KVCache, _block_step, _select
-from tputopo.workloads.quant import qdot
+from tputopo.workloads.quant import fold_kv_scale, qdot, quantize_kv
 from tputopo.workloads.model import (ModelConfig, _rmsnorm, _rope_tables,
                                      embed_tokens, lm_head)
 from tputopo.workloads.sharding import constrain
@@ -72,10 +72,9 @@ class DecodeState(NamedTuple):
 
 
 def init_state(config: ModelConfig, slots: int, max_len: int) -> DecodeState:
-    cache = KVCache.create(config, slots, max_len)
-    cache = KVCache(
-        k=constrain(cache.k, None, "dp", None, "tp", None),
-        v=constrain(cache.v, None, "dp", None, "tp", None))
+    from tputopo.workloads.decode import _constrain_cache
+
+    cache = _constrain_cache(KVCache.create(config, slots, max_len))
     return DecodeState(
         cache=cache,
         tokens=jnp.zeros((slots, max_len), jnp.int32),
@@ -107,15 +106,18 @@ def admit(params: dict, state: DecodeState, config: ModelConfig,
 
     # The slot's cache slice, as a batch-1 cache the block prefill
     # understands; positions >= pad keep stale junk that per-slot length
-    # masks make unreachable.
-    ck = jax.lax.dynamic_slice_in_dim(state.cache.k, slot, 1, axis=1)
-    cv = jax.lax.dynamic_slice_in_dim(state.cache.v, slot, 1, axis=1)
+    # masks make unreachable.  Every leaf (incl. int8 scale buffers)
+    # shares the [L, slots, ...] layout, so one slice/update rule covers
+    # both cache formats.
+    slot_cache = KVCache(*(
+        None if b is None else jax.lax.dynamic_slice_in_dim(b, slot, 1, axis=1)
+        for b in state.cache))
     logits, filled = _block_step(params, c, prompt[None, :], 0,
-                                 KVCache(k=ck, v=cv), cos, sin)
-    new_k = jax.lax.dynamic_update_slice_in_dim(
-        state.cache.k, filled.k, slot, axis=1)
-    new_v = jax.lax.dynamic_update_slice_in_dim(
-        state.cache.v, filled.v, slot, axis=1)
+                                 slot_cache, cos, sin)
+    new_cache = KVCache(*(
+        None if b is None else jax.lax.dynamic_update_slice_in_dim(
+            whole, b, slot, axis=1)
+        for whole, b in zip(state.cache, filled)))
 
     last = jax.lax.dynamic_index_in_dim(logits[0], prompt_len - 1, axis=0,
                                         keepdims=False)
@@ -132,7 +134,7 @@ def admit(params: dict, state: DecodeState, config: ModelConfig,
 
     length = prompt_len + 1
     return DecodeState(
-        cache=KVCache(k=new_k, v=new_v),
+        cache=new_cache,
         tokens=jax.lax.dynamic_update_slice_in_dim(
             state.tokens, row[None, :], slot, axis=0),
         length=state.length.at[slot].set(length),
@@ -173,18 +175,25 @@ def _write_kv_at(cache_l: jax.Array, kv: jax.Array, pos: jax.Array) -> jax.Array
 
 
 def _attend_ragged(q: jax.Array, ck: jax.Array, cv: jax.Array,
-                   pos: jax.Array, group: int) -> jax.Array:
+                   pos: jax.Array, group: int,
+                   ck_s=None, cv_s=None) -> jax.Array:
     """One query per slot at its own position: q [B, 1, N, H] against the
     cache [B, S, KV, H]; slot b attends cache positions <= pos[b].  Same
-    grouped-GQA einsums as decode._attend_cached."""
+    grouped-GQA einsums as decode._attend_cached, including the exact
+    int8-cache scale folds (per key position into the logits, per value
+    position into the probabilities)."""
     B, T, N, H = q.shape
     KV = ck.shape[2]
     scale = 1.0 / (H ** 0.5)
     qg = q.astype(jnp.float32).reshape(B, T, KV, group, H) * scale
     s = jnp.einsum("btkgh,bskh->bkgts", qg, ck.astype(jnp.float32))
+    if ck_s is not None:
+        s = s * fold_kv_scale(ck_s)
     k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 4)
     s = jnp.where(k_pos <= pos[:, None, None, None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
+    if cv_s is not None:
+        p = p * fold_kv_scale(cv_s)
     out = jnp.einsum("bkgts,bskh->btkgh", p, cv.astype(jnp.float32))
     return out.reshape(B, T, N, H).astype(q.dtype)
 
@@ -214,17 +223,22 @@ def decode_step(params: dict, state: DecodeState, config: ModelConfig,
 
     def layer_step(carry, inp):
         x = carry
-        layer, ck_l, cv_l = inp
+        layer, ck_l, cv_l, cks_l, cvs_l = inp
         h = _rmsnorm(x, layer["attn_norm"], c.norm_eps)
         q = qdot(h, layer["wq"]).reshape(B, 1, c.n_heads, c.head_dim)
         k = qdot(h, layer["wk"]).reshape(B, 1, c.n_kv_heads, c.head_dim)
         v = qdot(h, layer["wv"]).reshape(B, 1, c.n_kv_heads, c.head_dim)
         q = _apply_rope_at(q, cos_b, sin_b)
         k = _apply_rope_at(k, cos_b, sin_b)
+        if cks_l is not None:
+            k, ks = quantize_kv(k)
+            v, vs = quantize_kv(v)
+            cks_l = _write_kv_at(cks_l, ks, pos)
+            cvs_l = _write_kv_at(cvs_l, vs, pos)
         ck_l = _write_kv_at(ck_l, k, pos)
         cv_l = _write_kv_at(cv_l, v, pos)
         q = constrain(q, "dp", None, "tp", None)
-        out = _attend_ragged(q, ck_l, cv_l, pos, group)
+        out = _attend_ragged(q, ck_l, cv_l, pos, group, cks_l, cvs_l)
         out = out.reshape(B, 1, c.n_heads * c.head_dim)
         x = x + qdot(out, layer["wo"])
         h2 = _rmsnorm(x, layer["mlp_norm"], c.norm_eps)
@@ -236,10 +250,12 @@ def decode_step(params: dict, state: DecodeState, config: ModelConfig,
             gate = jax.nn.silu(qdot(h2, layer["w_gate"]))
             up = qdot(h2, layer["w_up"])
             y = qdot(gate * up, layer["w_down"])
-        return x + y, (ck_l, cv_l)
+        return x + y, (ck_l, cv_l, cks_l, cvs_l)
 
-    x, (ck, cv) = jax.lax.scan(layer_step, x,
-                               (params["layers"], state.cache.k, state.cache.v))
+    x, (ck, cv, cks, cvs) = jax.lax.scan(
+        layer_step, x,
+        (params["layers"], state.cache.k, state.cache.v,
+         state.cache.k_scale, state.cache.v_scale))
     logits = lm_head(params, x, c)[:, 0]  # [B, V]
     nxt = _select(logits, temperature, top_k, key, state.step, jnp.int32)
 
@@ -255,7 +271,7 @@ def decode_step(params: dict, state: DecodeState, config: ModelConfig,
     finished = active & ((nxt == eos_id) | (generated >= state.budget)
                          | (new_length >= max_len))
     return DecodeState(
-        cache=KVCache(k=ck, v=cv),
+        cache=KVCache(k=ck, v=cv, k_scale=cks, v_scale=cvs),
         tokens=new_tokens,
         length=new_length,
         prompt_len=state.prompt_len,
