@@ -379,6 +379,14 @@ def _flash_vjp(q, k, v, causal, block_q, block_kv, interpret):
 def _flash_vjp_fwd(q, k, v, causal, block_q, block_kv, interpret):
     out, lse = _flash_forward_lse(q, k, v, causal=causal, block_q=block_q,
                                   block_kv=block_kv, interpret=interpret)
+    # Named so a remat policy can SAVE the kernel's residuals: pallas_call
+    # is not a dot, so under dots_saveable alone the whole flash forward
+    # re-runs inside the backward just to regenerate (out, lse) — the
+    # "dots" policy in model.apply_remat saves these names to skip that.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (q, k, v, out, lse)
 
 

@@ -114,10 +114,8 @@ def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     exactly into the attention einsums (per key position into the logits,
     per value position into the probabilities), so the cache is read at
     int8 with no dequantized copy."""
-    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
-    scale = jnp.where(amax > 0, amax, 1.0) / 127.0
-    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
-    return q, scale.astype(jnp.float32)
+    d = _quantize_leaf(x, axis=-1)
+    return d["int8"], d["scale"]
 
 
 def fold_kv_scale(s: jax.Array) -> jax.Array:
