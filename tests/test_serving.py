@@ -233,14 +233,100 @@ def test_randomized_schedules_match_per_request_generate(seed):
     slots = int(rng.integers(1, 4))
     steps_per_tick = int(rng.integers(1, 5))
     buckets = (4, 8) if rng.integers(2) else 8
+    prefill_chunk = [None, 2, 4][int(rng.integers(3))]
     n_req = int(rng.integers(4, 9))
     prompts = [rng.integers(0, 64, (int(rng.integers(1, 9)),)).tolist()
                for _ in range(n_req)]
     news = [int(rng.integers(1, 7)) for _ in range(n_req)]
     eng = ServingEngine(params, CFG, slots=slots, max_len=16,
-                        prompt_pad=buckets, steps_per_tick=steps_per_tick)
+                        prompt_pad=buckets, steps_per_tick=steps_per_tick,
+                        prefill_chunk=prefill_chunk)
     ids = [eng.submit(p, max_new=m) for p, m in zip(prompts, news)]
     results = eng.run()
     for rid, p, m in zip(ids, prompts, news):
         assert results[rid] == _one_shot(params, p, m), \
-            (seed, rid, len(p), m, slots, steps_per_tick, buckets)
+            (seed, rid, len(p), m, slots, steps_per_tick, buckets,
+             prefill_chunk)
+
+
+def test_chunked_prefill_matches_whole_bucket():
+    """Chunked prefill is causally exact: chunk t attends itself plus the
+    chunks already in the cache, which is what one whole-bucket prefill
+    computes — every request's tokens must match per-request generate."""
+    params = _params()
+    rng = np.random.default_rng(12)
+    prompts = [rng.integers(0, 64, (n,)).tolist() for n in (8, 3, 6, 8, 5)]
+    eng = ServingEngine(params, CFG, slots=2, max_len=20, prompt_pad=8,
+                        prefill_chunk=2)
+    ids = [eng.submit(p, max_new=4) for p in prompts]
+    results = eng.run()
+    for rid, p in zip(ids, prompts):
+        assert results[rid] == _one_shot(params, p, 4), (rid, len(p))
+    assert eng.metrics["prefill_chunks"] > 0
+
+
+def test_chunked_prefill_interleaves_with_decode():
+    """The point of chunking: while one slot prefills a long prompt, the
+    other slot's decode keeps running.  Drive ticks by hand and assert
+    decode steps happen BETWEEN the long prompt's chunks — and that the
+    inactive-slot junk-write redirect protects the prefilling slot's
+    chunk 0 (its final tokens still match one-shot generate)."""
+    params = _params()
+    rng = np.random.default_rng(13)
+    short = rng.integers(0, 64, (2,)).tolist()
+    long_p = rng.integers(0, 64, (8,)).tolist()
+    eng = ServingEngine(params, CFG, slots=2, max_len=24, prompt_pad=8,
+                        prefill_chunk=2)
+    i_short = eng.submit(short, max_new=10)
+    eng.step()  # short admitted (<= chunk would chunk too; 2 <= 2 direct)
+    i_long = eng.submit(long_p, max_new=4)
+    decode_before = eng.metrics["decode_steps"]
+    eng.step()  # long starts chunking; short decodes
+    assert 0 in eng._prefilling or 1 in eng._prefilling
+    assert eng.metrics["decode_steps"] > decode_before, \
+        "decode must proceed during a chunked prefill"
+    results = eng.run()
+    assert results[i_short] == _one_shot(params, short, 10)
+    assert results[i_long] == _one_shot(params, long_p, 4)
+
+
+def test_chunked_prefill_skips_tail_chunks():
+    """A prompt of 5 in an 8-bucket with chunk 2 needs chunks covering
+    positions 0..4 only (3 chunks); the 8-bucket tail chunk is skipped."""
+    params = _params()
+    rng = np.random.default_rng(14)
+    p = rng.integers(0, 64, (5,)).tolist()
+    eng = ServingEngine(params, CFG, slots=1, max_len=16, prompt_pad=8,
+                        prefill_chunk=2)
+    rid = eng.submit(p, max_new=3)
+    results = eng.run()
+    assert results[rid] == _one_shot(params, p, 3)
+    assert eng.metrics["prefill_chunks"] == 3  # ceil(5/2), not 8/2
+
+
+def test_chunked_prefill_validation():
+    params = _params()
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ServingEngine(params, CFG, slots=1, max_len=16, prompt_pad=8,
+                      prefill_chunk=3)  # 3 does not divide 8
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ServingEngine(params, CFG, slots=1, max_len=16, prompt_pad=8,
+                      prefill_chunk=0)
+
+
+def test_chunked_prefill_int8_kv():
+    """Chunk-at-a-time quantize-at-write produces the same int8 rows as a
+    whole-bucket prefill (same values in, same per-row scales out)."""
+    import dataclasses
+
+    cfg8 = dataclasses.replace(CFG, kv_dtype="int8")
+    params = _params()
+    rng = np.random.default_rng(15)
+    prompts = [rng.integers(0, 64, (n,)).tolist() for n in (8, 4)]
+    eng = ServingEngine(params, cfg8, slots=2, max_len=20, prompt_pad=8,
+                        prefill_chunk=4)
+    ids = [eng.submit(p, max_new=4) for p in prompts]
+    results = eng.run()
+    for rid, p in zip(ids, prompts):
+        one = generate(params, jnp.asarray([p]), cfg8, max_new=4)
+        assert results[rid] == np.asarray(one)[0].tolist(), rid

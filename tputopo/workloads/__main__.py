@@ -197,7 +197,8 @@ def cmd_serve(args) -> int:
         eng = ServingEngine(params, cfg, slots=args.slots,
                             max_len=args.prompt_len + args.max_new,
                             prompt_pad=args.prompt_len,
-                            steps_per_tick=args.steps_per_tick)
+                            steps_per_tick=args.steps_per_tick,
+                            prefill_chunk=args.prefill_chunk)
         ids = [eng.submit(rng.integers(0, cfg.vocab_size, (L,)).tolist(),
                           max_new=args.max_new) for L in lens]
         t0 = time.perf_counter()
@@ -282,6 +283,10 @@ def main() -> int:
                    help="prefill bucket; prompts sample 1/4..1x of it")
     p.add_argument("--max-new", type=int, default=32)
     p.add_argument("--steps-per-tick", type=int, default=8)
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   help="chunked prefill: long prompts prefill this many "
+                        "tokens per tick, interleaved with decode (bounds "
+                        "head-of-line blocking); must divide --prompt-len")
     p.add_argument("--int8", action="store_true",
                    help="full int8 serving stack: weights + KV cache")
     p.set_defaults(fn=cmd_serve)

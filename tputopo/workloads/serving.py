@@ -7,7 +7,9 @@ The reference schedules the *containers* serving workloads like this one
 the placed chips run a real serving engine, not a fixed-shape toy.
 
 TPU-first formulation — everything the accelerator touches is static-shape
-and compiled exactly twice (one prefill program, one decode program):
+and compiled O(1) times: one whole-bucket prefill per bucket, one decode
+program, and (when chunked prefill is on) one chunk program plus one
+per-bucket finisher:
 
 - A ``DecodeState`` holds SLOTS, not requests: a [slots, max_len] token
   buffer, one KV cache, and per-slot ``length`` / ``prompt_len`` /
@@ -28,9 +30,17 @@ and compiled exactly twice (one prefill program, one decode program):
   vmapped ``dynamic_update_slice`` at per-slot positions, and the
   attention mask compares against each slot's own length.  Idle (done or
   empty) slots ride along masked — their state vectors are write-gated,
-  and their cache writes are idempotent re-writes of an existing token's
-  K/V (or land in a region the next admission's prefill overwrites
-  wholesale), so one fixed-shape program serves any active subset.
+  and their junk cache writes are REDIRECTED to position max_len-1,
+  which is unreachable (length masks) until the exact step whose real
+  write overwrites it.  The redirect is load-bearing for CHUNKED
+  prefill: a mid-prefill slot is inactive while decode ticks run between
+  its chunks, and a junk write at position 0 (the old convention) would
+  clobber its first chunk.
+- Chunked prefill (``prefill_chunk=N``) bounds head-of-line blocking:
+  a wide-bucket admission runs one N-token chunk per tick — causally
+  exact, since chunk t attends itself plus the chunks already in the
+  cache — with decode steps interleaved; the chunk holding the prompt's
+  last token activates the slot, later chunks are skipped.
 
 The host-side :class:`ServingEngine` is pure control plane: a request
 queue, slot bookkeeping, and harvesting — no tensor math, nothing that
@@ -89,43 +99,35 @@ def init_state(config: ModelConfig, slots: int, max_len: int) -> DecodeState:
 
 # ---- admission: ragged prefill into one slot --------------------------------
 
-def admit(params: dict, state: DecodeState, config: ModelConfig,
-          slot: jax.Array, prompt: jax.Array, prompt_len: jax.Array,
-          seq_id: jax.Array, budget: jax.Array, eos_id: jax.Array, *,
-          temperature: float = 0.0, top_k: int | None = None,
-          key: jax.Array | None = None) -> DecodeState:
-    """Prefill ``prompt`` (padded to the static bucket length) into
-    ``slot`` and emit its first token.  ``slot``/``prompt_len``/``seq_id``
-    /``budget``/``eos_id`` are traced scalars — admitting into any slot
-    reuses one compiled program.  ``eos_id`` < 0 disables EOS (token ids
-    are non-negative, so the comparison never fires)."""
-    c = config
-    max_len = state.tokens.shape[1]
-    pad = prompt.shape[0]
-    cos, sin = _rope_tables(c, max_len)
-
-    # The slot's cache slice, as a batch-1 cache the block prefill
-    # understands; positions >= pad keep stale junk that per-slot length
-    # masks make unreachable.  Every leaf (incl. int8 scale buffers)
-    # shares the [L, slots, ...] layout, so one slice/update rule covers
-    # both cache formats.
-    slot_cache = KVCache(*(
+def _slot_cache(state: DecodeState, slot: jax.Array) -> KVCache:
+    """The slot's cache slice, as a batch-1 cache the block prefill
+    understands.  Every leaf (incl. int8 scale buffers) shares the
+    [L, slots, ...] layout, so one slice rule covers both formats."""
+    return KVCache(*(
         None if b is None else jax.lax.dynamic_slice_in_dim(b, slot, 1, axis=1)
         for b in state.cache))
-    logits, filled = _block_step(params, c, prompt[None, :], 0,
-                                 slot_cache, cos, sin)
-    new_cache = KVCache(*(
+
+
+def _merge_slot_cache(state: DecodeState, filled: KVCache,
+                      slot: jax.Array) -> KVCache:
+    return KVCache(*(
         None if b is None else jax.lax.dynamic_update_slice_in_dim(
             whole, b, slot, axis=1)
         for whole, b in zip(state.cache, filled)))
 
-    last = jax.lax.dynamic_index_in_dim(logits[0], prompt_len - 1, axis=0,
-                                        keepdims=False)
-    first = _select(last[None, :], temperature, top_k, key, state.step,
+
+def _finish_admit(state: DecodeState, config: ModelConfig, new_cache: KVCache,
+                  slot, last_logits, prompt_row, prompt_len, seq_id, budget,
+                  eos_id, temperature, top_k, key) -> DecodeState:
+    """Shared tail of whole-bucket and chunked admission: select the first
+    token from the last prompt position's logits, install the token row,
+    and activate the slot."""
+    max_len = state.tokens.shape[1]
+    first = _select(last_logits[None, :], temperature, top_k, key, state.step,
                     jnp.int32)[0]
 
     row = jnp.zeros((max_len,), jnp.int32)
-    row = jax.lax.dynamic_update_slice(row, prompt.astype(jnp.int32), (0,))
+    row = jax.lax.dynamic_update_slice(row, prompt_row.astype(jnp.int32), (0,))
     # Pad positions past the real prompt are zeroed so the token buffer is
     # exactly prompt + generated (harvest slices by length).
     pos = jnp.arange(max_len)
@@ -149,7 +151,78 @@ def admit(params: dict, state: DecodeState, config: ModelConfig,
     )
 
 
+def admit(params: dict, state: DecodeState, config: ModelConfig,
+          slot: jax.Array, prompt: jax.Array, prompt_len: jax.Array,
+          seq_id: jax.Array, budget: jax.Array, eos_id: jax.Array, *,
+          temperature: float = 0.0, top_k: int | None = None,
+          key: jax.Array | None = None) -> DecodeState:
+    """Prefill ``prompt`` (padded to the static bucket length) into
+    ``slot`` and emit its first token.  ``slot``/``prompt_len``/``seq_id``
+    /``budget``/``eos_id`` are traced scalars — admitting into any slot
+    reuses one compiled program.  ``eos_id`` < 0 disables EOS (token ids
+    are non-negative, so the comparison never fires).  Positions >= the
+    real prompt keep stale cache junk that per-slot length masks make
+    unreachable."""
+    c = config
+    max_len = state.tokens.shape[1]
+    cos, sin = _rope_tables(c, max_len)
+    logits, filled = _block_step(params, c, prompt[None, :], 0,
+                                 _slot_cache(state, slot), cos, sin)
+    last = jax.lax.dynamic_index_in_dim(logits[0], prompt_len - 1, axis=0,
+                                        keepdims=False)
+    return _finish_admit(state, c, _merge_slot_cache(state, filled, slot),
+                         slot, last, prompt, prompt_len, seq_id, budget,
+                         eos_id, temperature, top_k, key)
+
+
 admit_jit = jax.jit(admit, static_argnames=("config", "temperature", "top_k"))
+
+
+def prefill_chunk(params: dict, state: DecodeState, config: ModelConfig,
+                  slot: jax.Array, chunk: jax.Array,
+                  start: jax.Array) -> DecodeState:
+    """One NON-final chunk of a chunked prefill: run ``chunk`` (a fixed-
+    size slice of the prompt) through the stack at positions start.. and
+    write only the slot's cache — the slot stays inactive (seq_id -1), so
+    decode ticks for other slots proceed between chunks instead of
+    stalling behind one long prompt (head-of-line blocking).  Causally
+    exact: the chunk attends to itself plus the earlier chunks already in
+    the cache, which is precisely what a whole-prompt prefill computes."""
+    cos, sin = _rope_tables(config, state.tokens.shape[1])
+    _, filled = _block_step(params, config, chunk[None, :], start,
+                            _slot_cache(state, slot), cos, sin)
+    return state._replace(cache=_merge_slot_cache(state, filled, slot))
+
+
+prefill_chunk_jit = jax.jit(prefill_chunk, static_argnames=("config",))
+
+
+def admit_final_chunk(params: dict, state: DecodeState, config: ModelConfig,
+                      slot: jax.Array, prompt: jax.Array, chunk: jax.Array,
+                      start: jax.Array, prompt_len: jax.Array,
+                      seq_id: jax.Array, budget: jax.Array,
+                      eos_id: jax.Array, *, temperature: float = 0.0,
+                      top_k: int | None = None,
+                      key: jax.Array | None = None) -> DecodeState:
+    """The FINAL chunk of a chunked prefill: position prompt_len-1 lies in
+    ``chunk``, so this call both fills its cache span and activates the
+    slot (first-token select + token row from the full padded ``prompt``).
+    Chunks past this one are never run — the positions they would fill
+    hold junk the per-slot length masks make unreachable, exactly like
+    whole-bucket admit's pad tail."""
+    c = config
+    cos, sin = _rope_tables(c, state.tokens.shape[1])
+    logits, filled = _block_step(params, c, chunk[None, :], start,
+                                 _slot_cache(state, slot), cos, sin)
+    last = jax.lax.dynamic_index_in_dim(logits[0], prompt_len - 1 - start,
+                                        axis=0, keepdims=False)
+    return _finish_admit(state, c, _merge_slot_cache(state, filled, slot),
+                         slot, last, prompt, prompt_len, seq_id, budget,
+                         eos_id, temperature, top_k, key)
+
+
+admit_final_chunk_jit = jax.jit(
+    admit_final_chunk, static_argnames=("config", "temperature", "top_k"))
 
 
 # ---- the ragged decode step -------------------------------------------------
@@ -210,10 +283,14 @@ def decode_step(params: dict, state: DecodeState, config: ModelConfig,
     group = c.n_heads // c.n_kv_heads
     active = state.active
     # The last held token (produced by admit/the previous step) has not
-    # been fed yet: feed it at position length-1.  Empty slots (length 0)
-    # clamp to position 0 — their writes are junk inside a region the next
-    # admission's prefill overwrites wholesale.
-    pos = jnp.maximum(state.length - 1, 0)
+    # been fed yet: feed it at position length-1.  Inactive slots write
+    # their junk K/V at max_len-1, NOT position 0: a slot mid-way through
+    # a CHUNKED prefill is still inactive, and a junk write at 0 would
+    # clobber its first chunk.  max_len-1 is always safe — it only
+    # becomes reachable (k_pos <= length-1) on the exact step whose real
+    # write overwrites it.
+    pos = jnp.where(active, jnp.maximum(state.length - 1, 0),
+                    state.tokens.shape[1] - 1)
     tok = jnp.take_along_axis(state.tokens, pos[:, None], axis=1)  # [B, 1]
 
     cos, sin = _rope_tables(c, max_len)
@@ -323,6 +400,13 @@ class ServingEngine:
     long-prompt service don't pay the full-pad prefill.  Prompts longer
     than the largest bucket are rejected.  ``eos_id`` < 0 disables EOS
     (budget-only termination).
+
+    ``prefill_chunk`` (optional) bounds head-of-line blocking: prompts
+    longer than the chunk prefill one fixed-size chunk per tick,
+    interleaved with the other slots' decode steps, instead of stalling
+    them for the whole prompt.  Buckets must be chunk multiples; chunks
+    past the one holding the prompt's last token are skipped (their
+    positions stay junk the length masks make unreachable).
     """
 
     def __init__(self, params: dict, config: ModelConfig, *, slots: int,
@@ -330,7 +414,8 @@ class ServingEngine:
                  eos_id: int = -1,
                  temperature: float = 0.0, top_k: int | None = None,
                  key: jax.Array | None = None,
-                 steps_per_tick: int = 1) -> None:
+                 steps_per_tick: int = 1,
+                 prefill_chunk: int | None = None) -> None:
         buckets = ((prompt_pad,) if isinstance(prompt_pad, int)
                    else tuple(sorted(set(prompt_pad))))
         if not buckets or any(b < 1 for b in buckets):
@@ -342,6 +427,12 @@ class ServingEngine:
             raise ValueError("sampling (temperature > 0) needs a PRNG key")
         if steps_per_tick < 1:
             raise ValueError("steps_per_tick must be >= 1")
+        if prefill_chunk is not None and (
+                prefill_chunk < 1
+                or any(b % prefill_chunk for b in buckets)):
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} must be >= 1 and divide "
+                f"every bucket {buckets}")
         self.params = params
         self.config = config
         self.slots = slots
@@ -353,11 +444,15 @@ class ServingEngine:
         self.top_k = top_k
         self.key = key if key is not None else jax.random.key(0)
         self.steps_per_tick = steps_per_tick
+        self.prefill_chunk = prefill_chunk
         self.state = init_state(config, slots, max_len)
         self._queue: list[tuple[int, list[int], int]] = []  # (id, prompt, max_new)
+        # slot -> (rid, padded row, prompt_len, max_new, next chunk start)
+        self._prefilling: dict[int, tuple[int, np.ndarray, int, int, int]] = {}
         self._next_id = 0
         self._results: dict[int, list[int]] = {}
-        self.metrics = {"admitted": 0, "decode_steps": 0, "finished": 0}
+        self.metrics = {"admitted": 0, "decode_steps": 0, "finished": 0,
+                        "prefill_chunks": 0}
 
     # -- request surface --
 
@@ -383,7 +478,35 @@ class ServingEngine:
 
     def _free_slots(self) -> list[int]:
         seq = np.asarray(self.state.seq_id)
-        return [i for i in range(self.slots) if seq[i] < 0]
+        return [i for i in range(self.slots)
+                if seq[i] < 0 and i not in self._prefilling]
+
+    def _advance_prefill(self, slot: int) -> None:
+        """One chunk of ``slot``'s prefill.  The chunk holding the
+        prompt's last token finishes through admit_final_chunk (first-
+        token select + activation); chunks past it never run."""
+        ch = self.prefill_chunk
+        rid, padded, plen, max_new, start = self._prefilling[slot]
+        if start + ch < plen:  # a later chunk holds position plen-1
+            self.state = prefill_chunk_jit(
+                self.params, self.state, self.config, jnp.int32(slot),
+                jnp.asarray(padded[start:start + ch]), jnp.int32(start))
+            self._prefilling[slot] = (rid, padded, plen, max_new, start + ch)
+        else:
+            self.state = admit_final_chunk_jit(
+                self.params, self.state, self.config, jnp.int32(slot),
+                jnp.asarray(padded),
+                jnp.asarray(padded[start:start + ch]), jnp.int32(start),
+                jnp.int32(plen), jnp.int32(rid), jnp.int32(max_new),
+                jnp.int32(self.eos_id), temperature=self.temperature,
+                top_k=self.top_k, key=self.key)
+            del self._prefilling[slot]
+            self.metrics["admitted"] += 1
+        self.metrics["prefill_chunks"] += 1
+
+    def _advance_prefills(self) -> None:
+        for slot in list(self._prefilling):
+            self._advance_prefill(slot)
 
     def _admit_pending(self) -> None:
         for slot in self._free_slots():
@@ -395,6 +518,15 @@ class ServingEngine:
             pad = next(b for b in self.buckets if b >= len(prompt))
             padded = np.zeros((pad,), np.int32)
             padded[: len(prompt)] = prompt
+            if self.prefill_chunk and pad > self.prefill_chunk:
+                # The BUCKET (not the prompt) decides: even a short prompt
+                # in a wide bucket would otherwise pay a whole-bucket
+                # prefill.  Reserve the slot and run its first chunk now
+                # (no dead tick); later chunks land one per tick so the
+                # other slots keep decoding.
+                self._prefilling[slot] = (rid, padded, len(prompt), max_new, 0)
+                self._advance_prefill(slot)
+                continue
             self.state = admit_jit(
                 self.params, self.state, self.config,
                 jnp.int32(slot), jnp.asarray(padded),
@@ -427,10 +559,13 @@ class ServingEngine:
         )
 
     def step(self) -> None:
-        """One engine tick: harvest finished -> admit from the queue ->
-        ``steps_per_tick`` batched decode steps (if anything is active),
-        chained device-side so the tick costs one dispatch."""
+        """One engine tick: harvest finished -> advance chunked prefills
+        by one chunk each -> admit from the queue -> ``steps_per_tick``
+        batched decode steps (if anything is active), chained device-side
+        so the tick costs one dispatch."""
         self._harvest()
+        if self._prefilling:
+            self._advance_prefills()
         self._admit_pending()
         if bool(np.asarray(self.state.active).any()):
             if self.steps_per_tick == 1:
@@ -451,7 +586,7 @@ class ServingEngine:
         (prompt + generated, EOS included when emitted)}."""
         for _ in range(max_steps):
             self.step()
-            if not self._queue and not bool(
+            if not self._queue and not self._prefilling and not bool(
                     np.asarray(self.state.seq_id >= 0).any()):
                 break
         self._harvest()
