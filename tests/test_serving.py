@@ -330,3 +330,112 @@ def test_chunked_prefill_int8_kv():
     for rid, p in zip(ids, prompts):
         one = generate(params, jnp.asarray([p]), cfg8, max_new=4)
         assert results[rid] == np.asarray(one)[0].tolist(), rid
+
+
+def test_prefix_cache_matches_one_shot():
+    """register_prefix computes the shared prefix KV once; every request
+    with prefix=pid must match a one-shot generate of prefix + suffix
+    token-for-token (RoPE is absolute, so copied rows are bit-identical
+    to in-place prefill)."""
+    params = _params()
+    rng = np.random.default_rng(20)
+    prefix = rng.integers(0, 64, (6,)).tolist()
+    sufs = [rng.integers(0, 64, (n,)).tolist() for n in (3, 5, 2, 7)]
+    eng = ServingEngine(params, CFG, slots=2, max_len=32, prompt_pad=8)
+    pid = eng.register_prefix(prefix)
+    ids = [eng.submit(s, max_new=5, prefix=pid) for s in sufs]
+    plain = eng.submit(rng.integers(0, 64, (4,)).tolist(), max_new=3)
+    results = eng.run()
+    for rid, s in zip(ids, sufs):
+        assert results[rid] == _one_shot(params, prefix + s, 5), (rid, len(s))
+    assert plain in results  # prefix and plain admissions coexist
+    assert eng.metrics["prefix_admits"] == 4
+
+
+def test_prefix_cache_with_chunked_suffix():
+    """A prefix admission's suffix rides the same chunk machinery at
+    start=P: chunked and unchunked produce identical tokens."""
+    params = _params()
+    rng = np.random.default_rng(21)
+    prefix = rng.integers(0, 64, (5,)).tolist()
+    sufs = [rng.integers(0, 64, (n,)).tolist() for n in (7, 8, 1)]
+    eng = ServingEngine(params, CFG, slots=2, max_len=32, prompt_pad=8,
+                        prefill_chunk=4)
+    pid = eng.register_prefix(prefix)
+    ids = [eng.submit(s, max_new=4, prefix=pid) for s in sufs]
+    results = eng.run()
+    for rid, s in zip(ids, sufs):
+        assert results[rid] == _one_shot(params, prefix + s, 4), (rid, len(s))
+    assert eng.metrics["prefill_chunks"] > 0
+
+
+def test_prefix_cache_int8_kv():
+    """Prefix KV built, copied, and attended through the int8 cache:
+    quantize-at-build equals quantize-at-prefill (same rows in, same
+    scales out), so tokens match the no-prefix int8 path."""
+    import dataclasses
+
+    cfg8 = dataclasses.replace(CFG, kv_dtype="int8")
+    params = _params()
+    rng = np.random.default_rng(22)
+    prefix = rng.integers(0, 64, (6,)).tolist()
+    suf = rng.integers(0, 64, (4,)).tolist()
+    eng = ServingEngine(params, cfg8, slots=1, max_len=32, prompt_pad=8)
+    pid = eng.register_prefix(prefix)
+    rid = eng.submit(suf, max_new=5, prefix=pid)
+    results = eng.run()
+    one = generate(params, jnp.asarray([prefix + suf]), cfg8, max_new=5)
+    assert results[rid] == np.asarray(one)[0].tolist()
+
+
+def test_prefix_cache_validation():
+    params = _params()
+    eng = ServingEngine(params, CFG, slots=1, max_len=16, prompt_pad=8)
+    with pytest.raises(ValueError, match="non-empty"):
+        eng.register_prefix([])
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        eng.register_prefix([1] * 12)  # 12 + bucket 8 > 16
+    pid = eng.register_prefix([1, 2, 3])
+    with pytest.raises(ValueError, match="unknown prefix"):
+        eng.submit([4], max_new=2, prefix=pid + 999)
+    with pytest.raises(ValueError, match="max_new"):
+        eng.submit([4] * 8, max_new=8, prefix=pid)  # 3 + 8 + 8 > 16
+
+
+def test_prefix_finisher_compiles_once_across_prefix_lengths():
+    """The finisher's compile key must vary only with chunk width — a
+    second prefix of a different length reuses the same programs (the
+    token row is max_len-shaped; per-(prefix, bucket) retraces would put
+    seconds of XLA compile on the serving path)."""
+    from tputopo.workloads import serving
+
+    params = _params()
+    rng = np.random.default_rng(23)
+    eng = ServingEngine(params, CFG, slots=1, max_len=32, prompt_pad=8)
+    p1 = eng.register_prefix(rng.integers(0, 64, (4,)).tolist())
+    p2 = eng.register_prefix(rng.integers(0, 64, (7,)).tolist())
+    r1 = eng.submit(rng.integers(0, 64, (3,)).tolist(), max_new=2, prefix=p1)
+    eng.run()
+    traces = serving.admit_final_chunk_jit._cache_size()
+    r2 = eng.submit(rng.integers(0, 64, (5,)).tolist(), max_new=2, prefix=p2)
+    res = eng.run()
+    assert serving.admit_final_chunk_jit._cache_size() == traces, \
+        "a different prefix length must not retrace the finisher"
+    assert r1 != r2 and r2 in res
+
+
+def test_unregister_prefix():
+    params = _params()
+    rng = np.random.default_rng(24)
+    eng = ServingEngine(params, CFG, slots=1, max_len=32, prompt_pad=8)
+    pid = eng.register_prefix(rng.integers(0, 64, (4,)).tolist())
+    rid = eng.submit([1, 2], max_new=2, prefix=pid)
+    with pytest.raises(ValueError, match="still referenced"):
+        eng.unregister_prefix(pid)
+    res = eng.run()
+    assert rid in res
+    eng.unregister_prefix(pid)
+    with pytest.raises(ValueError, match="unknown prefix"):
+        eng.unregister_prefix(pid)
+    with pytest.raises(ValueError, match="unknown prefix"):
+        eng.submit([1], max_new=2, prefix=pid)

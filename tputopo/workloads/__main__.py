@@ -193,23 +193,33 @@ def cmd_serve(args) -> int:
     rng = np.random.default_rng(0)
     lens = rng.integers(max(1, args.prompt_len // 4), args.prompt_len + 1,
                         args.requests)
+    max_len = args.prefix_len + args.prompt_len + args.max_new
     with shardlib.activate(plan):
         eng = ServingEngine(params, cfg, slots=args.slots,
-                            max_len=args.prompt_len + args.max_new,
+                            max_len=max_len,
                             prompt_pad=args.prompt_len,
                             steps_per_tick=args.steps_per_tick,
                             prefill_chunk=args.prefill_chunk)
+        pid = None
+        if args.prefix_len:
+            # Shared system-prompt demo: its KV computes once, every
+            # request below reuses it by copy.
+            pid = eng.register_prefix(
+                rng.integers(0, cfg.vocab_size, (args.prefix_len,)).tolist())
         ids = [eng.submit(rng.integers(0, cfg.vocab_size, (L,)).tolist(),
-                          max_new=args.max_new) for L in lens]
+                          max_new=args.max_new, prefix=pid) for L in lens]
         t0 = time.perf_counter()
         results = eng.run()
         dt = time.perf_counter() - t0
-    generated = sum(len(results[i]) - L for i, L in zip(ids, lens))
+    base = args.prefix_len + np.asarray(lens)
+    generated = sum(len(results[i]) - int(b) for i, b in zip(ids, base))
     print(json.dumps({
         "requests": args.requests, "slots": args.slots, "mesh": plan.axes,
         "prompt_lens": f"{lens.min()}..{lens.max()}",
+        "prefix_len": args.prefix_len,
         "generated_tokens": int(generated),
         "decode_steps": eng.metrics["decode_steps"],
+        "prefix_admits": eng.metrics["prefix_admits"],
         "tokens_per_s": round(generated / dt, 1),
         "wall_s": round(dt, 3),
     }))
@@ -287,6 +297,9 @@ def main() -> int:
                    help="chunked prefill: long prompts prefill this many "
                         "tokens per tick, interleaved with decode (bounds "
                         "head-of-line blocking); must divide --prompt-len")
+    p.add_argument("--prefix-len", type=int, default=0,
+                   help="shared system-prompt length: its KV computes once "
+                        "(register_prefix) and every request reuses it")
     p.add_argument("--int8", action="store_true",
                    help="full int8 serving stack: weights + KV cache")
     p.set_defaults(fn=cmd_serve)

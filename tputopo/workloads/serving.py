@@ -121,13 +121,16 @@ def _finish_admit(state: DecodeState, config: ModelConfig, new_cache: KVCache,
                   eos_id, temperature, top_k, key) -> DecodeState:
     """Shared tail of whole-bucket and chunked admission: select the first
     token from the last prompt position's logits, install the token row,
-    and activate the slot."""
+    and activate the slot.  ``prompt_row`` may be bucket-length (admit) or
+    already max_len (the chunk finisher, whose compile key must not vary
+    with prompt composition)."""
     max_len = state.tokens.shape[1]
     first = _select(last_logits[None, :], temperature, top_k, key, state.step,
                     jnp.int32)[0]
 
     row = jnp.zeros((max_len,), jnp.int32)
-    row = jax.lax.dynamic_update_slice(row, prompt_row.astype(jnp.int32), (0,))
+    row = jax.lax.dynamic_update_slice(
+        row, prompt_row.astype(jnp.int32)[:max_len], (0,))
     # Pad positions past the real prompt are zeroed so the token buffer is
     # exactly prompt + generated (harvest slices by length).
     pos = jnp.arange(max_len)
@@ -206,10 +209,12 @@ def admit_final_chunk(params: dict, state: DecodeState, config: ModelConfig,
                       key: jax.Array | None = None) -> DecodeState:
     """The FINAL chunk of a chunked prefill: position prompt_len-1 lies in
     ``chunk``, so this call both fills its cache span and activates the
-    slot (first-token select + token row from the full padded ``prompt``).
-    Chunks past this one are never run — the positions they would fill
-    hold junk the per-slot length masks make unreachable, exactly like
-    whole-bucket admit's pad tail."""
+    slot (first-token select + token row from the full padded ``prompt``,
+    which callers pass at max_len so the compile key varies only with the
+    chunk width — never with prompt or prefix length).  Chunks past this
+    one are never run — the positions they would fill hold junk the
+    per-slot length masks make unreachable, exactly like whole-bucket
+    admit's pad tail."""
     c = config
     cos, sin = _rope_tables(c, state.tokens.shape[1])
     logits, filled = _block_step(params, c, chunk[None, :], start,
@@ -223,6 +228,44 @@ def admit_final_chunk(params: dict, state: DecodeState, config: ModelConfig,
 
 admit_final_chunk_jit = jax.jit(
     admit_final_chunk, static_argnames=("config", "temperature", "top_k"))
+
+
+# ---- prefix caching: compute a shared prompt prefix's KV once ---------------
+
+def build_prefix_cache(params: dict, config: ModelConfig,
+                       tokens: jax.Array) -> KVCache:
+    """KV for a shared prefix [P], computed once: a batch-1, length-P
+    cache filled by the standard block prefill.  RoPE is absolute, so
+    these rows are bit-identical to computing the prefix in place at
+    positions 0..P-1 of any slot — admission copies them (O(bytes),
+    no FLOPs) instead of re-running the transformer per request."""
+    P = tokens.shape[0]
+    cos, sin = _rope_tables(config, P)
+    _, filled = _block_step(params, config, tokens[None, :], 0,
+                            KVCache.create(config, 1, P), cos, sin)
+    # KV heads shard over tp like any cache; batch dim is 1 (no dp).
+    return KVCache(*(None if b is None
+                     else constrain(b, None, None, None, "tp", None)
+                     for b in filled))
+
+
+build_prefix_cache_jit = jax.jit(build_prefix_cache,
+                                 static_argnames=("config",))
+
+
+def copy_prefix(state: DecodeState, prefix: KVCache,
+                slot: jax.Array) -> DecodeState:
+    """Install a prebuilt prefix KV into ``slot``'s cache positions
+    0..P-1 — a pure device copy.  The slot stays inactive; the suffix
+    prefill (whole-bucket or chunked, at start=P) activates it."""
+    new_cache = KVCache(*(
+        None if b is None else jax.lax.dynamic_update_slice(
+            whole, b, (0, slot) + (0,) * (whole.ndim - 2))
+        for whole, b in zip(state.cache, prefix)))
+    return state._replace(cache=new_cache)
+
+
+copy_prefix_jit = jax.jit(copy_prefix)
 
 
 # ---- the ragged decode step -------------------------------------------------
@@ -446,32 +489,83 @@ class ServingEngine:
         self.steps_per_tick = steps_per_tick
         self.prefill_chunk = prefill_chunk
         self.state = init_state(config, slots, max_len)
-        self._queue: list[tuple[int, list[int], int]] = []  # (id, prompt, max_new)
-        # slot -> (rid, padded row, prompt_len, max_new, next chunk start)
-        self._prefilling: dict[int, tuple[int, np.ndarray, int, int, int]] = {}
+        # (id, prompt-or-suffix, max_new, prefix id or None)
+        self._queue: list[tuple[int, list[int], int, int | None]] = []
+        # slot -> (rid, max_len row, prompt_len, max_new, next start, chunk)
+        self._prefilling: dict[
+            int, tuple[int, np.ndarray, int, int, int, int]] = {}
+        # prefix id -> (tokens, device KVCache [L, 1, P, KV, H])
+        self._prefixes: dict[int, tuple[list[int], KVCache]] = {}
         self._next_id = 0
         self._results: dict[int, list[int]] = {}
         self.metrics = {"admitted": 0, "decode_steps": 0, "finished": 0,
-                        "prefill_chunks": 0}
+                        "prefill_chunks": 0, "prefix_admits": 0}
 
     # -- request surface --
 
-    def submit(self, prompt: list[int] | np.ndarray, max_new: int) -> int:
+    def register_prefix(self, tokens: list[int] | np.ndarray) -> int:
+        """Compute a shared prompt prefix's KV once; requests submitted
+        with ``prefix=pid`` copy it (no recompute) and prefill only their
+        suffix.  One compiled builder per distinct prefix length."""
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            raise ValueError("prefix must be non-empty")
+        if len(tokens) + self.buckets[0] > self.max_len:
+            raise ValueError(
+                f"prefix {len(tokens)} + smallest bucket {self.buckets[0]} "
+                f"exceeds max_len {self.max_len}")
+        cache = build_prefix_cache_jit(self.params, self.config,
+                                       jnp.asarray(tokens, jnp.int32))
+        pid = self._next_id
+        self._next_id += 1
+        self._prefixes[pid] = (tokens, cache)
+        return pid
+
+    def unregister_prefix(self, pid: int) -> None:
+        """Release a prefix's device KV (a registered prefix pins
+        L x P x KV x H x 2 device bytes until dropped — long-lived
+        engines rotating system prompts must evict).  Mid-prefill slots
+        already copied the KV; only queued requests still reference the
+        pid, so eviction is refused while any do."""
+        if pid not in self._prefixes:
+            raise ValueError(f"unknown prefix id {pid}")
+        if any(q[3] == pid for q in self._queue):
+            raise ValueError(
+                f"prefix {pid} still referenced by queued requests")
+        del self._prefixes[pid]
+
+    def submit(self, prompt: list[int] | np.ndarray, max_new: int,
+               prefix: int | None = None) -> int:
+        """Queue a request.  With ``prefix``, ``prompt`` is the SUFFIX
+        after the registered prefix; the result row is the full
+        prefix + suffix + generated sequence (parity with a one-shot
+        generate of the concatenation)."""
         prompt = list(int(t) for t in prompt)
         if not 0 < len(prompt) <= self.prompt_pad:
             raise ValueError(
                 f"prompt length {len(prompt)} outside (0, {self.prompt_pad}]")
         if max_new < 1:
             raise ValueError("max_new must be >= 1")
-        if len(prompt) + max_new > self.max_len:
+        plen = len(prompt)
+        if prefix is not None:
+            if prefix not in self._prefixes:
+                raise ValueError(f"unknown prefix id {prefix}")
+            ptoks = self._prefixes[prefix][0]
+            pad_s = next(b for b in self.buckets if b >= len(prompt))
+            if len(ptoks) + pad_s > self.max_len:
+                raise ValueError(
+                    f"prefix {len(ptoks)} + suffix bucket {pad_s} exceeds "
+                    f"max_len {self.max_len}")
+            plen += len(ptoks)
+        if plen + max_new > self.max_len:
             # The slot buffer would silently cap generation otherwise,
             # breaking parity with a one-shot generate of the same budget.
             raise ValueError(
-                f"prompt {len(prompt)} + max_new {max_new} exceeds "
+                f"prompt {plen} + max_new {max_new} exceeds "
                 f"max_len {self.max_len}")
         rid = self._next_id
         self._next_id += 1
-        self._queue.append((rid, prompt, max_new))
+        self._queue.append((rid, prompt, max_new, prefix))
         return rid
 
     # -- engine internals --
@@ -484,19 +578,20 @@ class ServingEngine:
     def _advance_prefill(self, slot: int) -> None:
         """One chunk of ``slot``'s prefill.  The chunk holding the
         prompt's last token finishes through admit_final_chunk (first-
-        token select + activation); chunks past it never run."""
-        ch = self.prefill_chunk
-        rid, padded, plen, max_new, start = self._prefilling[slot]
+        token select + activation); chunks past it never run.  ``row`` is
+        max_len-shaped, so the compiled programs key only on the chunk
+        width — a prefix admission of any prefix length reuses them."""
+        rid, row, plen, max_new, start, ch = self._prefilling[slot]
         if start + ch < plen:  # a later chunk holds position plen-1
             self.state = prefill_chunk_jit(
                 self.params, self.state, self.config, jnp.int32(slot),
-                jnp.asarray(padded[start:start + ch]), jnp.int32(start))
-            self._prefilling[slot] = (rid, padded, plen, max_new, start + ch)
+                jnp.asarray(row[start:start + ch]), jnp.int32(start))
+            self._prefilling[slot] = (rid, row, plen, max_new, start + ch, ch)
         else:
             self.state = admit_final_chunk_jit(
                 self.params, self.state, self.config, jnp.int32(slot),
-                jnp.asarray(padded),
-                jnp.asarray(padded[start:start + ch]), jnp.int32(start),
+                jnp.asarray(row),
+                jnp.asarray(row[start:start + ch]), jnp.int32(start),
                 jnp.int32(plen), jnp.int32(rid), jnp.int32(max_new),
                 jnp.int32(self.eos_id), temperature=self.temperature,
                 top_k=self.top_k, key=self.key)
@@ -512,21 +607,46 @@ class ServingEngine:
         for slot in self._free_slots():
             if not self._queue:
                 break
-            rid, prompt, max_new = self._queue.pop(0)
-            # Smallest bucket covering the prompt: one compiled prefill
-            # per bucket length, chosen per admission.
+            rid, prompt, max_new, pfx = self._queue.pop(0)
+            # Smallest bucket covering the prompt/suffix: one compiled
+            # prefill per bucket length, chosen per admission.
             pad = next(b for b in self.buckets if b >= len(prompt))
-            padded = np.zeros((pad,), np.int32)
-            padded[: len(prompt)] = prompt
+            if pfx is not None:
+                # Prefix-cached admission: copy the prebuilt prefix KV
+                # into the slot (pure device copy), then prefill ONLY the
+                # suffix at start=P through the shared chunk/finisher
+                # machinery — one finisher per chunk width, regardless of
+                # prefix length (the row is max_len-shaped).  Unchunked
+                # engines treat the whole suffix bucket as one chunk.
+                ptoks, pcache = self._prefixes[pfx]
+                P = len(ptoks)
+                row = np.zeros((self.max_len,), np.int32)
+                row[:P] = ptoks
+                row[P:P + len(prompt)] = prompt
+                plen = P + len(prompt)
+                self.state = copy_prefix_jit(self.state, pcache,
+                                             jnp.int32(slot))
+                self.metrics["prefix_admits"] += 1
+                ch = (self.prefill_chunk
+                      if self.prefill_chunk and pad > self.prefill_chunk
+                      else pad)
+                self._prefilling[slot] = (rid, row, plen, max_new, P, ch)
+                self._advance_prefill(slot)
+                continue
             if self.prefill_chunk and pad > self.prefill_chunk:
                 # The BUCKET (not the prompt) decides: even a short prompt
                 # in a wide bucket would otherwise pay a whole-bucket
                 # prefill.  Reserve the slot and run its first chunk now
                 # (no dead tick); later chunks land one per tick so the
                 # other slots keep decoding.
-                self._prefilling[slot] = (rid, padded, len(prompt), max_new, 0)
+                row = np.zeros((self.max_len,), np.int32)
+                row[: len(prompt)] = prompt
+                self._prefilling[slot] = (rid, row, len(prompt), max_new, 0,
+                                          self.prefill_chunk)
                 self._advance_prefill(slot)
                 continue
+            padded = np.zeros((pad,), np.int32)
+            padded[: len(prompt)] = prompt
             self.state = admit_jit(
                 self.params, self.state, self.config,
                 jnp.int32(slot), jnp.asarray(padded),
