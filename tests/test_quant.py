@@ -256,3 +256,27 @@ def test_training_keeps_f32_masters():
     assert qp["layers"]["attn_norm"].dtype == jnp.float32
     with pytest.raises(KeyError):
         _ = qp["layers"]["wq"]["missing"]
+
+
+def test_quantized_params_checkpoint_roundtrip(tmp_path):
+    """Deployment flow: quantize once, save, restore onto a fresh
+    template, serve — restored int8/scale leaves are bit-identical and
+    the engine produces the same tokens."""
+    from tputopo.workloads.checkpoint import restore_params, save_params
+
+    params = _params()
+    qp = quantize_params(params)
+    save_params(tmp_path, qp)
+    template = quantize_params(_params(seed=1))  # different values, same tree
+    restored = restore_params(tmp_path, template)
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(qp),
+            jax.tree_util.tree_leaves_with_path(restored)):
+        assert pa == pb
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    prompt = jax.random.randint(jax.random.key(30), (2, 8), 0, CFG.vocab_size)
+    np.testing.assert_array_equal(
+        np.asarray(generate(qp, prompt, CFG, max_new=4)),
+        np.asarray(generate(restored, prompt, CFG, max_new=4)))
+    assert restore_params(tmp_path / "empty", template) is None

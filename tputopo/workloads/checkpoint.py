@@ -46,6 +46,36 @@ def latest_step(ckpt_dir: str | Path) -> int | None:
     return max(steps) if steps else None
 
 
+def _restore_tree(path: Path, target):
+    """Shared orbax restore: ``target`` supplies structure AND shardings."""
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(path, abstract)
+
+
+def save_params(ckpt_dir: str | Path, params: dict) -> None:
+    """Serving deployment: persist a parameter tree — raw f32 masters or
+    the int8-quantized serving tree (quantize once offline with
+    :func:`tputopo.workloads.quant.quantize_params`, serve many).  Any
+    pytree of arrays round-trips, {int8, scale} leaves included.
+    Overwrites a previous save (the re-quantize-and-redeploy flow saves
+    to the same path every time, unlike training's step_N dirs)."""
+    path = Path(ckpt_dir).absolute() / "params"
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, params, force=True)
+
+
+def restore_params(ckpt_dir: str | Path, target: dict) -> dict | None:
+    """Restore a parameter tree saved by :func:`save_params` into
+    ``target``'s structure and shardings (build ``target`` on the current
+    mesh — a quantized tree restores onto a quantized template).  Returns
+    None when nothing was saved."""
+    path = Path(ckpt_dir).absolute() / "params"
+    if not path.is_dir():
+        return None
+    return _restore_tree(path, target)
+
+
 def restore(ckpt_dir: str | Path, target: TrainState,
             step: int | None = None) -> TrainState | None:
     """Restore the latest (or given) step into ``target``'s sharded layout.
@@ -59,7 +89,4 @@ def restore(ckpt_dir: str | Path, target: TrainState,
         step = latest_step(ckpt_dir)
     if step is None:
         return None
-    path = Path(ckpt_dir).absolute() / f"step_{step}"
-    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
-    with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.restore(path, abstract)
+    return _restore_tree(Path(ckpt_dir).absolute() / f"step_{step}", target)
