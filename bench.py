@@ -454,6 +454,44 @@ def _chip_peak_flops() -> tuple[float | None, str]:
     return None, kind
 
 
+def _decode_slope_s(params, prompt, cfg, short: int, long: int,
+                    max_len: int, reps: int = 3) -> float:
+    """Hardened decode differencing, shared by bench_decode and
+    bench_moe: warm both window endpoints, min of ``reps`` timed runs
+    each, slope in seconds/step.  The int(...) forces a device-to-host
+    fetch (through this tunnel, block_until_ready returns before
+    execution finishes and would time the dispatch).  Use wide windows
+    (>= 160 steps) — narrow ones let one disturbed endpoint imply
+    unphysical >1 TB/s streams on this host."""
+    import time as _t
+
+    from tputopo.workloads.decode import generate_jit
+
+    def run(n):
+        int(generate_jit(params, prompt, cfg, max_new=n,
+                         max_len=max_len)[0, -1])
+        ts = []
+        for _ in range(reps):
+            t0 = _t.perf_counter()
+            int(generate_jit(params, prompt, cfg, max_new=n,
+                             max_len=max_len)[0, -1])
+            ts.append(_t.perf_counter() - t0)
+        return min(ts)
+
+    return (run(long) - run(short)) / (long - short)
+
+
+def _detect_generation() -> str:
+    """Cost-model generation key for the local chip (shared by the HBM,
+    decode, and MoE benches)."""
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    return ("v5e" if "v5 lite" in kind or "v5e" in kind
+            else "v6e" if "v6" in kind
+            else "v5p" if "v5" in kind else "v4")
+
+
 def _fwd_flops(c, batch: int, seq: int) -> float:
     """Required forward FLOPs (2*m*n*k per matmul; causal attention counted
     at the half the math actually needs, so a kernel that skips masked
@@ -684,10 +722,7 @@ def bench_hbm_gbps() -> dict | None:
 
         from tputopo.topology.generations import get_generation
 
-        kind0 = jax.devices()[0].device_kind.lower()
-        gen0 = ("v5e" if "v5 lite" in kind0 or "v5e" in kind0
-                else "v6e" if "v6" in kind0
-                else "v5p" if "v5" in kind0 else "v4")
+        gen0 = _detect_generation()
         spec = get_generation(gen0).hbm_gbps
         measured = None
         for _attempt in range(2):
@@ -889,23 +924,8 @@ def bench_decode(measured_hbm_gbps: float | None = None) -> dict | None:
         prompt = jnp.asarray(np.random.default_rng(0).integers(
             0, cfg.vocab_size, (batch, prompt_len)))
 
-        def timed_gen(p, toks, c_, n, mlen):
-            # int(...) forces a device-to-host fetch: through the tunnel,
-            # block_until_ready returns before execution finishes and
-            # would time the dispatch, not the decode.
-            int(generate_jit(p, toks, c_, max_new=n, max_len=mlen)[0, -1])
-            ts = []
-            for _ in range(3):
-                t0 = _t.perf_counter()
-                int(generate_jit(p, toks, c_, max_new=n,
-                                 max_len=mlen)[0, -1])
-                ts.append(_t.perf_counter() - t0)
-            return min(ts)
-
-        def run(p, n):
-            return timed_gen(p, prompt, cfg, n, prompt_len + long)
-
-        dt = (run(params, long) - run(params, short)) / (long - short)
+        dt = _decode_slope_s(params, prompt, cfg, short, long,
+                             prompt_len + long)
         if dt <= 0:
             # The same disturbed-endpoint failure the physics flag below
             # catches, in its extreme form — don't publish negative
@@ -915,18 +935,15 @@ def bench_decode(measured_hbm_gbps: float | None = None) -> dict | None:
             return None
         # Streamed bytes per decode step: every weight except the embed
         # table (gathered, not streamed) is read once — the shared
-        # accounting in quant.streamed_bytes (bf16 casts for matmul
-        # weights, f32 for norms and the uncast lm_head), so the bf16 and
-        # int8 legs of the A/B use one rule.
+        # accounting in quant.streamed_bytes (matmul weights incl. the
+        # lm_head at their hoisted bf16 casts, f32 for norms/router), so
+        # the bf16 and int8 legs of the A/B use one rule.
         from tputopo.workloads.quant import streamed_bytes
 
         streamed = streamed_bytes(params)
         from tputopo.topology.generations import get_generation
 
-        kind = jax.devices()[0].device_kind.lower()
-        gen = ("v5e" if "v5 lite" in kind or "v5e" in kind
-               else "v6e" if "v6" in kind
-               else "v5p" if "v5" in kind else "v4")
+        gen = _detect_generation()
         out = {
             "batch": batch,
             "decode_step_ms": round(dt * 1e3, 3),
@@ -978,7 +995,8 @@ def bench_decode(measured_hbm_gbps: float | None = None) -> dict | None:
         try:
             if qp is None:
                 raise RuntimeError("no quantized tree")
-            dt8 = (run(qp, long) - run(qp, short)) / (long - short)
+            dt8 = _decode_slope_s(qp, prompt, cfg, short, long,
+                                  prompt_len + long)
             if dt8 <= 0:
                 raise RuntimeError("non-positive int8 differencing slope")
             q_streamed = streamed_bytes(qp)
@@ -1005,14 +1023,11 @@ def bench_decode(measured_hbm_gbps: float | None = None) -> dict | None:
             lprompt_toks = jnp.asarray(np.random.default_rng(1).integers(
                 0, cfg.vocab_size, (lbatch, lprompt)))
 
-            def lrun(p, c_, n):
-                return timed_gen(p, lprompt_toks, c_, n, lprompt + long)
-
-            ldt16 = (lrun(params, lcfg, long) - lrun(params, lcfg, short)
-                     ) / (long - short)
+            ldt16 = _decode_slope_s(params, lprompt_toks, lcfg, short, long,
+                                    lprompt + long)
             lcfg8 = dataclasses.replace(lcfg, kv_dtype="int8")
-            ldt8 = (lrun(qp, lcfg8, long) - lrun(qp, lcfg8, short)
-                    ) / (long - short)
+            ldt8 = _decode_slope_s(qp, lprompt_toks, lcfg8, short, long,
+                                   lprompt + long)
             if ldt16 <= 0 or ldt8 <= 0:
                 raise RuntimeError("non-positive differencing slope")
             out["long_context"] = {
@@ -1029,6 +1044,102 @@ def bench_decode(measured_hbm_gbps: float | None = None) -> dict | None:
     except Exception as e:  # pragma: no cover - context only
         print(f"bench: decode skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
+        return None
+
+
+def bench_moe() -> dict | None:
+    """MoE on silicon with an in-run dense control: top-2-of-4 experts at
+    expert width F against a dense FFN of width 2F — equal ACTIVE FLOPs
+    per token — timed interleaved, so the ratio isolates what the
+    capacity-dispatch path (routing, one_hot dispatch/combine einsums)
+    costs over the plain MLP it replaces.  Decode compares the drop-free
+    serving mixture (which streams EVERY expert's tables per step, the
+    documented serving-semantics trade) against the dense decode stream.
+    TPU-only, never fatal."""
+    try:
+        import time as _t
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        if jax.devices()[0].platform != "tpu":
+            return None
+        from tputopo.workloads.decode import generate_jit
+        from tputopo.workloads.model import ModelConfig, init_params
+        from tputopo.workloads.moe import MoEConfig
+
+        base = dict(vocab_size=32768, d_model=2048, n_layers=4, n_heads=16,
+                    n_kv_heads=8, max_seq=2048, compute_dtype=jnp.bfloat16)
+        dense = ModelConfig(**base, d_ff=4096)
+        moe = ModelConfig(**base, d_ff=2048,
+                          moe=MoEConfig(n_experts=4, top_k=2))
+        batch, seq = 8, 2048
+        overhead = _measure_dispatch_overhead_s()
+        t_dense, t_moe, moe_over_dense = _measure_fwd_pair(
+            dense, moe, batch, seq, overhead_s=overhead)
+        # Active FLOPs are the dense twin's by construction (top_k * F ==
+        # 2F); MFU on the active basis is the honest MoE number.
+        flops = _fwd_flops(dense, batch, seq)
+        peak, _ = _chip_peak_flops()
+        out = {
+            "experts": 4, "top_k": 2, "expert_ff": 2048,
+            "model": "d2048 L4 E4top2 ff2048/expert vs dense ff4096",
+            "fwd_step_ms": round(t_moe * 1e3, 3),
+            "dense_equal_active_fwd_ms": round(t_dense * 1e3, 3),
+            "moe_over_dense_equal_active_flops": round(moe_over_dense, 3),
+            "fwd_tokens_per_s": round(batch * seq / t_moe),
+        }
+        if peak is not None:
+            out["active_mfu"] = round(flops / t_moe / peak, 3)
+
+        # Decode: drop-free mixture streams all E expert tables per step.
+        # Same hardened protocol as bench_decode (160-step window, 3 reps
+        # — the narrow-window form measured unphysical >1.5 TB/s here).
+        from tputopo.workloads.quant import streamed_bytes
+
+        prompt_len, short, long = 128, 8, 168
+        prompt = jnp.asarray(np.random.default_rng(2).integers(
+            0, 32768, (batch, prompt_len)))
+
+        def dt_for(cfg):
+            import dataclasses
+
+            c = dataclasses.replace(cfg, max_seq=prompt_len + long)
+            p = init_params(c, jax.random.key(0))
+            dt = _decode_slope_s(p, prompt, c, short, long,
+                                 prompt_len + long)
+            return dt, streamed_bytes(p)
+
+        from tputopo.topology.generations import get_generation
+
+        ddt, dbytes = dt_for(dense)
+        mdt, mbytes = dt_for(moe)
+        spec = get_generation(_detect_generation()).hbm_gbps
+        if ddt <= 0 or mdt <= 0:
+            print(f"bench: moe decode skipped: non-positive differencing "
+                  f"slope (dense {ddt * 1e3:.3f} / moe {mdt * 1e3:.3f} "
+                  "ms/step)", file=sys.stderr)
+        if ddt > 0 and mdt > 0:
+            out["decode"] = {
+                "decode_step_ms": round(mdt * 1e3, 3),
+                "decode_tokens_per_s": round(batch / mdt, 1),
+                "streamed_gb": round(mbytes / 1e9, 3),
+                "effective_stream_gbps": round(mbytes / mdt / 1e9, 1),
+                "dense_equal_active_step_ms": round(ddt * 1e3, 3),
+                "dense_streamed_gb": round(dbytes / 1e9, 3),
+                "moe_over_dense": round(mdt / ddt, 3),
+                "note": ("drop-free serving mixture streams all E expert "
+                         "tables per step (E/top_k x the active bytes)"),
+            }
+            worst = max(mbytes / mdt, dbytes / ddt) / 1e9
+            if worst > 1.15 * spec:
+                out["decode"]["timing_quality"] = (
+                    f"noisy: implied stream {worst:.0f} GB/s exceeds the "
+                    "HBM spec — differencing endpoints were disturbed")
+        return out
+    except Exception as e:  # pragma: no cover - context only
+        print(f"bench: moe skipped: {type(e).__name__}: {e}", file=sys.stderr)
         return None
 
 
@@ -1170,6 +1281,7 @@ def main() -> None:
                                                      strict=True),
             "workload_fwd": isolated("workload_mfu", bench_workload_mfu),
             "decode": isolated("decode", bench_decode, measured_hbm),
+            "moe": isolated("moe", bench_moe),
             "serving": isolated("serving", bench_serving),
             "hbm": hbm,
             "calibration": calibration,

@@ -90,7 +90,7 @@ def test_greedy_decode_token_parity():
 
 def test_streamed_bytes_roughly_halved():
     """int8 + f32-scales stream less than 55% of the bf16 accounting
-    (better than half: the f32 lm_head drops 4 bytes -> 1)."""
+    (matmul weights incl. the lm_head drop 2 bytes -> 1, plus scales)."""
     params = _params()
     qp = quantize_params(params)
     ratio = streamed_bytes(qp) / streamed_bytes(params)

@@ -358,8 +358,24 @@ def embed_tokens(params: dict, tokens: jax.Array, config: ModelConfig) -> jax.Ar
 
 
 def lm_head(params: dict, x: jax.Array, config: ModelConfig) -> jax.Array:
+    from tputopo.workloads.quant import is_quantized
+
     x = _rmsnorm(x, params["final_norm"], config.norm_eps)
-    logits = qdot(x.astype(jnp.float32), params["lm_head"])
+    w = params["lm_head"]
+    if is_quantized(w):
+        logits = qdot(x.astype(jnp.float32), w)
+    else:
+        # Stream the head at compute dtype with f32 accumulation: the f32
+        # master was measured streaming 4 B/elem inside the decode loop
+        # (0.29 ms of a 2.35 ms step on v5e — the head is the single
+        # largest table).  The cast is loop-invariant, so XLA hoists one
+        # bf16 copy out of the decode scan.  Numerics: this touches
+        # training/prefill too, but the old f32 x f32 dot already
+        # MULTIPLIED at bf16 (jax's default matmul precision on TPU), so
+        # the delta is operand rounding only — the logits still
+        # accumulate in f32.
+        logits = jnp.matmul(x, w.astype(config.compute_dtype),
+                            preferred_element_type=jnp.float32)
     return constrain(logits, "dp", "sp", None)
 
 

@@ -35,7 +35,7 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
-from tputopo.workloads.quant import deq, qdot
+from tputopo.workloads.quant import deq, is_quantized, qdot
 from tputopo.workloads.sharding import constrain
 
 
@@ -166,14 +166,22 @@ def moe_mlp_reference(x: jax.Array, p: dict, cfg) -> jax.Array:
     gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
     w = (jax.nn.one_hot(idx, m.n_experts) * gates[..., None]).sum(2)  # [B,T,E]
 
+    def wdot(x_, wt):
+        # Quantized leaves stream int8 via qdot; raw tables stream at
+        # COMPUTE dtype with f32 accumulation — leaving them f32 made the
+        # decode loop read 4 B/elem per step (measured on v5e), while the
+        # f32->bf16 cast of the stacked tables is loop-invariant, so XLA
+        # hoists one bf16 copy (params/2 extra HBM) out of the decode scan.
+        # Activations stay f32: the mixture's gating math is exact.
+        if is_quantized(wt):
+            return qdot(x_, wt)
+        return jnp.matmul(x_, wt.astype(cfg.compute_dtype),
+                          preferred_element_type=jnp.float32)
+
     def expert_step(acc, inp):
         wg, wu, wd, we = inp  # [D,F], [D,F], [F,D], [B,T,1]
-        # qdot upcasts ONE expert's tables inside the step (or streams
-        # them int8 when serving-quantized): upcasting the whole [E, ...]
-        # stacks outside the scan would materialize a full f32 copy of
-        # every expert at once — the bounded-memory point of the scan form.
-        h = jax.nn.silu(qdot(x32, wg)) * qdot(x32, wu)
-        return acc + we * qdot(h, wd), None
+        h = jax.nn.silu(wdot(x32, wg)) * wdot(x32, wu)
+        return acc + we * wdot(h, wd), None
 
     out, _ = jax.lax.scan(
         expert_step, jnp.zeros_like(x32),

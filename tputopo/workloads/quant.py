@@ -125,39 +125,37 @@ def fold_kv_scale(s: jax.Array) -> jax.Array:
     return jnp.moveaxis(s[..., 0], 1, -1)[:, :, None, None, :]
 
 
-def streamed_bytes(params: dict) -> int:
+def streamed_bytes(params: dict, compute_itemsize: int = 2) -> int:
     """Bytes a decode step streams from HBM for this parameter tree.
 
     Every weight except the embedding (gathered, O(B) rows) is read once
     per step: quantized leaves stream int8 + their f32 scales; raw matmul
-    weights stream at bf16 (the cast XLA hoists out of the decode scan);
-    the raw lm_head streams f32 (model.lm_head never casts it); norms are
-    f32.  Mirrors the accounting bench_decode uses for the ceiling.
+    weights — dense projections, MoE expert tables, the lm_head — stream
+    at the model's COMPUTE dtype (``compute_itemsize`` bytes: 2 for the
+    bf16 default; pass 4 for a compute_dtype=float32 model, whose casts
+    are no-ops), because the model consumes every one of them through a
+    cast-to-compute-dtype dot whose loop-invariant cast XLA hoists out of
+    the decode scan.  Norms and the router are consumed at f32.  Mirrors
+    the accounting bench_decode uses for the ceiling.
     """
-    def leaf_bytes(name: str, v, in_moe: bool) -> int:
+    matmul_names = _LAYER_WEIGHTS + ("lm_head",)
+
+    def leaf_bytes(name: str, v) -> int:
         if is_quantized(v):
             return v["int8"].size + v["scale"].size * 4
-        # Raw dense matmul weights stream as their bf16 casts (qdot's
-        # astype of the bf16 activations, which XLA hoists out of the
-        # decode scan).  Everything else is consumed at f32: norms, the
-        # raw lm_head, the MoE router — AND raw MoE expert tables, because
-        # the drop-free decode mixture contracts them against f32
-        # activations (moe_mlp_reference's x32), so their astype is a
-        # no-op on the f32 masters.
-        dense_bf16 = name in _LAYER_WEIGHTS and not in_moe
-        return v.size * (2 if dense_bf16 else 4)
+        return v.size * (compute_itemsize if name in matmul_names else 4)
 
     total = 0
 
-    def walk(tree: dict, in_moe: bool = False):
+    def walk(tree: dict):
         nonlocal total
         for k, v in tree.items():
             if isinstance(v, dict) and not is_quantized(v):
-                walk(v, in_moe=in_moe or k == "moe")
+                walk(v)
             else:
-                total += leaf_bytes(k, v, in_moe)
+                total += leaf_bytes(k, v)
 
     walk(params["layers"])
-    total += leaf_bytes("final_norm", params["final_norm"], False)
-    total += leaf_bytes("lm_head", params["lm_head"], False)
+    total += leaf_bytes("final_norm", params["final_norm"])
+    total += leaf_bytes("lm_head", params["lm_head"])
     return total
