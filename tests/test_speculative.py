@@ -1,0 +1,101 @@
+"""Speculative decoding (tputopo.workloads.speculative).
+
+The contract that matters is LOSSLESSNESS: greedy spec-decode must
+reproduce the target model's plain greedy decode token-for-token no
+matter how bad the draft is (a random-weight draft is the worst case —
+acceptance near zero — which makes it the strongest parity fixture).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tputopo.workloads.decode import generate
+from tputopo.workloads.model import ModelConfig, init_params
+from tputopo.workloads.quant import quantize_params
+from tputopo.workloads.speculative import draft_slice, spec_generate
+
+CFG = ModelConfig(vocab_size=64, d_model=32, n_layers=4, n_heads=4,
+                  n_kv_heads=2, d_ff=64, max_seq=96,
+                  compute_dtype=jnp.float32)
+
+
+def _params(seed=0):
+    return init_params(CFG, jax.random.key(seed))
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 5])
+@pytest.mark.parametrize("draft_layers", [1, 2])
+def test_lossless_vs_greedy_generate(gamma, draft_layers):
+    params = _params()
+    prompt = jax.random.randint(jax.random.key(1), (1, 7), 0, CFG.vocab_size)
+    want = np.asarray(generate(params, prompt, CFG, max_new=12))
+    got, stats = spec_generate(params, prompt, CFG, max_new=12,
+                               draft_layers=draft_layers, gamma=gamma)
+    np.testing.assert_array_equal(want, np.asarray(got))
+    assert int(stats["target_steps"]) >= 1
+    assert 0 <= int(stats["drafted_accepted"]) <= 12
+
+
+def test_perfect_draft_accepts_everything():
+    """Draft == target (all layers... not allowed; emulate by drafting
+    with the SAME depth via a 2-layer model whose draft is also 2 layers
+    is invalid — instead verify the bound: a draft that happens to agree
+    commits gamma+1 per target step, so target_steps can go as low as
+    ceil(max_new / (gamma+1)).  With draft_layers == n_layers - 1 on a
+    model whose last layer is ~identity-ish this is probabilistic, so
+    assert only the accounting identity: commits == max_new."""
+    params = _params()
+    prompt = jax.random.randint(jax.random.key(2), (1, 5), 0, CFG.vocab_size)
+    got, stats = spec_generate(params, prompt, CFG, max_new=9,
+                               draft_layers=3, gamma=4)
+    assert got.shape == (1, 5 + 9)
+    # Each target stream commits 1 correction + its accepted drafts, so
+    # target_steps + drafted_accepted == max_new — EXCEPT when the final
+    # step's acceptance run hits the budget cap and its correction token
+    # is never emitted, which overshoots the sum by exactly 1.
+    total = int(stats["target_steps"]) + int(stats["drafted_accepted"])
+    assert total in (9, 10), total
+
+
+def test_int8_spec_decode_lossless_vs_int8_greedy():
+    """The draft slice works on quantized {int8, scale} leaves (leading
+    layer axis everywhere) and int8 KV caches; parity holds against the
+    int8 greedy path."""
+    cfg8 = dataclasses.replace(CFG, kv_dtype="int8")
+    params = quantize_params(_params())
+    prompt = jax.random.randint(jax.random.key(3), (1, 6), 0, CFG.vocab_size)
+    want = np.asarray(generate(params, prompt, cfg8, max_new=8))
+    got, _ = spec_generate(params, prompt, cfg8, max_new=8,
+                           draft_layers=2, gamma=3)
+    np.testing.assert_array_equal(want, np.asarray(got))
+
+
+def test_draft_slice_validation_and_shapes():
+    params = _params()
+    dp, dc = draft_slice(params, CFG, 2)
+    assert dc.n_layers == 2
+    assert dp["layers"]["wq"].shape[0] == 2
+    assert dp["embed"] is params["embed"]  # shared, not copied
+    with pytest.raises(ValueError, match="draft_layers"):
+        draft_slice(params, CFG, 0)
+    with pytest.raises(ValueError, match="draft_layers"):
+        draft_slice(params, CFG, CFG.n_layers)
+    with pytest.raises(ValueError, match="single-sequence"):
+        spec_generate(params, jnp.zeros((2, 4), jnp.int32), CFG,
+                      max_new=2, draft_layers=1)
+
+
+def test_budget_edges():
+    """max_new smaller than gamma: commits are capped at the budget, the
+    output is still exactly the greedy sequence."""
+    params = _params()
+    prompt = jax.random.randint(jax.random.key(4), (1, 5), 0, CFG.vocab_size)
+    for max_new in (1, 2):
+        want = np.asarray(generate(params, prompt, CFG, max_new=max_new))
+        got, _ = spec_generate(params, prompt, CFG, max_new=max_new,
+                               draft_layers=1, gamma=5)
+        np.testing.assert_array_equal(want, np.asarray(got))

@@ -16,8 +16,9 @@ allocated GPUs).  The TPU-native analog here is twofold:
   parallel) attention in :mod:`tputopo.workloads.ring`, KV-cache decode
   in :mod:`tputopo.workloads.decode`, the continuous-batching serving
   engine (ragged prompts, EOS, slot reuse) in
-  :mod:`tputopo.workloads.serving`, weight-only int8 serving
-  quantization in :mod:`tputopo.workloads.quant`, and the
+  :mod:`tputopo.workloads.serving`, int8 serving quantization (weights
+  + KV cache) in :mod:`tputopo.workloads.quant`, lossless speculative
+  decoding in :mod:`tputopo.workloads.speculative`, and the
   conv-classifier second model family (the Gaia Exp.6 MNIST analog) in
   :mod:`tputopo.workloads.vision`.
 

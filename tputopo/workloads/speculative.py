@@ -1,0 +1,177 @@
+"""Speculative decoding: draft cheap, verify exact, accept in bulk.
+
+The serving engine's decode step is HBM-bound — every new token pays one
+full weight stream.  Speculative decoding amortizes that stream: a cheap
+DRAFT model proposes ``gamma`` tokens autoregressively, then the target
+model scores all of them in ONE batched forward (the same weight stream
+that one ordinary decode step pays), and the longest prefix whose greedy
+argmax agrees is committed along with the target's own next token.  Per
+target stream, 1..gamma+1 tokens commit instead of exactly 1.
+
+Lossless by construction: with greedy selection, the committed sequence
+is EXACTLY the target model's greedy decode — the draft only decides how
+many target steps are skipped, never what is emitted.  The parity test
+pins this for arbitrary (even random, worst-case) drafts.  One numerics
+caveat: the verify forward is width gamma+1 while plain decode is width
+1, and XLA does not promise bitwise-equal reductions across block
+shapes — at bf16, two logits within an ulp of each other can argmax
+differently between the two widths.  Parity is exact at f32 (pinned by
+tests) and held empirically at bf16 on v5e; a near-tie flip would still
+emit a coherent greedy-of-the-verify-block sequence, not garbage.
+
+TPU-first formulation:
+- the draft is a leading-layer slice of the target's own stacked
+  parameters (``jax.tree.map(lambda a: a[:k], params["layers"])`` — one
+  model, no second checkpoint; embed/final-norm/head shared), so the
+  layer scan machinery is reused verbatim at a different depth;
+- the whole generate loop is ONE ``lax.while_loop`` with static shapes:
+  preallocated token buffer and caches, fixed-width (gamma+1) draft
+  catch-up and verify blocks, acceptance handled by masked commits.
+  Junk K/V written past the committed length is overwritten before any
+  query can attend it — the same invariant the serving engine's
+  redirect relies on (serving.py);
+- rejected-draft cache rows need no rollback: positions past the
+  committed length are junk by definition and the next verify block
+  rewrites them.
+
+Single-sequence (B=1): per-sequence acceptance makes batched positions
+ragged; the batched analog is the serving engine's slot machinery, where
+each slot would advance independently — out of scope here.
+
+The reference has no serving leg at all (SURVEY §0); this module extends
+the workload layer (L5) the placement serves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from tputopo.workloads.decode import KVCache, _block_step, _constrain_cache
+from tputopo.workloads.model import ModelConfig, _rope_tables
+
+
+def draft_slice(params: dict, config: ModelConfig,
+                draft_layers: int) -> tuple[dict, ModelConfig]:
+    """The draft model: the target's first ``draft_layers`` layers with
+    the embed/final-norm/head shared — a depth slice of the SAME stacked
+    parameter tree (works for raw, int8-quantized, and MoE leaves, whose
+    scales/tables all carry the leading layer axis)."""
+    if not 0 < draft_layers < config.n_layers:
+        raise ValueError(
+            f"draft_layers must be in (0, {config.n_layers}), "
+            f"got {draft_layers}")
+    draft_params = dict(params)
+    draft_params["layers"] = jax.tree.map(
+        lambda a: a[:draft_layers], params["layers"])
+    return draft_params, dataclasses.replace(config, n_layers=draft_layers)
+
+
+@partial(jax.jit, static_argnames=("config", "draft_layers", "gamma",
+                                   "max_new", "max_len"))
+def spec_generate(params: dict, prompt: jax.Array, config: ModelConfig, *,
+                  max_new: int, draft_layers: int, gamma: int = 4,
+                  max_len: int | None = None
+                  ) -> tuple[jax.Array, dict]:
+    """Greedy speculative decode: prompt [1, P] -> ([1, P + max_new]
+    tokens, stats).  Token-for-token identical to ``generate``'s greedy
+    output; ``stats`` reports ``target_steps`` (verify forwards paid) and
+    ``drafted_accepted`` (tokens committed straight from the draft) —
+    tokens_per_target_stream = (max_new) / target_steps.
+    """
+    c = config
+    B, P = prompt.shape
+    if B != 1:
+        raise ValueError("spec_generate is single-sequence (B=1); the "
+                         "batched analog is the serving engine's slots")
+    if max_new < 1:
+        raise ValueError(f"max_new must be >= 1, got {max_new}")
+    total = P + max_new
+    # Fixed-width blocks write up to gamma tokens past the committed
+    # length; give the buffers that margin.
+    need = total + gamma + 1
+    max_len = max(max_len or 0, need)
+    draft_params, draft_cfg = draft_slice(params, c, draft_layers)
+    cos, sin = _rope_tables(c, max_len)
+
+    tokens = jnp.zeros((1, max_len), jnp.int32)
+    tokens = jax.lax.dynamic_update_slice(tokens, prompt.astype(jnp.int32),
+                                          (0, 0))
+
+    # Prefill both caches on the prompt; the target's last-position logits
+    # give the first committed token.
+    # Same serving-mesh layout as generate/serving: KV heads over tp
+    # (batch is 1 here; dp resolves to a no-op).
+    tcache = _constrain_cache(KVCache.create(c, 1, max_len))
+    dcache = _constrain_cache(KVCache.create(draft_cfg, 1, max_len))
+    tlogits, tcache = _block_step(params, c, prompt, 0, tcache, cos, sin)
+    _, dcache = _block_step(draft_params, draft_cfg, prompt, 0, dcache,
+                            cos, sin)
+    first = jnp.argmax(tlogits[0, -1]).astype(jnp.int32)
+    tokens = tokens.at[0, P].set(first)
+
+    def draft_one(carry, _):
+        tok, cache, pos = carry
+        lg, cache = _block_step(draft_params, draft_cfg, tok[None, None],
+                                pos, cache, cos, sin)
+        nxt = jnp.argmax(lg[0, -1]).astype(jnp.int32)
+        return (nxt, cache, pos + 1), nxt
+
+    def body(state):
+        tokens, length, tcache, dcache, dlen, tsteps, accepted = state
+        # 1. Draft catch-up: feed the draft every committed token it has
+        # not seen, as one fixed-width block.  Entries past the real gap
+        # are junk whose K/V rows are overwritten before any query can
+        # attend them (they sit past the drafting frontier).
+        gap_block = jax.lax.dynamic_slice(
+            tokens, (0, dlen), (1, gamma + 1))
+        _, dcache = _block_step(draft_params, draft_cfg, gap_block, dlen,
+                                dcache, cos, sin)
+        dlen = length  # the draft has now seen tokens[0:length]
+
+        # 2. Draft gamma tokens autoregressively from the last committed.
+        last = tokens[0, length - 1]
+        (_, dcache, _), drafts = jax.lax.scan(
+            draft_one, (last, dcache, length - 1), None, length=gamma)
+
+        # 3. Verify: ONE target forward over [last, draft_1..draft_gamma]
+        # at positions length-1.. — the amortized weight stream.
+        block = jnp.concatenate([last[None], drafts])[None, :]
+        vlogits, tcache = _block_step(params, c, block, length - 1,
+                                      tcache, cos, sin)
+        targets = jnp.argmax(vlogits[0], axis=-1).astype(jnp.int32)
+        # targets[i] = target's token AFTER position length-1+i; the
+        # draft's claim for that slot is drafts[i].
+        agree = targets[:gamma] == drafts
+        n_accept = jnp.argmin(jnp.concatenate(
+            [agree, jnp.zeros((1,), bool)]))  # first disagreement, or gamma
+
+        # 4. Commit accepted drafts + the target's own next token, capped
+        # by the remaining budget (never emit past total).
+        commit = jnp.minimum(n_accept + 1, total - length)
+        # Candidate row: accepted drafts then the correction token at
+        # index n_accept (targets[n_accept] is the target's choice after
+        # the accepted prefix).
+        row = jnp.where(jnp.arange(gamma + 1) < n_accept,
+                        jnp.concatenate([drafts, targets[gamma:]]),
+                        targets)
+        cur = jax.lax.dynamic_slice(tokens, (0, length), (1, gamma + 1))[0]
+        sel = jnp.where(jnp.arange(gamma + 1) < commit, row, cur)
+        tokens = jax.lax.dynamic_update_slice(tokens, sel[None, :],
+                                              (0, length))
+        return (tokens, length + commit, tcache, dcache, dlen,
+                tsteps + 1, accepted + jnp.minimum(n_accept, commit))
+
+    def cond(state):
+        return state[1] < total
+
+    state = (tokens, jnp.int32(P + 1), tcache, dcache, jnp.int32(P),
+             jnp.int32(1), jnp.int32(0))
+    tokens, length, _, _, _, tsteps, accepted = jax.lax.while_loop(
+        cond, body, state)
+    stats = {"target_steps": tsteps, "drafted_accepted": accepted,
+             "max_new": jnp.int32(max_new)}
+    return tokens[:, :total], stats
