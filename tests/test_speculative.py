@@ -99,3 +99,84 @@ def test_budget_edges():
         got, _ = spec_generate(params, prompt, CFG, max_new=max_new,
                                draft_layers=1, gamma=5)
         np.testing.assert_array_equal(want, np.asarray(got))
+
+
+# ---- speculative continuous batching ---------------------------------------
+
+from tputopo.workloads.speculative import SpecServingEngine  # noqa: E402
+
+
+def _one_shot(params, prompt, max_new, cfg=CFG):
+    out = generate(params, jnp.asarray([prompt]), cfg, max_new=max_new)
+    return np.asarray(out)[0].tolist()
+
+
+def test_spec_engine_matches_per_request_generate():
+    """Slot-parallel speculative decoding is lossless per request: every
+    result equals the one-shot greedy generate, across ragged prompts,
+    mid-stream admission, and slot reuse."""
+    params = _params()
+    rng = np.random.default_rng(40)
+    prompts = [rng.integers(0, 64, (n,)).tolist() for n in (3, 6, 2, 5, 4)]
+    news = [6, 4, 7, 3, 5]
+    eng = SpecServingEngine(params, CFG, slots=2, max_len=24, prompt_pad=6,
+                            draft_layers=2, gamma=3)
+    ids = [eng.submit(p, max_new=m) for p, m in zip(prompts, news)]
+    results = eng.run()
+    for rid, p, m in zip(ids, prompts, news):
+        assert results[rid] == _one_shot(params, p, m), (rid, len(p), m)
+    assert eng.metrics["decode_steps"] >= 1
+    assert eng.metrics["drafted_accepted"] >= 0
+
+
+def test_spec_engine_eos_early_exit():
+    """An EOS inside an ACCEPTED run must stop the slot there, exactly
+    like the one-shot reference truncated at its first EOS."""
+    params = _params()
+    rng = np.random.default_rng(41)
+    prompts = [rng.integers(0, 64, (4,)).tolist() for _ in range(4)]
+    max_new = 10
+    refs = [_one_shot(params, p, max_new) for p in prompts]
+    gen_tokens = [t for p, r in zip(prompts, refs) for t in r[len(p):]]
+    eos = gen_tokens[len(gen_tokens) // 2]
+    eng = SpecServingEngine(params, CFG, slots=2, max_len=24, prompt_pad=4,
+                            draft_layers=1, gamma=4, eos_id=eos)
+    ids = [eng.submit(p, max_new=max_new) for p in prompts]
+    results = eng.run()
+    stopped = 0
+    for rid, p, ref in zip(ids, prompts, refs):
+        gen = ref[len(p):]
+        cut = gen.index(eos) + 1 if eos in gen else len(gen)
+        assert results[rid] == p + gen[:cut], rid
+        stopped += cut < len(gen)
+    assert stopped >= 1, "probe failed to exercise EOS"
+
+
+def test_spec_engine_int8_stack():
+    """Quantized weights + int8 KV caches (target AND draft) through the
+    slotted speculative path: parity against the int8 one-shot."""
+    cfg8 = dataclasses.replace(CFG, kv_dtype="int8")
+    params = quantize_params(_params())
+    rng = np.random.default_rng(42)
+    prompts = [rng.integers(0, 64, (n,)).tolist() for n in (3, 5, 2)]
+    eng = SpecServingEngine(params, cfg8, slots=2, max_len=24, prompt_pad=5,
+                            draft_layers=2, gamma=2)
+    ids = [eng.submit(p, max_new=5) for p in prompts]
+    results = eng.run()
+    for rid, p in zip(ids, prompts):
+        assert results[rid] == _one_shot(params, p, 5, cfg8), rid
+
+
+def test_spec_engine_accounting():
+    """decode_steps counts target streams; committed tokens per request
+    sum to the budgets, and drafted_accepted never exceeds them."""
+    params = _params()
+    rng = np.random.default_rng(43)
+    prompts = [rng.integers(0, 64, (4,)).tolist() for _ in range(3)]
+    eng = SpecServingEngine(params, CFG, slots=3, max_len=24, prompt_pad=4,
+                            draft_layers=3, gamma=2)
+    ids = [eng.submit(p, max_new=6) for p in prompts]
+    results = eng.run()
+    emitted = sum(len(results[r]) - 4 for r in ids)
+    assert emitted == 3 * 6
+    assert 0 <= eng.metrics["drafted_accepted"] <= emitted

@@ -271,20 +271,26 @@ copy_prefix_jit = jax.jit(copy_prefix)
 # ---- the ragged decode step -------------------------------------------------
 
 def _apply_rope_at(x: jax.Array, cos_b: jax.Array, sin_b: jax.Array) -> jax.Array:
-    """RoPE for [B, 1, N, H] queries/keys with PER-SLOT positions:
-    cos_b/sin_b are [B, H/2] rows gathered at each slot's position."""
+    """RoPE for [B, T, N, H] queries/keys with PER-(slot, offset)
+    positions: cos_b/sin_b are [B, T, H/2] rows gathered at each slot's
+    own positions."""
     dt = x.dtype
     x = x.astype(jnp.float32)
     x1, x2 = jnp.split(x, 2, axis=-1)
-    cb = cos_b[:, None, None, :]
-    sb = sin_b[:, None, None, :]
+    cb = cos_b[:, :, None, :]
+    sb = sin_b[:, :, None, :]
     return jnp.concatenate([x1 * cb - x2 * sb, x1 * sb + x2 * cb],
                            axis=-1).astype(dt)
 
 
 def _write_kv_at(cache_l: jax.Array, kv: jax.Array, pos: jax.Array) -> jax.Array:
-    """Per-slot cache write: cache_l [B, S, KV, H] <- kv [B, 1, KV, H] at
-    position pos[b] (vmapped dynamic_update_slice -> one scatter)."""
+    """Per-slot T-wide cache write: cache_l [B, S, KV, H] <- kv
+    [B, T, KV, H] at positions pos[b]..pos[b]+T-1 (vmapped
+    dynamic_update_slice -> one scatter).  CONTRACT: callers must
+    guarantee pos[b] + T <= S for windows that matter — near the buffer
+    end, dynamic_update_slice silently CLAMPS the start to S - T and
+    would corrupt earlier rows (the speculative engine's buffer_margin
+    exists exactly so active slots never hit the clamp)."""
     return jax.vmap(
         lambda cb, kb, p: jax.lax.dynamic_update_slice_in_dim(
             cb, kb, p, axis=0))(cache_l, kv, pos)
@@ -293,11 +299,13 @@ def _write_kv_at(cache_l: jax.Array, kv: jax.Array, pos: jax.Array) -> jax.Array
 def _attend_ragged(q: jax.Array, ck: jax.Array, cv: jax.Array,
                    pos: jax.Array, group: int,
                    ck_s=None, cv_s=None) -> jax.Array:
-    """One query per slot at its own position: q [B, 1, N, H] against the
-    cache [B, S, KV, H]; slot b attends cache positions <= pos[b].  Same
-    grouped-GQA einsums as decode._attend_cached, including the exact
-    int8-cache scale folds (per key position into the logits, per value
-    position into the probabilities)."""
+    """T queries per slot, each slot at its OWN base position: q
+    [B, T, N, H] against the cache [B, S, KV, H]; slot b's query t sits
+    at position pos[b] + t and attends cache positions <= it (T=1 is the
+    plain continuous-batching step; T=gamma+1 is speculative verify).
+    Same grouped-GQA einsums as decode._attend_cached, including the
+    exact int8-cache scale folds (per key position into the logits, per
+    value position into the probabilities)."""
     B, T, N, H = q.shape
     KV = ck.shape[2]
     scale = 1.0 / (H ** 0.5)
@@ -306,7 +314,8 @@ def _attend_ragged(q: jax.Array, ck: jax.Array, cv: jax.Array,
     if ck_s is not None:
         s = s * fold_kv_scale(ck_s)
     k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 4)
-    s = jnp.where(k_pos <= pos[:, None, None, None, None], s, -1e30)
+    q_pos = (pos[:, None] + jnp.arange(T)[None, :])  # [B, T]
+    s = jnp.where(k_pos <= q_pos[:, None, None, :, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     if cv_s is not None:
         p = p * fold_kv_scale(cv_s)
@@ -314,52 +323,46 @@ def _attend_ragged(q: jax.Array, ck: jax.Array, cv: jax.Array,
     return out.reshape(B, T, N, H).astype(q.dtype)
 
 
-def decode_step(params: dict, state: DecodeState, config: ModelConfig,
-                eos_id: jax.Array, *, temperature: float = 0.0,
-                top_k: int | None = None,
-                key: jax.Array | None = None) -> DecodeState:
-    """One token for every active slot, each at its own position — the
-    continuous-batching hot loop, one compiled program for any mix of
-    positions/occupancy.  Idle slots compute masked no-ops."""
+def ragged_block(params: dict, config: ModelConfig, tokens: jax.Array,
+                 starts: jax.Array, cache: KVCache
+                 ) -> tuple[jax.Array, KVCache]:
+    """T tokens per slot, each slot at its OWN base position: tokens
+    [B, T] run positions starts[b]..starts[b]+T-1 through the stack ->
+    (logits [B, T, V], updated cache).  T=1 is the continuous-batching
+    decode step; T=gamma+1 is speculative serving's catch-up / verify
+    block.  Callers own the junk-window discipline: pass ``starts``
+    already redirected/clamped for inactive slots (writes are T-wide
+    per-slot windows)."""
     c = config
-    B, max_len = state.tokens.shape
+    B, T = tokens.shape
     group = c.n_heads // c.n_kv_heads
-    active = state.active
-    # The last held token (produced by admit/the previous step) has not
-    # been fed yet: feed it at position length-1.  Inactive slots write
-    # their junk K/V at max_len-1, NOT position 0: a slot mid-way through
-    # a CHUNKED prefill is still inactive, and a junk write at 0 would
-    # clobber its first chunk.  max_len-1 is always safe — it only
-    # becomes reachable (k_pos <= length-1) on the exact step whose real
-    # write overwrites it.
-    pos = jnp.where(active, jnp.maximum(state.length - 1, 0),
-                    state.tokens.shape[1] - 1)
-    tok = jnp.take_along_axis(state.tokens, pos[:, None], axis=1)  # [B, 1]
-
+    max_len = cache.k.shape[2]
     cos, sin = _rope_tables(c, max_len)
-    cos_b, sin_b = cos[pos], sin[pos]  # [B, H/2]
+    pos_bt = jnp.clip(starts[:, None] + jnp.arange(T)[None, :], 0,
+                      max_len - 1)
+    cos_bt, sin_bt = cos[pos_bt], sin[pos_bt]  # [B, T, H/2]
 
-    x = embed_tokens(params, tok, c)  # [B, 1, D]
+    x = embed_tokens(params, tokens, c)  # [B, T, D]
 
     def layer_step(carry, inp):
         x = carry
         layer, ck_l, cv_l, cks_l, cvs_l = inp
         h = _rmsnorm(x, layer["attn_norm"], c.norm_eps)
-        q = qdot(h, layer["wq"]).reshape(B, 1, c.n_heads, c.head_dim)
-        k = qdot(h, layer["wk"]).reshape(B, 1, c.n_kv_heads, c.head_dim)
-        v = qdot(h, layer["wv"]).reshape(B, 1, c.n_kv_heads, c.head_dim)
-        q = _apply_rope_at(q, cos_b, sin_b)
-        k = _apply_rope_at(k, cos_b, sin_b)
+        q = qdot(h, layer["wq"]).reshape(B, T, c.n_heads, c.head_dim)
+        k = qdot(h, layer["wk"]).reshape(B, T, c.n_kv_heads, c.head_dim)
+        v = qdot(h, layer["wv"]).reshape(B, T, c.n_kv_heads, c.head_dim)
+        q = _apply_rope_at(q, cos_bt, sin_bt)
+        k = _apply_rope_at(k, cos_bt, sin_bt)
         if cks_l is not None:
             k, ks = quantize_kv(k)
             v, vs = quantize_kv(v)
-            cks_l = _write_kv_at(cks_l, ks, pos)
-            cvs_l = _write_kv_at(cvs_l, vs, pos)
-        ck_l = _write_kv_at(ck_l, k, pos)
-        cv_l = _write_kv_at(cv_l, v, pos)
+            cks_l = _write_kv_at(cks_l, ks, starts)
+            cvs_l = _write_kv_at(cvs_l, vs, starts)
+        ck_l = _write_kv_at(ck_l, k, starts)
+        cv_l = _write_kv_at(cv_l, v, starts)
         q = constrain(q, "dp", None, "tp", None)
-        out = _attend_ragged(q, ck_l, cv_l, pos, group, cks_l, cvs_l)
-        out = out.reshape(B, 1, c.n_heads * c.head_dim)
+        out = _attend_ragged(q, ck_l, cv_l, starts, group, cks_l, cvs_l)
+        out = out.reshape(B, T, c.n_heads * c.head_dim)
         x = x + qdot(out, layer["wo"])
         h2 = _rmsnorm(x, layer["mlp_norm"], c.norm_eps)
         if c.moe is not None:
@@ -374,9 +377,33 @@ def decode_step(params: dict, state: DecodeState, config: ModelConfig,
 
     x, (ck, cv, cks, cvs) = jax.lax.scan(
         layer_step, x,
-        (params["layers"], state.cache.k, state.cache.v,
-         state.cache.k_scale, state.cache.v_scale))
-    logits = lm_head(params, x, c)[:, 0]  # [B, V]
+        (params["layers"], cache.k, cache.v, cache.k_scale, cache.v_scale))
+    logits = lm_head(params, x, c)
+    return logits, KVCache(k=ck, v=cv, k_scale=cks, v_scale=cvs)
+
+
+def decode_step(params: dict, state: DecodeState, config: ModelConfig,
+                eos_id: jax.Array, *, temperature: float = 0.0,
+                top_k: int | None = None,
+                key: jax.Array | None = None) -> DecodeState:
+    """One token for every active slot, each at its own position — the
+    continuous-batching hot loop, one compiled program for any mix of
+    positions/occupancy.  Idle slots compute masked no-ops."""
+    c = config
+    B, max_len = state.tokens.shape
+    active = state.active
+    # The last held token (produced by admit/the previous step) has not
+    # been fed yet: feed it at position length-1.  Inactive slots write
+    # their junk K/V at max_len-1, NOT position 0: a slot mid-way through
+    # a CHUNKED prefill is still inactive, and a junk write at 0 would
+    # clobber its first chunk.  max_len-1 is always safe — it only
+    # becomes reachable (k_pos <= length-1) on the exact step whose real
+    # write overwrites it.
+    pos = jnp.where(active, jnp.maximum(state.length - 1, 0),
+                    state.tokens.shape[1] - 1)
+    tok = jnp.take_along_axis(state.tokens, pos[:, None], axis=1)  # [B, 1]
+    logits, new_cache = ragged_block(params, c, tok, pos, state.cache)
+    logits = logits[:, 0]  # [B, V]
     nxt = _select(logits, temperature, top_k, key, state.step, jnp.int32)
 
     # Write-gate everything by activity; clamp the write index (a full
@@ -391,7 +418,7 @@ def decode_step(params: dict, state: DecodeState, config: ModelConfig,
     finished = active & ((nxt == eos_id) | (generated >= state.budget)
                          | (new_length >= max_len))
     return DecodeState(
-        cache=KVCache(k=ck, v=cv, k_scale=cks, v_scale=cvs),
+        cache=new_cache,
         tokens=new_tokens,
         length=new_length,
         prompt_len=state.prompt_len,
@@ -458,7 +485,8 @@ class ServingEngine:
                  temperature: float = 0.0, top_k: int | None = None,
                  key: jax.Array | None = None,
                  steps_per_tick: int = 1,
-                 prefill_chunk: int | None = None) -> None:
+                 prefill_chunk: int | None = None,
+                 buffer_margin: int = 0) -> None:
         buckets = ((prompt_pad,) if isinstance(prompt_pad, int)
                    else tuple(sorted(set(prompt_pad))))
         if not buckets or any(b < 1 for b in buckets):
@@ -488,7 +516,11 @@ class ServingEngine:
         self.key = key if key is not None else jax.random.key(0)
         self.steps_per_tick = steps_per_tick
         self.prefill_chunk = prefill_chunk
-        self.state = init_state(config, slots, max_len)
+        # buffer_margin: extra cache/token rows past the logical max_len
+        # (which still bounds submissions) for subclasses whose device
+        # programs write fixed-width windows at the frontier — the
+        # speculative engine's gamma+1 verify block must never clamp.
+        self.state = init_state(config, slots, max_len + buffer_margin)
         # (id, prompt-or-suffix, max_new, prefix id or None)
         self._queue: list[tuple[int, list[int], int, int | None]] = []
         # slot -> (rid, max_len row, prompt_len, max_new, next start, chunk)
@@ -655,6 +687,12 @@ class ServingEngine:
                 temperature=self.temperature, top_k=self.top_k,
                 key=self.key)
             self.metrics["admitted"] += 1
+            self._post_admit(slot, padded, len(prompt))
+
+    def _post_admit(self, slot: int, padded: np.ndarray,
+                    prompt_len: int) -> None:
+        """Hook for subclasses that keep auxiliary per-slot device state
+        (the speculative engine prefills its draft cache here)."""
 
     def _harvest(self) -> None:
         done = np.asarray(self.state.done)
@@ -680,26 +718,30 @@ class ServingEngine:
 
     def step(self) -> None:
         """One engine tick: harvest finished -> advance chunked prefills
-        by one chunk each -> admit from the queue -> ``steps_per_tick``
-        batched decode steps (if anything is active), chained device-side
-        so the tick costs one dispatch."""
+        by one chunk each -> admit from the queue -> one decode tick (if
+        anything is active).  Subclasses replace only ``_decode_tick``."""
         self._harvest()
         if self._prefilling:
             self._advance_prefills()
         self._admit_pending()
         if bool(np.asarray(self.state.active).any()):
-            if self.steps_per_tick == 1:
-                self.state = decode_step_jit(
-                    self.params, self.state, self.config,
-                    jnp.int32(self.eos_id), temperature=self.temperature,
-                    top_k=self.top_k, key=self.key)
-            else:
-                self.state = decode_steps_jit(
-                    self.params, self.state, self.config,
-                    jnp.int32(self.eos_id), n=self.steps_per_tick,
-                    temperature=self.temperature, top_k=self.top_k,
-                    key=self.key)
-            self.metrics["decode_steps"] += self.steps_per_tick
+            self._decode_tick()
+
+    def _decode_tick(self) -> None:
+        """``steps_per_tick`` batched decode steps, chained device-side
+        so the tick costs one dispatch."""
+        if self.steps_per_tick == 1:
+            self.state = decode_step_jit(
+                self.params, self.state, self.config,
+                jnp.int32(self.eos_id), temperature=self.temperature,
+                top_k=self.top_k, key=self.key)
+        else:
+            self.state = decode_steps_jit(
+                self.params, self.state, self.config,
+                jnp.int32(self.eos_id), n=self.steps_per_tick,
+                temperature=self.temperature, top_k=self.top_k,
+                key=self.key)
+        self.metrics["decode_steps"] += self.steps_per_tick
 
     def run(self, max_steps: int = 100_000) -> dict[int, list[int]]:
         """Drive until queue and slots drain; returns {request id: tokens

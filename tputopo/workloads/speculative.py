@@ -16,8 +16,12 @@ caveat: the verify forward is width gamma+1 while plain decode is width
 1, and XLA does not promise bitwise-equal reductions across block
 shapes — at bf16, two logits within an ulp of each other can argmax
 differently between the two widths.  Parity is exact at f32 (pinned by
-tests) and held empirically at bf16 on v5e; a near-tie flip would still
-emit a coherent greedy-of-the-verify-block sequence, not garbage.
+tests).  Observed on v5e: raw bf16 weights held exact parity across 48
+tokens; int8 weights flipped ONE near-tie (top-2 logit gap 0.003 on
+|logits| ~3.5 — 0.1% relative), and the f32 recomputation sided with
+the WIDER verify block, i.e. the speculative path was the more accurate
+of the two.  A flip emits a coherent greedy-of-the-verify-block
+sequence, never garbage.
 
 TPU-first formulation:
 - the draft is a leading-layer slice of the target's own stacked
@@ -34,9 +38,12 @@ TPU-first formulation:
   committed length are junk by definition and the next verify block
   rewrites them.
 
-Single-sequence (B=1): per-sequence acceptance makes batched positions
-ragged; the batched analog is the serving engine's slot machinery, where
-each slot would advance independently — out of scope here.
+Two forms: :func:`spec_generate` (single sequence, one while_loop) and
+:class:`SpecServingEngine` — speculative CONTINUOUS BATCHING over the
+serving engine's slots, where every slot drafts and accepts
+independently at its own position through one ragged verify forward
+(serving.ragged_block, the T-wide primitive), with per-slot EOS and
+budget caps.
 
 The reference has no serving leg at all (SURVEY §0); this module extends
 the workload layer (L5) the placement serves.
@@ -50,8 +57,28 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from tputopo.workloads.decode import KVCache, _block_step, _constrain_cache
 from tputopo.workloads.model import ModelConfig, _rope_tables
+from tputopo.workloads.serving import DecodeState, ServingEngine, ragged_block
+
+
+def _acceptance_row(drafts: jax.Array, targets: jax.Array
+                    ) -> tuple[jax.Array, jax.Array]:
+    """The speculative acceptance rule, shared by both paths: drafts
+    [B, gamma] vs targets [B, gamma+1] (the target's argmax AFTER each
+    verify position) -> (row [B, gamma+1], n_accept [B]).  ``row`` is
+    the commit candidate — the accepted draft prefix, then the target's
+    own correction token at index n_accept."""
+    B, gamma = drafts.shape
+    agree = targets[:, :gamma] == drafts
+    n_accept = jnp.argmin(
+        jnp.concatenate([agree, jnp.zeros((B, 1), bool)], axis=1), axis=1)
+    row = jnp.where(jnp.arange(gamma + 1)[None, :] < n_accept[:, None],
+                    jnp.concatenate([drafts, targets[:, gamma:]], axis=1),
+                    targets)
+    return row, n_accept
 
 
 def draft_slice(params: dict, config: ModelConfig,
@@ -144,20 +171,14 @@ def spec_generate(params: dict, prompt: jax.Array, config: ModelConfig, *,
                                       tcache, cos, sin)
         targets = jnp.argmax(vlogits[0], axis=-1).astype(jnp.int32)
         # targets[i] = target's token AFTER position length-1+i; the
-        # draft's claim for that slot is drafts[i].
-        agree = targets[:gamma] == drafts
-        n_accept = jnp.argmin(jnp.concatenate(
-            [agree, jnp.zeros((1,), bool)]))  # first disagreement, or gamma
+        # shared acceptance rule yields the commit row (accepted draft
+        # prefix + the target's correction at index n_accept).
+        row, n_accept = _acceptance_row(drafts[None, :], targets[None, :])
+        row, n_accept = row[0], n_accept[0]
 
         # 4. Commit accepted drafts + the target's own next token, capped
         # by the remaining budget (never emit past total).
         commit = jnp.minimum(n_accept + 1, total - length)
-        # Candidate row: accepted drafts then the correction token at
-        # index n_accept (targets[n_accept] is the target's choice after
-        # the accepted prefix).
-        row = jnp.where(jnp.arange(gamma + 1) < n_accept,
-                        jnp.concatenate([drafts, targets[gamma:]]),
-                        targets)
         cur = jax.lax.dynamic_slice(tokens, (0, length), (1, gamma + 1))[0]
         sel = jnp.where(jnp.arange(gamma + 1) < commit, row, cur)
         tokens = jax.lax.dynamic_update_slice(tokens, sel[None, :],
@@ -175,3 +196,175 @@ def spec_generate(params: dict, prompt: jax.Array, config: ModelConfig, *,
     stats = {"target_steps": tsteps, "drafted_accepted": accepted,
              "max_new": jnp.int32(max_new)}
     return tokens[:, :total], stats
+
+
+# ---- speculative continuous batching ----------------------------------------
+
+@partial(jax.jit, static_argnames=("config", "draft_config", "gamma"))
+def spec_tick(params: dict, draft_params: dict, state, dcache: KVCache,
+              dlen: jax.Array, config: ModelConfig,
+              draft_config: ModelConfig, eos_id: jax.Array, gamma: int):
+    """One speculative tick for every active slot: draft catch-up ->
+    gamma per-slot draft tokens -> ONE ragged target verify block ->
+    per-slot acceptance, EOS/budget-capped commits.  Each slot commits
+    1..gamma+1 tokens per target weight stream; slots accept
+    independently (the whole point of doing this over the slotted
+    state — a lockstep batch would advance at the worst slot's rate).
+
+    Junk-window discipline (same invariant as decode_step): inactive
+    slots' windows are redirected to the buffer tail, and every junk
+    K/V row is either masked (k_pos <= q_pos) or overwritten before a
+    query can attend it.  The ServingEngine buffer carries a gamma+1
+    margin past the logical max_len so ACTIVE slots' verify windows
+    never clamp.
+
+    Returns (new state, new draft cache, new dlen, accepted_this_tick).
+    """
+    c = config
+    B, buf_len = state.tokens.shape
+    G1 = gamma + 1
+    active = state.active
+    safe = buf_len - G1  # junk-window base for inactive slots
+
+    # 1. Draft catch-up: feed the draft every committed token it has not
+    # seen (gap = length - dlen <= gamma+1 between ticks; admissions
+    # reset dlen via the draft prefill).  Junk entries past the real gap
+    # are overwritten by the draft steps below before any query attends
+    # them.
+    cu_start = jnp.where(active, jnp.minimum(dlen, safe), safe)
+    gap = jax.vmap(lambda row, s: jax.lax.dynamic_slice(row, (s,), (G1,)))(
+        state.tokens, cu_start)
+    _, dcache = ragged_block(draft_params, draft_config, gap, cu_start,
+                             dcache)
+    dlen = jnp.where(active, state.length, dlen)
+
+    # 2. Draft gamma tokens autoregressively (T=1 ragged steps).
+    pos0 = jnp.where(active, jnp.maximum(state.length - 1, 0), safe)
+    last = jnp.take_along_axis(state.tokens, pos0[:, None], axis=1)[:, 0]
+
+    def draft_one(carry, i):
+        tok, dc = carry
+        lg, dc = ragged_block(draft_params, draft_config, tok[:, None],
+                              pos0 + i, dc)
+        nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
+        return (nxt, dc), nxt
+
+    (_, dcache), drafts = jax.lax.scan(draft_one, (last, dcache),
+                                       jnp.arange(gamma))
+    drafts = drafts.T  # [B, gamma]
+
+    # 3. Verify: ONE target forward per slot over [last, d_1..d_gamma]
+    # at positions length-1.. — the amortized weight stream.
+    vblock = jnp.concatenate([last[:, None], drafts], axis=1)
+    vlogits, tcache = ragged_block(params, c, vblock, pos0, state.cache)
+    targets = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, G1]
+
+    # 4. Acceptance and commit row per slot (shared rule): accepted
+    # draft prefix, then the target's own correction token.
+    row, n_accept = _acceptance_row(drafts, targets)
+    generated = state.length - state.prompt_len
+    commit = jnp.minimum(n_accept + 1, state.budget - generated)
+    is_eos = row == eos_id
+    eos_idx = jnp.argmax(is_eos, axis=1)
+    has_eos = jnp.any(is_eos, axis=1)
+    commit = jnp.where(has_eos, jnp.minimum(commit, eos_idx + 1), commit)
+    commit = jnp.where(active, commit, 0)
+
+    # 5. Masked full-row token write (no window clamping to reason about).
+    idx = jnp.arange(buf_len)[None, :]
+    off = idx - state.length[:, None]
+    use = (off >= 0) & (off < commit[:, None]) & active[:, None]
+    gathered = jnp.take_along_axis(
+        row, jnp.clip(off, 0, gamma), axis=1)
+    new_tokens = jnp.where(use, gathered, state.tokens)
+
+    new_length = state.length + commit
+    new_generated = new_length - state.prompt_len
+    eos_committed = has_eos & (eos_idx + 1 <= commit)
+    finished = active & (eos_committed | (new_generated >= state.budget)
+                         | (new_length >= buf_len))
+    new_state = DecodeState(
+        cache=tcache,
+        tokens=new_tokens,
+        length=new_length,
+        prompt_len=state.prompt_len,
+        budget=state.budget,
+        seq_id=state.seq_id,
+        done=state.done | finished,
+        step=state.step + 1,
+    )
+    accepted = jnp.sum(jnp.where(active, jnp.minimum(n_accept, commit), 0))
+    return new_state, dcache, dlen, accepted
+
+
+@partial(jax.jit, static_argnames=("config",))
+def _draft_prefill(draft_params: dict, config: ModelConfig, dcache: KVCache,
+                   slot: jax.Array, prompt: jax.Array) -> KVCache:
+    """Prefill one slot of the draft cache on admission (the draft twin
+    of ServingEngine's admit — cache only, no token bookkeeping)."""
+    cos, sin = _rope_tables(config, dcache.k.shape[2])
+    sub = KVCache(*(
+        None if b is None else jax.lax.dynamic_slice_in_dim(b, slot, 1, axis=1)
+        for b in dcache))
+    _, filled = _block_step(draft_params, config, prompt[None, :], 0, sub,
+                            cos, sin)
+    return KVCache(*(
+        None if b is None else jax.lax.dynamic_update_slice_in_dim(
+            whole, b, slot, axis=1)
+        for whole, b in zip(dcache, filled)))
+
+
+class SpecServingEngine(ServingEngine):
+    """Speculative continuous batching: the slotted ServingEngine with a
+    draft model (a leading-layer slice of the same parameters) proposing
+    gamma tokens per tick and one ragged verify forward committing
+    1..gamma+1 tokens per slot per target stream.
+
+    A subclass that replaces exactly two hooks: ``_post_admit`` (prefill
+    the draft cache alongside every admission) and ``_decode_tick`` (the
+    speculative tick instead of plain decode steps) — admission, harvest,
+    queueing, and the run loop are the parent's.  Greedy-only (the
+    lossless guarantee; sampled speculative decoding needs rejection
+    sampling) and whole-bucket admission only (no chunked prefill; no
+    prefix caching — its draft-cache mirroring is future work).
+    """
+
+    def __init__(self, params: dict, config: ModelConfig, *, slots: int,
+                 max_len: int, prompt_pad, draft_layers: int,
+                 gamma: int = 4, eos_id: int = -1) -> None:
+        if gamma < 1:
+            raise ValueError(f"gamma must be >= 1, got {gamma}")
+        self.gamma = gamma
+        self.draft_params, self.draft_cfg = draft_slice(params, config,
+                                                        draft_layers)
+        # buffer_margin: a slot at the logical max_len still needs a
+        # non-clamping gamma+1 verify window (see _write_kv_at's
+        # contract); submissions stay bounded by the logical max_len.
+        super().__init__(params, config, slots=slots, max_len=max_len,
+                         prompt_pad=prompt_pad, eos_id=eos_id,
+                         buffer_margin=gamma + 1)
+        self._dcache = _constrain_cache(
+            KVCache.create(self.draft_cfg, slots, max_len + gamma + 1))
+        self._dlen = jnp.zeros((slots,), jnp.int32)
+        self.metrics["drafted_accepted"] = 0
+
+    def submit(self, prompt, max_new: int, prefix: int | None = None) -> int:
+        if prefix is not None:
+            raise ValueError("prefix caching is not supported with "
+                             "speculative serving (draft-cache mirroring "
+                             "is future work)")
+        return super().submit(prompt, max_new)
+
+    def _post_admit(self, slot: int, padded, prompt_len: int) -> None:
+        self._dcache = _draft_prefill(
+            self.draft_params, self.draft_cfg, self._dcache,
+            jnp.int32(slot), jnp.asarray(padded))
+        self._dlen = self._dlen.at[slot].set(prompt_len)
+
+    def _decode_tick(self) -> None:
+        self.state, self._dcache, self._dlen, accepted = spec_tick(
+            self.params, self.draft_params, self.state, self._dcache,
+            self._dlen, self.config, self.draft_cfg,
+            jnp.int32(self.eos_id), self.gamma)
+        self.metrics["decode_steps"] += 1  # target streams paid
+        self.metrics["drafted_accepted"] += int(accepted)
