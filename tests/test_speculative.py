@@ -180,3 +180,46 @@ def test_spec_engine_accounting():
     emitted = sum(len(results[r]) - 4 for r in ids)
     assert emitted == 3 * 6
     assert 0 <= eng.metrics["drafted_accepted"] <= emitted
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_spec_engine_randomized_schedules(seed):
+    """Property test: random prompt lengths, budgets, slot counts, draft
+    depths, and gammas — every request must reproduce its one-shot
+    greedy generate (failures replay via the seed)."""
+    rng = np.random.default_rng(200 + seed)
+    params = _params()
+    slots = int(rng.integers(1, 4))
+    gamma = int(rng.integers(1, 5))
+    draft_layers = int(rng.integers(1, CFG.n_layers))
+    n_req = int(rng.integers(3, 7))
+    prompts = [rng.integers(0, 64, (int(rng.integers(1, 7)),)).tolist()
+               for _ in range(n_req)]
+    news = [int(rng.integers(1, 8)) for _ in range(n_req)]
+    eng = SpecServingEngine(params, CFG, slots=slots, max_len=20,
+                            prompt_pad=6, draft_layers=draft_layers,
+                            gamma=gamma)
+    ids = [eng.submit(p, max_new=m) for p, m in zip(prompts, news)]
+    results = eng.run()
+    for rid, p, m in zip(ids, prompts, news):
+        assert results[rid] == _one_shot(params, p, m), \
+            (seed, rid, len(p), m, slots, gamma, draft_layers)
+
+
+def test_spec_engine_at_the_max_len_frontier():
+    """A slot whose budget runs the buffer to the logical max_len: the
+    verify window spans into the gamma+1 buffer margin, which must keep
+    it from clamping (clamping would corrupt earlier cache rows —
+    _write_kv_at's documented hazard).  Parity must hold to the last
+    token."""
+    params = _params()
+    rng = np.random.default_rng(44)
+    p = rng.integers(0, 64, (6,)).tolist()
+    max_len = 16
+    max_new = max_len - len(p)  # fills the logical buffer exactly
+    eng = SpecServingEngine(params, CFG, slots=1, max_len=max_len,
+                            prompt_pad=6, draft_layers=2, gamma=4)
+    rid = eng.submit(p, max_new=max_new)
+    results = eng.run()
+    assert results[rid] == _one_shot(params, p, max_new)
+    assert len(results[rid]) == max_len

@@ -99,21 +99,21 @@ def init_state(config: ModelConfig, slots: int, max_len: int) -> DecodeState:
 
 # ---- admission: ragged prefill into one slot --------------------------------
 
-def _slot_cache(state: DecodeState, slot: jax.Array) -> KVCache:
-    """The slot's cache slice, as a batch-1 cache the block prefill
+def _slot_cache(cache: KVCache, slot: jax.Array) -> KVCache:
+    """One slot's cache slice, as a batch-1 cache the block prefill
     understands.  Every leaf (incl. int8 scale buffers) shares the
     [L, slots, ...] layout, so one slice rule covers both formats."""
     return KVCache(*(
         None if b is None else jax.lax.dynamic_slice_in_dim(b, slot, 1, axis=1)
-        for b in state.cache))
+        for b in cache))
 
 
-def _merge_slot_cache(state: DecodeState, filled: KVCache,
+def _merge_slot_cache(cache: KVCache, filled: KVCache,
                       slot: jax.Array) -> KVCache:
     return KVCache(*(
         None if b is None else jax.lax.dynamic_update_slice_in_dim(
             whole, b, slot, axis=1)
-        for whole, b in zip(state.cache, filled)))
+        for whole, b in zip(cache, filled)))
 
 
 def _finish_admit(state: DecodeState, config: ModelConfig, new_cache: KVCache,
@@ -170,10 +170,10 @@ def admit(params: dict, state: DecodeState, config: ModelConfig,
     max_len = state.tokens.shape[1]
     cos, sin = _rope_tables(c, max_len)
     logits, filled = _block_step(params, c, prompt[None, :], 0,
-                                 _slot_cache(state, slot), cos, sin)
+                                 _slot_cache(state.cache, slot), cos, sin)
     last = jax.lax.dynamic_index_in_dim(logits[0], prompt_len - 1, axis=0,
                                         keepdims=False)
-    return _finish_admit(state, c, _merge_slot_cache(state, filled, slot),
+    return _finish_admit(state, c, _merge_slot_cache(state.cache, filled, slot),
                          slot, last, prompt, prompt_len, seq_id, budget,
                          eos_id, temperature, top_k, key)
 
@@ -193,8 +193,8 @@ def prefill_chunk(params: dict, state: DecodeState, config: ModelConfig,
     the cache, which is precisely what a whole-prompt prefill computes."""
     cos, sin = _rope_tables(config, state.tokens.shape[1])
     _, filled = _block_step(params, config, chunk[None, :], start,
-                            _slot_cache(state, slot), cos, sin)
-    return state._replace(cache=_merge_slot_cache(state, filled, slot))
+                            _slot_cache(state.cache, slot), cos, sin)
+    return state._replace(cache=_merge_slot_cache(state.cache, filled, slot))
 
 
 prefill_chunk_jit = jax.jit(prefill_chunk, static_argnames=("config",))
@@ -218,10 +218,10 @@ def admit_final_chunk(params: dict, state: DecodeState, config: ModelConfig,
     c = config
     cos, sin = _rope_tables(c, state.tokens.shape[1])
     logits, filled = _block_step(params, c, chunk[None, :], start,
-                                 _slot_cache(state, slot), cos, sin)
+                                 _slot_cache(state.cache, slot), cos, sin)
     last = jax.lax.dynamic_index_in_dim(logits[0], prompt_len - 1 - start,
                                         axis=0, keepdims=False)
-    return _finish_admit(state, c, _merge_slot_cache(state, filled, slot),
+    return _finish_admit(state, c, _merge_slot_cache(state.cache, filled, slot),
                          slot, last, prompt, prompt_len, seq_id, budget,
                          eos_id, temperature, top_k, key)
 
@@ -629,6 +629,10 @@ class ServingEngine:
                 top_k=self.top_k, key=self.key)
             del self._prefilling[slot]
             self.metrics["admitted"] += 1
+            # Every admission path fires the hook (the whole-bucket path
+            # fires it in _admit_pending): a subclass keeping auxiliary
+            # per-slot state must see chunked/prefix admissions too.
+            self._post_admit(slot, row, plen)
         self.metrics["prefill_chunks"] += 1
 
     def _advance_prefills(self) -> None:

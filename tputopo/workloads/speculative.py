@@ -61,7 +61,9 @@ import numpy as np
 
 from tputopo.workloads.decode import KVCache, _block_step, _constrain_cache
 from tputopo.workloads.model import ModelConfig, _rope_tables
-from tputopo.workloads.serving import DecodeState, ServingEngine, ragged_block
+from tputopo.workloads.serving import (DecodeState, ServingEngine,
+                                       _merge_slot_cache, _slot_cache,
+                                       ragged_block)
 
 
 def _acceptance_row(drafts: jax.Array, targets: jax.Array
@@ -155,14 +157,18 @@ def spec_generate(params: dict, prompt: jax.Array, config: ModelConfig, *,
         # attend them (they sit past the drafting frontier).
         gap_block = jax.lax.dynamic_slice(
             tokens, (0, dlen), (1, gamma + 1))
-        _, dcache = _block_step(draft_params, draft_cfg, gap_block, dlen,
-                                dcache, cos, sin)
+        cu_logits, dcache = _block_step(draft_params, draft_cfg, gap_block,
+                                        dlen, dcache, cos, sin)
+        # The first draft token is free — the catch-up block contains the
+        # last committed token's position, so its logits are already here.
+        d1 = jnp.argmax(cu_logits[0, length - 1 - dlen]).astype(jnp.int32)
         dlen = length  # the draft has now seen tokens[0:length]
 
-        # 2. Draft gamma tokens autoregressively from the last committed.
+        # 2. Draft the remaining gamma-1 tokens autoregressively.
         last = tokens[0, length - 1]
-        (_, dcache, _), drafts = jax.lax.scan(
-            draft_one, (last, dcache, length - 1), None, length=gamma)
+        (_, dcache, _), rest = jax.lax.scan(
+            draft_one, (d1, dcache, length), None, length=gamma - 1)
+        drafts = jnp.concatenate([d1[None], rest])
 
         # 3. Verify: ONE target forward over [last, draft_1..draft_gamma]
         # at positions length-1.. — the amortized weight stream.
@@ -234,24 +240,31 @@ def spec_tick(params: dict, draft_params: dict, state, dcache: KVCache,
     cu_start = jnp.where(active, jnp.minimum(dlen, safe), safe)
     gap = jax.vmap(lambda row, s: jax.lax.dynamic_slice(row, (s,), (G1,)))(
         state.tokens, cu_start)
-    _, dcache = ragged_block(draft_params, draft_config, gap, cu_start,
-                             dcache)
+    cu_logits, dcache = ragged_block(draft_params, draft_config, gap,
+                                     cu_start, dcache)
     dlen = jnp.where(active, state.length, dlen)
 
-    # 2. Draft gamma tokens autoregressively (T=1 ragged steps).
+    # 2. Draft gamma tokens autoregressively.  The FIRST draft token is
+    # free: the catch-up block always contains the last committed token's
+    # position (dlen <= length-1 <= dlen+gamma), so its logits are
+    # already in cu_logits — one draft forward saved per tick.
     pos0 = jnp.where(active, jnp.maximum(state.length - 1, 0), safe)
     last = jnp.take_along_axis(state.tokens, pos0[:, None], axis=1)[:, 0]
+    first_idx = jnp.clip(pos0 - cu_start, 0, gamma)
+    d1 = jnp.take_along_axis(
+        jnp.argmax(cu_logits, axis=-1).astype(jnp.int32),
+        first_idx[:, None], axis=1)[:, 0]
 
     def draft_one(carry, i):
         tok, dc = carry
         lg, dc = ragged_block(draft_params, draft_config, tok[:, None],
-                              pos0 + i, dc)
+                              pos0 + 1 + i, dc)
         nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
         return (nxt, dc), nxt
 
-    (_, dcache), drafts = jax.lax.scan(draft_one, (last, dcache),
-                                       jnp.arange(gamma))
-    drafts = drafts.T  # [B, gamma]
+    (_, dcache), rest = jax.lax.scan(draft_one, (d1, dcache),
+                                     jnp.arange(gamma - 1))
+    drafts = jnp.concatenate([d1[:, None], rest.T], axis=1)  # [B, gamma]
 
     # 3. Verify: ONE target forward per slot over [last, d_1..d_gamma]
     # at positions length-1.. — the amortized weight stream.
@@ -303,15 +316,9 @@ def _draft_prefill(draft_params: dict, config: ModelConfig, dcache: KVCache,
     """Prefill one slot of the draft cache on admission (the draft twin
     of ServingEngine's admit — cache only, no token bookkeeping)."""
     cos, sin = _rope_tables(config, dcache.k.shape[2])
-    sub = KVCache(*(
-        None if b is None else jax.lax.dynamic_slice_in_dim(b, slot, 1, axis=1)
-        for b in dcache))
-    _, filled = _block_step(draft_params, config, prompt[None, :], 0, sub,
-                            cos, sin)
-    return KVCache(*(
-        None if b is None else jax.lax.dynamic_update_slice_in_dim(
-            whole, b, slot, axis=1)
-        for whole, b in zip(dcache, filled)))
+    _, filled = _block_step(draft_params, config, prompt[None, :], 0,
+                            _slot_cache(dcache, slot), cos, sin)
+    return _merge_slot_cache(dcache, filled, slot)
 
 
 class SpecServingEngine(ServingEngine):
