@@ -185,6 +185,26 @@ def cmd_serve(args) -> int:
                       n_kv_heads=4, d_ff=512,
                       max_seq=args.prompt_len + args.max_new,
                       kv_dtype="int8" if args.int8 else "bf16")
+    # Flag validation BEFORE any device work (init/device_put/quantize).
+    if args.spec_draft_layers:
+        if not 0 < args.spec_draft_layers < cfg.n_layers:
+            print(f"error: --spec-draft-layers must be in "
+                  f"(0, {cfg.n_layers})", file=sys.stderr)
+            return 2
+        if args.spec_gamma < 1:
+            print("error: --spec-gamma must be >= 1", file=sys.stderr)
+            return 2
+        incompatible = [f for f, v in (("--prefix-len", args.prefix_len),
+                                       ("--prefill-chunk", args.prefill_chunk))
+                        if v]
+        if args.steps_per_tick != 8:  # non-default: would be silently ignored
+            incompatible.append("--steps-per-tick")
+        if incompatible:
+            print(f"error: --spec-draft-layers is incompatible with "
+                  f"{', '.join(incompatible)} (a speculative tick is one "
+                  "verify stream; draft-cache mirroring for prefix/chunked "
+                  "admission is future work)", file=sys.stderr)
+            return 2
     n = jax.device_count()
     plan = mesh_for_slice((n,), heads=cfg.n_kv_heads)
     params = init_params(cfg, jax.random.key(0))
@@ -195,11 +215,20 @@ def cmd_serve(args) -> int:
                         args.requests)
     max_len = args.prefix_len + args.prompt_len + args.max_new
     with shardlib.activate(plan):
-        eng = ServingEngine(params, cfg, slots=args.slots,
-                            max_len=max_len,
-                            prompt_pad=args.prompt_len,
-                            steps_per_tick=args.steps_per_tick,
-                            prefill_chunk=args.prefill_chunk)
+        if args.spec_draft_layers:
+            from tputopo.workloads.speculative import SpecServingEngine
+
+            eng = SpecServingEngine(params, cfg, slots=args.slots,
+                                    max_len=max_len,
+                                    prompt_pad=args.prompt_len,
+                                    draft_layers=args.spec_draft_layers,
+                                    gamma=args.spec_gamma)
+        else:
+            eng = ServingEngine(params, cfg, slots=args.slots,
+                                max_len=max_len,
+                                prompt_pad=args.prompt_len,
+                                steps_per_tick=args.steps_per_tick,
+                                prefill_chunk=args.prefill_chunk)
         pid = None
         if args.prefix_len:
             # Shared system-prompt demo: its KV computes once, every
@@ -213,7 +242,7 @@ def cmd_serve(args) -> int:
         dt = time.perf_counter() - t0
     base = args.prefix_len + np.asarray(lens)
     generated = sum(len(results[i]) - int(b) for i, b in zip(ids, base))
-    print(json.dumps({
+    out = {
         "requests": args.requests, "slots": args.slots, "mesh": plan.axes,
         "prompt_lens": f"{lens.min()}..{lens.max()}",
         "prefix_len": args.prefix_len,
@@ -222,7 +251,10 @@ def cmd_serve(args) -> int:
         "prefix_admits": eng.metrics["prefix_admits"],
         "tokens_per_s": round(generated / dt, 1),
         "wall_s": round(dt, 3),
-    }))
+    }
+    if args.spec_draft_layers:
+        out["drafted_accepted"] = eng.metrics["drafted_accepted"]
+    print(json.dumps(out))
     return 0 if len(results) == args.requests else 1
 
 
@@ -302,6 +334,12 @@ def main() -> int:
                         "(register_prefix) and every request reuses it")
     p.add_argument("--int8", action="store_true",
                    help="full int8 serving stack: weights + KV cache")
+    p.add_argument("--spec-draft-layers", type=int, default=0,
+                   help="speculative continuous batching: draft with this "
+                        "many leading layers, verify per tick (lossless "
+                        "greedy; reports drafted_accepted)")
+    p.add_argument("--spec-gamma", type=int, default=4,
+                   help="draft tokens per speculative tick")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("train-vision",
