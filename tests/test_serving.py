@@ -223,7 +223,7 @@ def test_bucketed_prefill_parity_and_trace_count():
         ServingEngine(params, CFG, slots=1, max_len=8, prompt_pad=())
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
 def test_randomized_schedules_match_per_request_generate(seed):
     """Property test: any mix of prompt lengths, budgets, slot counts,
     tick chunking, and buckets must reproduce per-request generate
@@ -439,3 +439,69 @@ def test_unregister_prefix():
         eng.unregister_prefix(pid)
     with pytest.raises(ValueError, match="unknown prefix"):
         eng.submit([1], max_new=2, prefix=pid)
+
+
+def test_ragged_block_matches_block_step_on_aligned_positions():
+    """The T-wide ragged primitive at UNIFORM positions must equal the
+    batch block step (decode._block_step): same logits, same cache —
+    pinning ragged_block directly rather than only through the engines."""
+    from tputopo.workloads.decode import KVCache, _block_step, _rope_tables
+    from tputopo.workloads.serving import ragged_block
+
+    params = _params()
+    B, T, max_len = 3, 4, 32
+    toks = np.random.default_rng(30).integers(0, 64, (B, T))
+    toks = jnp.asarray(toks, jnp.int32)
+    start = 5
+    # Seed both caches with identical prefill at positions 0..4.
+    seed = jnp.asarray(np.random.default_rng(31).integers(0, 64, (B, 5)),
+                       jnp.int32)
+    cos, sin = _rope_tables(CFG, max_len)
+    _, cache_a = _block_step(params, CFG, seed, 0,
+                             KVCache.create(CFG, B, max_len), cos, sin)
+    cache_b = cache_a  # immutable arrays: one prefill seeds both runs
+
+    lg_a, cache_a = _block_step(params, CFG, toks, start, cache_a, cos, sin)
+    lg_b, cache_b = ragged_block(params, CFG, toks,
+                                 jnp.full((B,), start, jnp.int32), cache_b)
+    np.testing.assert_allclose(np.asarray(lg_a), np.asarray(lg_b),
+                               rtol=2e-5, atol=2e-5)
+    for a, b in zip(cache_a, cache_b):
+        if a is not None:
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_block_per_slot_positions_match_independent_runs():
+    """At DIFFERENT per-slot positions, each slot's output must equal a
+    batch-1 run of the same tokens at that position (raggedness is
+    bookkeeping, not math)."""
+    from tputopo.workloads.decode import KVCache, _block_step, _rope_tables
+    from tputopo.workloads.serving import ragged_block
+
+    params = _params()
+    B, T, max_len = 3, 3, 32
+    rng = np.random.default_rng(32)
+    starts = [4, 7, 2]
+    prefixes = [jnp.asarray(rng.integers(0, 64, (1, s)), jnp.int32)
+                for s in starts]
+    toks = jnp.asarray(rng.integers(0, 64, (B, T)), jnp.int32)
+    cos, sin = _rope_tables(CFG, max_len)
+
+    # Batched ragged run: per-slot caches prefilled at their own lengths.
+    singles = []
+    for b in range(B):
+        c1 = KVCache.create(CFG, 1, max_len)
+        _, c1 = _block_step(params, CFG, prefixes[b], 0, c1, cos, sin)
+        singles.append(c1)
+    cache = KVCache(*(
+        None if singles[0][i] is None else jnp.concatenate(
+            [singles[b][i] for b in range(B)], axis=1)
+        for i in range(len(singles[0]))))
+    lg, _ = ragged_block(params, CFG, toks,
+                         jnp.asarray(starts, jnp.int32), cache)
+    for b in range(B):
+        lg1, _ = _block_step(params, CFG, toks[b:b + 1], starts[b],
+                             singles[b], cos, sin)
+        np.testing.assert_allclose(np.asarray(lg[b]), np.asarray(lg1[0]),
+                                   rtol=2e-5, atol=2e-5)
