@@ -1202,14 +1202,190 @@ def bench_serving() -> dict | None:
         return None
 
 
+# ---- sub-bench isolation harness --------------------------------------------
+#
+# Every JAX-touching sub-bench runs in its OWN subprocess with a hard
+# timeout, behind a one-shot TPU pre-flight (also a subprocess).  Round 4's
+# lesson (BENCH_r04.json rc=124, parsed=null): the experimental TPU runtime
+# can wedge so that default-backend init blocks forever with ~0 CPU — an
+# in-process hang no try/except can catch.  The parent process therefore
+# NEVER initializes a JAX backend; the headline (scheduler p50, scale
+# trace, A/B gain) is pure CPU Python and must publish no matter what the
+# accelerator is doing (the fail-closed-but-LOUD posture, design.md:109 —
+# hanging silently is the one failure mode the design forbids).
+
+# Per-sub-bench wall-clock caps (seconds) and the whole-bench budget.
+# BENCH_BUDGET_S must undercut the driver's own timeout: a partial record
+# with rc=0 beats a complete one that never prints.
+BENCH_BUDGET_S_DEFAULT = 1500.0
+SUB_CAPS_S = {
+    "hbm": 240.0,
+    "workload_mfu": 420.0,
+    "decode": 420.0,
+    "moe": 300.0,
+    "serving": 480.0,
+}
+_TPU_SUBS = {
+    "hbm": lambda: bench_hbm_gbps(),
+    "workload_mfu": lambda: bench_workload_mfu(),
+    "moe": lambda: bench_moe(),
+    "serving": lambda: bench_serving(),
+}
+
+
+def _child_env() -> dict:
+    """Child env: persistent XLA compile cache so repeated sub-bench
+    processes (and repeated bench rounds) skip recompilation."""
+    import os
+
+    env = dict(os.environ)
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    return env
+
+
+def _tpu_preflight(timeout_s: float) -> dict:
+    """Initialize the default JAX backend in a throwaway subprocess.
+
+    Returns ok=True only if init completed within the timeout AND yielded a
+    non-CPU platform — the TPU sub-benches measure accelerator physics and
+    publish garbage (or minutes of waste) on a CPU backend.
+    """
+    import subprocess
+
+    # Tagged line so runtime log chatter on stdout can never be mistaken
+    # for the probe result (and a bad parse can never crash the parent:
+    # this function must not raise — the headline depends on it).
+    code = ("import jax; ds = jax.devices(); "
+            "print('TPUTOPO_PREFLIGHT', ds[0].platform, len(ds))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=max(1.0, timeout_s), env=_child_env())
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "detail": f"backend init did not return within "
+                                       f"{timeout_s:.0f}s (wedged runtime?)"}
+    except Exception as e:  # pragma: no cover - spawn failure
+        return {"ok": False, "detail": f"{type(e).__name__}: {e}"}
+    if proc.returncode != 0:
+        return {"ok": False,
+                "detail": f"rc={proc.returncode}: {proc.stderr.strip()[-300:]}"}
+    parts: list[str] = []
+    for ln in proc.stdout.splitlines():
+        if ln.startswith("TPUTOPO_PREFLIGHT"):
+            parts = ln.split()[1:]
+    platform = parts[0] if parts else "?"
+    if platform == "cpu":
+        return {"ok": False, "platform": platform,
+                "detail": "no accelerator (default backend is cpu)"}
+    if not parts:
+        return {"ok": False, "detail": "probe printed no tagged result"}
+    return {"ok": True, "platform": platform,
+            "devices": int(parts[1]) if len(parts) > 1 and
+            parts[1].isdigit() else None}
+
+
+# The sub-bench child currently running, so the SIGTERM handler can kill
+# it instead of orphaning it on the accelerator (where a leftover process
+# can hold the runtime and poison the NEXT run's preflight).
+_current_child: list = [None]
+
+
+def _run_sub(name: str, timeout_s: float, extra: list[str]) -> dict | None:
+    """Run ``python bench.py --sub <name>`` with a hard timeout; parse the
+    last stdout line as its JSON result."""
+    import os
+    import subprocess
+
+    cmd = [sys.executable, os.path.abspath(__file__), "--sub", name, *extra]
+    t0 = time.monotonic()
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            env=_child_env())
+    _current_child[0] = proc
+    try:
+        stdout, stderr = proc.communicate(timeout=max(1.0, timeout_s))
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return {"error": f"timeout after {timeout_s:.0f}s",
+                "elapsed_s": round(time.monotonic() - t0, 1)}
+    finally:
+        _current_child[0] = None
+    if stderr:
+        sys.stderr.write(stderr[-2000:])
+    parsed = False
+    out = None
+    for line in reversed(stdout.splitlines()):
+        if line.strip():
+            try:
+                out = json.loads(line)
+            except ValueError:
+                out = {"error": f"bad sub output: {line.strip()[:160]}"}
+            parsed = True
+            break
+    if not parsed:
+        out = {"error": f"rc={proc.returncode}, empty stdout"}
+    if out is None:
+        # The sub-bench legitimately declined to report (e.g. hbm's
+        # "differencing unstable under host load") — same as the old
+        # in-process null, not an error.
+        return None
+    if isinstance(out, dict):
+        out.setdefault("elapsed_s", round(time.monotonic() - t0, 1))
+    return out
+
+
+def _sub_main(argv: list[str]) -> int:
+    """``--sub`` child entry: run one sub-bench, print ONE JSON line."""
+    name = argv[0] if argv else ""
+    if name == "decode":
+        hbm = None
+        if "--hbm" in argv:
+            hbm = float(argv[argv.index("--hbm") + 1])
+        fn = lambda: bench_decode(hbm)  # noqa: E731
+    elif name in _TPU_SUBS:
+        fn = _TPU_SUBS[name]
+    else:
+        print(json.dumps({"error": f"unknown sub-bench {name!r}"}))
+        return 2
+    try:
+        res = fn()
+    except SystemExit as e:
+        # Sub-benches reserve SystemExit for correctness violations — the
+        # parent propagates these into its own exit code.
+        print(json.dumps({"error": f"correctness: {e}"}))
+        return 3
+    except KeyboardInterrupt:
+        raise
+    except BaseException as e:
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+        return 1
+    print(json.dumps(res))
+    return 0
+
+
 def main() -> None:
-    """Headline first, extras fault-isolated (VERDICT r3 #1: a failing
-    extras sub-bench must never suppress the headline JSON line).  Exit
-    code: 0 normally — including when a latency gate reports "fail" as
-    data — and 1 ONLY when the headline itself could not be computed or an
-    extras sub-bench hit a correctness violation (its SystemExit is
-    recorded in the JSON, which still prints)."""
+    """Headline first, extras fault-isolated and hang-isolated.
+
+    Exit code: 0 normally — including when the TPU is unavailable or the
+    budget truncates extras — and 1 ONLY when the headline itself could not
+    be computed or a sub-bench hit a correctness violation (recorded in the
+    JSON, which still prints)."""
+    import os
+    import signal
+
+    t_start = time.monotonic()
+    try:
+        budget_s = float(os.environ.get("BENCH_BUDGET_S",
+                                        BENCH_BUDGET_S_DEFAULT))
+    except ValueError:
+        budget_s = BENCH_BUDGET_S_DEFAULT
+    deadline = t_start + budget_s
     correctness_failures: list[str] = []
+    printed = [False]
 
     def isolated(name: str, fn, *args, strict: bool = False):
         try:
@@ -1217,18 +1393,16 @@ def main() -> None:
         except KeyboardInterrupt:
             raise
         except SystemExit as e:
-            # Sub-benches reserve SystemExit for correctness violations
-            # (double-booking, non-contiguity, steady-state LISTs) and
-            # trace-parameterization errors — report AND flag rc.
+            # In-process sub-benches reserve SystemExit for correctness
+            # violations (double-booking, non-contiguity, steady-state
+            # LISTs) — report AND flag rc.
             correctness_failures.append(f"{name}: {e}")
             print(f"bench: {name} correctness failure: {e}", file=sys.stderr)
             return {"error": f"correctness: {e}"}
         except BaseException as e:
             # strict sub-benches are pure-Python correctness traces: ANY
             # crash there means the trace's invariants went unvalidated —
-            # flag rc.  Non-strict ones depend on accelerator hardware; a
-            # hiccup there loses a data point, headline still publishes,
-            # rc stays 0.
+            # flag rc.
             if strict:
                 correctness_failures.append(
                     f"{name}: {type(e).__name__}: {e}")
@@ -1238,10 +1412,85 @@ def main() -> None:
 
     sched = bench_scheduler()  # headline — if this dies, rc != 0 (nothing to publish)
     p50 = sched["p50_ms"]
+    extras: dict = {
+        "baseline": "Gaia topology-aware mean scheduling time 2700 ms (PDF Fig. 10)",
+        "p95_ms": round(sched["p95_ms"], 3),
+        "pods_scheduled": sched["pods_scheduled"],
+        "cluster": "fake v5p-128 (4x4x4 chips, 16 hosts)",
+        "placement_quality_vs_ideal": sched["quality_vs_ideal"],
+    }
+    out = {
+        "metric": "scheduler_sort_bind_p50_latency",
+        "value": round(p50, 3),
+        "unit": "ms",
+        # Gaia's topology-aware scheduler needed 2700 ms per pod (PDF Fig.10);
+        # ratio >1 = this framework decides that many times faster.
+        "vs_baseline": round(GAIA_SCHED_MS / p50, 1),
+        "extras": extras,
+    }
+
+    def emit(truncated: str | None = None) -> None:
+        if printed[0]:
+            return
+        printed[0] = True
+        if truncated:
+            extras["truncated"] = truncated
+        extras["budget"] = {
+            "budget_s": budget_s,
+            "spent_s": round(time.monotonic() - t_start, 1),
+        }
+        print(json.dumps(out), flush=True)
+
+    def on_term(signum, frame):  # pragma: no cover - signal path
+        # The driver's `timeout` sends SIGTERM before SIGKILL: publish
+        # whatever is complete rather than dying silently.  The parent
+        # never blocks in a JAX backend (subprocesses do), so this handler
+        # actually gets to run.  Kill any in-flight sub-bench child first —
+        # an orphan would keep holding the accelerator runtime.
+        child = _current_child[0]
+        if child is not None:
+            try:
+                child.kill()
+            except OSError:
+                pass
+        emit(f"SIGTERM after {time.monotonic() - t_start:.0f}s")
+        os._exit(1 if correctness_failures else 0)
+
+    try:
+        signal.signal(signal.SIGTERM, on_term)
+    except ValueError:  # pragma: no cover - non-main thread (tests)
+        pass
+
+    extras["scale"] = isolated("scale", bench_scale, strict=True)
+    extras["bandwidth_gain_vs_count_only"] = isolated(
+        "ab_gain", bench_ab_gain, strict=True)
+
+    preflight = _tpu_preflight(min(120.0, max(5.0,
+                                              deadline - time.monotonic())))
+    extras["tpu_preflight"] = preflight
+
+    def tpu_sub(name: str, extra_args: list[str] | None = None):
+        if not preflight.get("ok"):
+            return {"skipped": "tpu_unavailable",
+                    "detail": preflight.get("detail")}
+        rem = deadline - time.monotonic()
+        if rem < 45.0:
+            return {"skipped": f"budget_exhausted ({rem:.0f}s of "
+                               f"{budget_s:.0f}s left)"}
+        res = _run_sub(name, min(SUB_CAPS_S[name], rem - 15.0),
+                       extra_args or [])
+        if isinstance(res, dict) and \
+                str(res.get("error", "")).startswith("correctness:"):
+            correctness_failures.append(f"{name}: {res['error']}")
+        return res
+
     # HBM first: decode quotes its serving ceiling against the IN-RUN
     # measured bandwidth, and the calibration record (the deployable cost
-    # override closing design.md:47's TODO) derives from it.
-    hbm = isolated("hbm", bench_hbm_gbps)
+    # override closing design.md:47's TODO) derives from it.  Results land
+    # in extras the moment they exist, so a mid-run SIGTERM publishes
+    # everything already computed.
+    hbm = tpu_sub("hbm")
+    extras["hbm"] = hbm
     measured_hbm = (hbm or {}).get("measured_hbm_gbps") if isinstance(hbm, dict) else None
     calibration = None
     if measured_hbm:
@@ -1259,38 +1508,33 @@ def main() -> None:
             calibration = {
                 "cost_override": {gen: {"hbm_gbps": cal.hbm_gbps}},
                 "measured_vs_spec": measured_vs_spec(cal, gen),
+                # Provenance: which cost-model axes this record actually
+                # measured vs which remain spec-sheet values — so a
+                # deployer knows what the scorer's absolute numbers are
+                # worth (the design.md:47 lesson: never leave the weight
+                # table's provenance implicit).
+                "provenance": {
+                    "calibrated": ["hbm_gbps"],
+                    "spec_only": ["ici_link_gbps", "dcn_host_gbps",
+                                  "host_dma_gbps", "ici_hop_latency_us",
+                                  "dcn_latency_us"],
+                },
                 "note": "feed cost_override into ExtenderConfig.cost_overrides",
             }
         except Exception as e:
             calibration = {"error": f"{type(e).__name__}: {e}"}
-    out = {
-        "metric": "scheduler_sort_bind_p50_latency",
-        "value": round(p50, 3),
-        "unit": "ms",
-        # Gaia's topology-aware scheduler needed 2700 ms per pod (PDF Fig.10);
-        # ratio >1 = this framework decides that many times faster.
-        "vs_baseline": round(GAIA_SCHED_MS / p50, 1),
-        "extras": {
-            "baseline": "Gaia topology-aware mean scheduling time 2700 ms (PDF Fig. 10)",
-            "p95_ms": round(sched["p95_ms"], 3),
-            "pods_scheduled": sched["pods_scheduled"],
-            "cluster": "fake v5p-128 (4x4x4 chips, 16 hosts)",
-            "placement_quality_vs_ideal": sched["quality_vs_ideal"],
-            "scale": isolated("scale", bench_scale, strict=True),
-            "bandwidth_gain_vs_count_only": isolated("ab_gain", bench_ab_gain,
-                                                     strict=True),
-            "workload_fwd": isolated("workload_mfu", bench_workload_mfu),
-            "decode": isolated("decode", bench_decode, measured_hbm),
-            "moe": isolated("moe", bench_moe),
-            "serving": isolated("serving", bench_serving),
-            "hbm": hbm,
-            "calibration": calibration,
-        },
-    }
-    print(json.dumps(out))
+    extras["calibration"] = calibration
+    extras["workload_fwd"] = tpu_sub("workload_mfu")
+    extras["decode"] = tpu_sub(
+        "decode", ["--hbm", str(measured_hbm)] if measured_hbm else [])
+    extras["moe"] = tpu_sub("moe")
+    extras["serving"] = tpu_sub("serving")
+    emit()
     if correctness_failures:
         sys.exit(1)
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--sub":
+        sys.exit(_sub_main(sys.argv[2:]))
     main()

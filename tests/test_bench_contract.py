@@ -1,0 +1,147 @@
+"""The driver-artifact contract (VERDICT r4 #1/#2): bench.py prints its one
+headline JSON line with rc=0 even when the TPU runtime is absent or wedged,
+and ``dryrun_multichip`` never initializes a non-CPU backend.
+
+Rounds 3 and 4 published NOTHING (rc=1 gate suicide, then rc=124 hang on a
+wedged accelerator runtime) despite all the underlying work being healthy.
+These tests pin the survival contract so it cannot regress silently.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+@pytest.fixture
+def restore_sigterm():
+    prev = signal.getsignal(signal.SIGTERM)
+    yield
+    signal.signal(signal.SIGTERM, prev)
+
+
+def _stub_headline(monkeypatch):
+    monkeypatch.setattr(bench, "bench_scheduler", lambda: {
+        "p50_ms": 1.0, "p95_ms": 2.0, "pods_scheduled": 4,
+        "quality_vs_ideal": 1.0})
+    monkeypatch.setattr(bench, "bench_scale", lambda: {"stub": True})
+    monkeypatch.setattr(bench, "bench_ab_gain", lambda: 3.0)
+
+
+def test_headline_publishes_when_tpu_unavailable(monkeypatch, capsys,
+                                                 restore_sigterm):
+    """TPU preflight fails (the wedged-runtime case) -> every TPU sub-bench
+    is marked skipped, the headline still prints as exactly one JSON line,
+    and the exit code is 0."""
+    _stub_headline(monkeypatch)
+    monkeypatch.setattr(bench, "_tpu_preflight", lambda t: {
+        "ok": False, "detail": "stub: no accelerator"})
+
+    def boom(name, timeout_s, extra):
+        raise AssertionError("no sub-bench subprocess may run without TPU")
+
+    monkeypatch.setattr(bench, "_run_sub", boom)
+    bench.main()  # must NOT raise SystemExit: rc stays 0
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert out["metric"] == "scheduler_sort_bind_p50_latency"
+    assert out["value"] == 1.0
+    for sub in ("hbm", "decode", "moe", "serving", "workload_fwd"):
+        assert out["extras"][sub]["skipped"] == "tpu_unavailable"
+    assert out["extras"]["budget"]["budget_s"] > 0
+
+
+def test_budget_exhaustion_skips_but_still_publishes(monkeypatch, capsys,
+                                                     restore_sigterm):
+    """A spent budget marks the remaining TPU sub-benches skipped instead of
+    running them — the JSON line and rc=0 survive."""
+    _stub_headline(monkeypatch)
+    monkeypatch.setattr(bench, "_tpu_preflight",
+                        lambda t: {"ok": True, "platform": "stub"})
+    monkeypatch.setenv("BENCH_BUDGET_S", "0")
+
+    def boom(name, timeout_s, extra):
+        raise AssertionError("budget-exhausted sub-bench must not spawn")
+
+    monkeypatch.setattr(bench, "_run_sub", boom)
+    bench.main()
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert out["extras"]["decode"]["skipped"].startswith("budget_exhausted")
+
+
+def test_sub_correctness_failure_flags_exit_code(monkeypatch, capsys,
+                                                 restore_sigterm):
+    """A sub-bench correctness violation (error starting 'correctness:')
+    must surface as exit code 1 — but only AFTER the JSON line printed."""
+    _stub_headline(monkeypatch)
+    monkeypatch.setattr(bench, "_tpu_preflight",
+                        lambda t: {"ok": True, "platform": "stub"})
+    monkeypatch.setattr(bench, "_run_sub", lambda name, timeout_s, extra: {
+        "error": "correctness: stub violation"})
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 1
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    assert json.loads(lines[0])["extras"]["hbm"]["error"].startswith(
+        "correctness:")
+
+
+def test_sub_main_unknown_name_is_loud(capsys):
+    rc = bench._sub_main(["nonexistent"])
+    assert rc == 2
+    out = json.loads(capsys.readouterr().out.strip())
+    assert "unknown sub-bench" in out["error"]
+
+
+def test_parent_process_never_initializes_a_backend():
+    """bench.py's parent process must never touch a JAX backend — on a
+    wedged runtime that is an uncatchable hang.  Run the full main() in a
+    subprocess under a platform that ERRORS on backend init: if any parent
+    code path initializes the default backend, the run crashes; the
+    contract is it publishes the headline with rc=0."""
+    env = dict(os.environ)
+    # An unknown platform makes jax.devices() raise immediately — a loud,
+    # fast stand-in for the silent hang of a wedged runtime.
+    env["JAX_PLATFORMS"] = "definitely_not_a_platform"
+    env["BENCH_BUDGET_S"] = "90"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert out["metric"] == "scheduler_sort_bind_p50_latency"
+    assert not out["extras"]["tpu_preflight"]["ok"]
+
+
+def test_dryrun_multichip_is_cpu_only_and_hang_immune():
+    """MULTICHIP_r04 died because dryrun_multichip touched the default
+    backend before forcing CPU.  Pin the fix: under a default platform that
+    ERRORS on first touch (stand-in for one that hangs), the dry run must
+    still complete — proving it configures the CPU platform before any
+    backend init — and its tail must name the multislice leg (VERDICT r4
+    #4)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "definitely_not_a_platform"
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "dryrun_multichip OK" in proc.stdout
+    assert "multislice" in proc.stdout
