@@ -121,3 +121,45 @@ def test_health_metrics_state_policy(server):
     _, policy_raw = get(srv, "/policy")
     policy = json.loads(policy_raw)
     assert policy["extenders"][0]["prioritizeVerb"] == "sort"
+
+
+def test_state_served_from_informer_mirror_zero_api_lists():
+    """GET /state must ride the informer mirror like the verbs do
+    (nodeCacheCapable posture): a monitoring scraper polling it in steady
+    state causes ZERO API-server LISTs and zero informer relists."""
+    from tputopo.k8s.informer import Informer
+
+    api, _ = build_cluster()
+    informer = Informer(api, watch_timeout_s=2.0).start()
+    try:
+        informer.wait_synced()
+        config = ExtenderConfig()
+        sched = ExtenderScheduler(api, config, informer=informer)
+        srv = ExtenderHTTPServer(sched, config, port=0).start()
+        try:
+            get(srv, "/state")  # prime the state build once
+            informer_lists_before = informer.metrics["lists"]
+            api_lists = 0
+            real_list = api.list
+
+            def counting_list(*args, **kwargs):
+                nonlocal api_lists
+                api_lists += 1
+                return real_list(*args, **kwargs)
+
+            api.list = counting_list
+            try:
+                for _ in range(5):
+                    status, raw = get(srv, "/state")
+                    assert status == 200
+                    assert "fragmentation" in json.loads(raw)
+            finally:
+                api.list = real_list
+            assert api_lists == 0, "steady-state /state polls hit the API server"
+            assert informer.metrics["lists"] == informer_lists_before
+            assert informer.metrics["relists"] == 0
+            assert sched.metrics.counters.get("state_cache_hits", 0) >= 4
+        finally:
+            srv.stop()
+    finally:
+        informer.stop()

@@ -87,7 +87,14 @@ class _Handler(BaseHTTPRequestHandler):
             elif self.path == "/metrics":
                 self._send_text(200, self._render_metrics())
             elif self.path == "/state":
-                state = self.scheduler._state()
+                # Serve from the informer mirror exactly like the verbs do
+                # (nodeCacheCapable posture, design.md:102): a monitoring
+                # scraper polling /state must cost zero API LISTs in steady
+                # state, not an authoritative full-cluster sync per hit.
+                sched = self.scheduler
+                reader = (sched.informer if sched.informer is not None
+                          and sched.informer.synced else None)
+                state = sched._state(allow_cache=True, reader=reader)
                 self._send_json(200, {
                     "fragmentation": state.fragmentation_report(),
                     "decisions": self.scheduler.decisions[-20:],
