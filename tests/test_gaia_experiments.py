@@ -130,3 +130,117 @@ def test_exp5_latency_overhead_vs_naive_count_scheduler():
     # per-alloc here is well under 1 ms, and the bound's meaning is "inside
     # the reference's +200-1000 ms overhead envelope", not a perf claim.
     assert per_alloc_ms < 50.0, f"{per_alloc_ms:.1f} ms per allocation"
+
+
+# ---- Exp.1 distribution methodology over RANDOMIZED occupancy ---------------
+#
+# The staged fixtures above assert single-outcome distributions; the paper's
+# actual methodology (PDF SS IV Table I) was 500 repetitions over a LIVE
+# cluster state with ties allowed to split (227/273) but invalid choices
+# pinned at 0.  These tests adapt that to the torus: ~200 randomized
+# occupancy states per policy case, asserting zero invalid picks, recheck-
+# determinism per state, and sane tie-splitting across states.
+
+DIST_REPS = 200
+
+
+def _random_state(rng, spec: str):
+    alloc = Allocator(parse_topology(spec))
+    chips = list(alloc.topo.chips)
+    rng.shuffle(chips)
+    used = chips[:rng.randrange(0, int(len(chips) * 0.8) + 1)]
+    if used:
+        alloc.mark_used(used)
+    return alloc, set(used)
+
+
+def _fresh_twin(spec: str, used: set) -> Allocator:
+    twin = Allocator(parse_topology(spec))
+    if used:
+        twin.mark_used(sorted(used))
+    return twin
+
+
+def test_dist_singular_zero_invalid_over_random_states():
+    """k=1 over 200 random occupancies: every pick is a free chip, every
+    pick is reproducible from the same state, and choices spread over the
+    grid (ties split across states rather than pinning one coordinate)."""
+    import random
+
+    rng = random.Random(0xA11)
+    outcomes = Counter()
+    for _ in range(DIST_REPS):
+        alloc, used = _random_state(rng, "v5e:4x4:wrap=00")
+        p = alloc.find(1)
+        if p is None:
+            assert len(used) == alloc.topo.num_chips, "find(1) failed with free chips"
+            continue
+        (chip,) = p.chips
+        assert chip not in used, f"invalid pick: used chip {chip}"
+        twin = _fresh_twin("v5e:4x4:wrap=00", used)
+        assert twin.find(1).chips == p.chips, "pick not deterministic"
+        outcomes[chip] += 1
+    assert sum(outcomes.values()) >= DIST_REPS * 0.9
+    assert len(outcomes) > 1, "one coordinate absorbed every pick"
+    assert max(outcomes.values()) / sum(outcomes.values()) < 0.9
+
+
+def test_dist_link_pairs_adjacent_whenever_possible():
+    """k=2 over 200 random occupancies: whenever ANY ICI-adjacent free pair
+    exists, the pick must be one (the Link policy's 500/500 criterion);
+    picks are deterministic and duplicates never appear."""
+    import random
+
+    rng = random.Random(0xB22)
+    adjacent_available = 0
+    for _ in range(DIST_REPS):
+        alloc, used = _random_state(rng, "v5e:4x4:wrap=00")
+        topo = alloc.topo
+        free = [c for c in topo.chips if c not in used]
+        has_adj = any(topo.hop_distance(a, b) == 1
+                      for i, a in enumerate(free) for b in free[i + 1:])
+        p = alloc.find(2)
+        if p is None:
+            assert not has_adj or len(free) < 2
+            continue
+        a, b = p.chips
+        assert a != b and a not in used and b not in used
+        if has_adj:
+            adjacent_available += 1
+            assert topo.hop_distance(a, b) == 1, \
+                f"non-adjacent pair {p.chips} with adjacent pairs free"
+        twin = _fresh_twin("v5e:4x4:wrap=00", used)
+        assert twin.find(2).chips == p.chips
+    assert adjacent_available > DIST_REPS // 2  # the assertion actually bit
+
+
+def test_dist_box_contiguous_whenever_a_box_fits():
+    """k=4 over 200 random occupancies: whenever any free 4-chip box
+    (1x4/4x1/2x2) exists, the pick is a contiguous box; otherwise any
+    returned fallback must still be 4 distinct free chips."""
+    import random
+
+    rng = random.Random(0xC33)
+    box_available = 0
+    for _ in range(DIST_REPS):
+        alloc, used = _random_state(rng, "v5e:4x4:wrap=00")
+        free = {c for c in alloc.topo.chips if c not in used}
+
+        def box_fits():
+            for (dx, dy) in ((1, 4), (4, 1), (2, 2)):
+                for ox in range(4 - dx + 1):
+                    for oy in range(4 - dy + 1):
+                        if all((ox + i, oy + j) in free
+                               for i in range(dx) for j in range(dy)):
+                            return True
+            return False
+
+        p = alloc.find(4)
+        if p is None:
+            continue
+        assert len(set(p.chips)) == 4 and set(p.chips) <= free
+        if box_fits():
+            box_available += 1
+            assert p.is_contiguous_box, \
+                f"blob {p.chips} while a free box existed"
+    assert box_available > DIST_REPS // 3
