@@ -20,7 +20,9 @@ allocated GPUs).  The TPU-native analog here is twofold:
   + KV cache) in :mod:`tputopo.workloads.quant`, lossless speculative
   decoding in :mod:`tputopo.workloads.speculative`, and the
   conv-classifier second model family (the Gaia Exp.6 MNIST analog) in
-  :mod:`tputopo.workloads.vision`.
+  :mod:`tputopo.workloads.vision`.  A second context-parallel strategy —
+  all-to-all (Ulysses-style) head re-sharding — lives in
+  :mod:`tputopo.workloads.ulysses`, selected via ``ModelConfig.sp_impl``.
 
 :mod:`tputopo.workloads.sharding` is the bridge between the scheduler and
 JAX: it turns a scheduled slice shape (a `Placement` from

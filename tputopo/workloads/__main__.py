@@ -61,7 +61,8 @@ def cmd_train(args) -> int:
               "over the ep axis and waste those chips)", file=sys.stderr)
         return 2
     config = ModelConfig(vocab_size=2048, d_model=256, n_layers=4, n_heads=8,
-                         n_kv_heads=4, d_ff=512, max_seq=args.seq, moe=moe)
+                         n_kv_heads=4, d_ff=512, max_seq=args.seq, moe=moe,
+                         sp_impl=getattr(args, "sp_impl", "ring"))
     plan = mesh_for_slice((n,), heads=config.n_heads, pp=args.pp, ep=args.ep,
                           sp=args.sp, tp=args.tp)
     if config.n_layers % plan.axes["pp"]:
@@ -295,7 +296,13 @@ def main() -> int:
     p.add_argument("--tp", type=int, default=None,
                    help="tensor-parallel degree (default: policy)")
     p.add_argument("--sp", type=int, default=None,
-                   help="sequence-parallel degree (ring attention)")
+                   help="sequence-parallel degree (context parallelism)")
+    p.add_argument("--sp-impl", choices=("ring", "a2a"), default="ring",
+                   help="context-parallel strategy: 'ring' rotates K/V "
+                        "over ICI neighbors (max context length); 'a2a' "
+                        "re-shards seq->heads with one all_to_all each "
+                        "way (full-sequence flash locally; needs sp to "
+                        "divide the per-tp-shard head counts)")
     p.add_argument("--pp", type=int, default=1,
                    help="pipeline stages (SPMD GPipe)")
     p.add_argument("--ep", type=int, default=1,
@@ -336,8 +343,10 @@ def main() -> int:
                    help="full int8 serving stack: weights + KV cache")
     p.add_argument("--spec-draft-layers", type=int, default=0,
                    help="speculative continuous batching: draft with this "
-                        "many leading layers, verify per tick (lossless "
-                        "greedy; reports drafted_accepted)")
+                        "many leading layers, verify per tick (greedy; "
+                        "lossless at f32 — at bf16/int8 a near-tie argmax "
+                        "can flip within a ulp between the width-1 and "
+                        "width-gamma+1 blocks; reports drafted_accepted)")
     p.add_argument("--spec-gamma", type=int, default=4,
                    help="draft tokens per speculative tick")
     p.set_defaults(fn=cmd_serve)

@@ -55,6 +55,12 @@ class ModelConfig:
     # "auto": Pallas flash kernel on TPU when shapes allow, einsum elsewhere.
     # "flash" forces the kernel (interpret mode off-TPU); "einsum" disables.
     attn_impl: str = "auto"
+    # Context-parallel strategy when an sp>1 plan is active (attn_impl
+    # "auto"): "ring" rotates K/V chunks over ICI neighbors (peak memory
+    # O(S/n_sp) — maximum context length); "a2a" re-shards seq->heads with
+    # one all_to_all each way and runs full-sequence flash locally (better
+    # MXU shape; needs sp to divide the per-tp-shard head counts).
+    sp_impl: str = "ring"
     # "block": jax.checkpoint each transformer layer — the backward holds
     # one layer's residuals instead of every layer's (incl. the bf16 weight
     # casts, 256 MB/layer at d2048/ff8192), trading ~1/3 extra forward
@@ -182,11 +188,26 @@ def _attention(x: jax.Array, p: dict, config: ModelConfig,
             k = jnp.repeat(k, group, axis=2)
             v = jnp.repeat(v, group, axis=2)
             kv_group = 1
+        attn = ring_attention
+        if c.sp_impl == "a2a":
+            # The a2a strategy additionally splits the per-tp-shard head
+            # axis over sp; expand a still-narrow GQA K/V when its local
+            # head count doesn't divide (q's own divisibility is checked
+            # loudly by the wrapper — use ring if heads are too few).
+            from tputopo.workloads.ulysses import a2a_attention
+
+            sp = ring_plan.axes.get("sp", 1)
+            if kv_group > 1 and (c.n_kv_heads // tp) % sp != 0:
+                k = jnp.repeat(k, kv_group, axis=2)
+                v = jnp.repeat(v, kv_group, axis=2)
+                kv_group = 1
+            attn = a2a_attention
+        elif c.sp_impl != "ring":
+            raise ValueError(f"unknown sp_impl {c.sp_impl!r}")
         q = constrain(q, "dp", "sp", "tp", None)
         k = constrain(k, "dp", "sp", "tp", None)
         v = constrain(v, "dp", "sp", "tp", None)
-        out = ring_attention(q, k, v, ring_plan, causal=True,
-                             kv_group=kv_group)
+        out = attn(q, k, v, ring_plan, causal=True, kv_group=kv_group)
         out = out.reshape(B, S, c.n_heads * c.head_dim)
         return qdot(out, p["wo"])
 

@@ -1,0 +1,121 @@
+"""All-to-all (Ulysses-style) sequence parallelism over the ``sp`` axis.
+
+The second context-parallel strategy next to :mod:`tputopo.workloads.ring`
+(same global layout contract, swappable via ``ModelConfig.sp_impl``).
+Where ring attention rotates K/V chunks ``n_sp - 1`` times per layer
+(`ppermute` over ICI neighbor links), the a2a strategy re-shards ONCE each
+way: an `all_to_all` converts the sequence sharding into a *head*
+sharding — every device then holds the FULL sequence for ``N / (tp*sp)``
+heads — runs plain (flash) attention locally with no cross-device
+bookkeeping, and a second `all_to_all` restores the sequence sharding.
+
+Trade-off (the reason both strategies ship): a2a moves the whole Q/K/V/O
+payload twice per layer but in two dense collectives XLA can schedule
+wide across the torus, and its local compute is one full-sequence flash
+call (best MXU shape).  Ring keeps peak activation memory at
+O(S / n_sp) — a2a's local K/V is O(S) for its head shard — and rides
+strictly neighbor links, so it wins at very long context or when heads
+are too few to split (a2a needs ``sp`` to divide the local head count;
+GQA K/V heads included).  Heuristic: a2a for throughput at moderate S
+with plenty of heads, ring for maximum context length.
+
+No counterpart in the reference (its design leaves model-internal
+parallelism entirely to the workload, design.md:17-19 / SURVEY.md §1 L5);
+the pattern follows the public DeepSpeed-Ulysses / JAX shard_map
+literature, implemented here against the same placement invariant the
+scheduler guarantees (a contiguous slice whose mesh axes ride ICI).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from jax import shard_map
+
+from tputopo.workloads.attention import flash_attention, reference_attention
+
+
+def _flash_block(S: int) -> int:
+    """The block size the local flash call will actually use: prefer the
+    kernel's full-size blocks, fall back to the largest divisor (the same
+    chain as model._flash_dispatch — the gate MUST probe with the block it
+    passes, or valid sequence lengths crash in _validate)."""
+    for b in (512, 256):
+        if S % b == 0:
+            return b
+    return min(128, S)
+
+
+def _flash_shapes_ok(S: int) -> bool:
+    block = _flash_block(S)
+    return S >= 16 and S % block == 0 and block % 8 == 0
+
+
+def a2a_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        axis_name: str, axis_size: int, causal: bool = True,
+                        kv_group: int = 1, impl: str = "einsum",
+                        interpret: bool = False) -> jax.Array:
+    """Per-device body (call under shard_map): q [B, Sc, Nl, H], k/v
+    [B, Sc, Nl/kv_group, H] local chunks; returns local [B, Sc, Nl, H]
+    as if attention ran over the full global sequence.
+
+    Requires ``Nl % axis_size == 0`` and ``(Nl/kv_group) % axis_size == 0``
+    (checked by the global wrapper): the all_to_all splits the head axis
+    into ``axis_size`` groups while concatenating the sequence axis.
+    """
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            tiled=True)
+    # [B, Sc, Nl, H] -> [B, S, Nl/sp, H]: heads scatter, sequence gathers.
+    qg = a2a(q, split_axis=2, concat_axis=1)
+    kg = a2a(k, split_axis=2, concat_axis=1)
+    vg = a2a(v, split_axis=2, concat_axis=1)
+    if kv_group > 1:
+        kg = jnp.repeat(kg, kv_group, axis=2)
+        vg = jnp.repeat(vg, kv_group, axis=2)
+    if impl == "flash":
+        blk = _flash_block(qg.shape[1])
+        out = flash_attention(qg, kg, vg, causal=causal, block_q=blk,
+                              block_kv=blk, interpret=interpret)
+    else:
+        out = reference_attention(qg, kg, vg, causal=causal)
+    # [B, S, Nl/sp, H] -> [B, Sc, Nl, H]: sequence scatters back, heads gather.
+    return a2a(out, split_axis=1, concat_axis=2)
+
+
+def a2a_attention(q: jax.Array, k: jax.Array, v: jax.Array, plan, *,
+                  causal: bool = True, kv_group: int = 1,
+                  impl: str = "auto") -> jax.Array:
+    """Global-array entry, same contract as :func:`ring.ring_attention`:
+    q [B, S, N, H] (k/v may carry N/kv_group heads), logically global,
+    laid out batch-over-dp, seq-over-sp, heads-over-tp on ``plan``'s mesh.
+
+    ``impl``: "flash" runs the Pallas kernel on the full-sequence local
+    block (interpret mode off-TPU), "einsum" the reference block, "auto"
+    picks flash on TPU whenever the global sequence shape allows it.
+    """
+    n_sp = plan.axes.get("sp", 1)
+    n_tp = plan.axes.get("tp", 1)
+    B, S, N, _ = q.shape
+    n_local = N // n_tp
+    nkv_local = k.shape[2] // n_tp
+    if n_local % n_sp or nkv_local % n_sp:
+        raise ValueError(
+            f"a2a sequence parallelism needs sp={n_sp} to divide the local "
+            f"head counts (q {n_local}, kv {nkv_local}); expand GQA heads "
+            "or use the ring strategy")
+    if impl == "auto":
+        impl = ("flash" if jax.default_backend() == "tpu"
+                and _flash_shapes_ok(S) else "einsum")
+    body = functools.partial(
+        a2a_attention_local, axis_name="sp", axis_size=n_sp, causal=causal,
+        kv_group=kv_group, impl=impl,
+        interpret=jax.default_backend() != "tpu")
+    from tputopo.workloads.sharding import shard_map_kwargs
+
+    spec = plan.spec("dp", "sp", "tp", None)
+    return shard_map(body, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False,
+                     **shard_map_kwargs(plan, {"dp", "sp", "tp"}))(q, k, v)
