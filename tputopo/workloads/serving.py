@@ -332,7 +332,16 @@ def ragged_block(params: dict, config: ModelConfig, tokens: jax.Array,
     decode step; T=gamma+1 is speculative serving's catch-up / verify
     block.  Callers own the junk-window discipline: pass ``starts``
     already redirected/clamped for inactive slots (writes are T-wide
-    per-slot windows)."""
+    per-slot windows).
+
+    CACHE-WRITE CONTRACT (public API — this function is exported): every
+    slot must satisfy ``starts[b] + T <= S`` (S = cache buffer length).
+    The per-slot cache write is a ``dynamic_update_slice``, which near the
+    buffer end silently CLAMPS the start to ``S - T`` and would overwrite
+    EARLIER cache rows — corruption, not an error.  Size the buffer with a
+    margin of at least ``T - 1`` beyond the longest position a slot may
+    reach (the speculative engine's ``buffer_margin >= gamma + 1`` is
+    exactly this formula for its T = gamma+1 verify block)."""
     c = config
     B, T = tokens.shape
     group = c.n_heads // c.n_kv_heads
