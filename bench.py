@@ -1465,8 +1465,12 @@ def main() -> None:
     extras["bandwidth_gain_vs_count_only"] = isolated(
         "ab_gain", bench_ab_gain, strict=True)
 
-    preflight = _tpu_preflight(min(120.0, max(5.0,
-                                              deadline - time.monotonic())))
+    try:
+        preflight_cap = float(os.environ.get("BENCH_TPU_PREFLIGHT_S", "120"))
+    except ValueError:
+        preflight_cap = 120.0
+    preflight = _tpu_preflight(min(preflight_cap,
+                                   max(5.0, deadline - time.monotonic())))
     extras["tpu_preflight"] = preflight
 
     def tpu_sub(name: str, extra_args: list[str] | None = None):

@@ -23,3 +23,34 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+# ---- slow-tier marker -------------------------------------------------------
+#
+# The compile-heaviest tests (serving engines, speculative decoding,
+# pipeline) are marked ``slow`` and excluded by default so the default tier
+# stays under ~10 minutes; run the FULL suite with ``--runslow`` or
+# ``RUN_SLOW=1``.  CI/driver runs use the default tier; the full tier is
+# for pre-merge validation of serving/speculative/pipeline changes.
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="also run tests marked slow (compile-heavy serving/pipeline)")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: compile-heavy test, excluded unless --runslow or RUN_SLOW=1")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow") or os.environ.get("RUN_SLOW") == "1":
+        return
+    skip = pytest.mark.skip(reason="slow tier: run with --runslow or RUN_SLOW=1")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

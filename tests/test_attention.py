@@ -90,6 +90,7 @@ def test_flash_grad_matches_reference(causal):
                                    atol=5e-5, rtol=5e-5)
 
 
+@pytest.mark.slow
 def test_sharded_train_step_with_flash():
     """Full DP x TP train step with the flash kernel under shard_map
     (interpret mode on the CPU mesh)."""

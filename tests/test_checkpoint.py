@@ -46,6 +46,7 @@ def test_save_restore_roundtrip_across_meshes(tmp_path):
     assert int(restored.step) == 4 and bool(jnp.isfinite(loss))
 
 
+@pytest.mark.slow
 def test_moe_pipeline_state_restores_across_plans(tmp_path):
     """A pipelined-MoE TrainState (expert tables over ep, layer stacks over
     pp) checkpointed from one plan restores onto a plain dp/tp plan — the
@@ -80,6 +81,7 @@ def test_moe_pipeline_state_restores_across_plans(tmp_path):
     assert int(restored.step) == 2 and bool(jnp.isfinite(loss))
 
 
+@pytest.mark.slow
 def test_restore_empty_dir_returns_none(tmp_path):
     plan = build_mesh({"dp": 2, "sp": 1, "tp": 4})
     target = make_sharded_state(plan, CFG, jax.random.key(0))
@@ -87,6 +89,7 @@ def test_restore_empty_dir_returns_none(tmp_path):
     assert ckpt.latest_step(tmp_path / "missing") is None
 
 
+@pytest.mark.slow
 def test_latest_step_picks_max(tmp_path):
     plan = build_mesh({"dp": 2, "sp": 1, "tp": 4})
     state = make_sharded_state(plan, CFG, jax.random.key(0))

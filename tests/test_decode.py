@@ -8,6 +8,7 @@ import numpy as np
 from tputopo.workloads.decode import KVCache, generate
 from tputopo.workloads.model import ModelConfig, forward, init_params
 from tputopo.workloads.moe import MoEConfig
+import pytest
 
 CFG = ModelConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
                   n_kv_heads=2, d_ff=64, max_seq=64,
@@ -33,6 +34,7 @@ def test_generate_matches_full_forward_dense():
     np.testing.assert_array_equal(out, ref)
 
 
+@pytest.mark.slow
 def test_generate_matches_full_forward_moe():
     cfg = ModelConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
                       n_kv_heads=2, d_ff=64, max_seq=64,
@@ -47,6 +49,7 @@ def test_generate_matches_full_forward_moe():
     np.testing.assert_array_equal(out, ref)
 
 
+@pytest.mark.slow
 def test_moe_decode_is_drop_free_under_tight_capacity():
     """Decode routes one token per step, so the training layer's capacity
     truncation can never trigger: with a TIGHT capacity config, decode

@@ -23,6 +23,7 @@ from tputopo.workloads import checkpoint as ckpt
 from tputopo.workloads.model import ModelConfig
 from tputopo.workloads.sharding import build_mesh
 from tputopo.workloads.train import make_sharded_state, make_sharded_train_step
+import pytest
 
 CFG = ModelConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
                   n_kv_heads=2, d_ff=64, max_seq=32,
@@ -37,6 +38,7 @@ def _schedule(sched, api, name):
     return sched.bind(name, "default", best["Host"])
 
 
+@pytest.mark.slow
 def test_chip_death_replace_and_resume(tmp_path):
     clock = Clock(1000.0)
     api, plugins = build_cluster(clock=clock)  # v5p:2x2x4, 4 nodes, 16 chips

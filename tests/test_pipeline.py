@@ -99,6 +99,7 @@ def test_pipeline_layer_params_sharded_over_pp():
         "each pipeline stage must hold only its own layers"
 
 
+@pytest.mark.slow
 def test_pipeline_composed_with_moe_ep():
     """pp=2 x ep=2 x tp=2: pipelined MoE training step runs and learns."""
     cfg = ModelConfig(vocab_size=128, d_model=32, n_layers=2, n_heads=4,

@@ -29,6 +29,7 @@ def _params(cfg=CFG, seed=0):
     return init_params(cfg, jax.random.key(seed))
 
 
+@pytest.mark.slow
 def test_roundtrip_error_bounded_by_half_scale():
     """Symmetric absmax int8: |deq(q) - w| <= scale/2 elementwise (the
     rounding bound), and exactly 0 for all-zero channels."""
@@ -99,6 +100,7 @@ def test_streamed_bytes_roughly_halved():
     assert is_quantized(qp["lm_head"]) and is_quantized(qp["layers"]["wq"])
 
 
+@pytest.mark.slow
 def test_moe_quantized_decode_and_training_path():
     """MoE expert tables quantize too: the drop-free decode mixture scans
     quantized {int8, scale} leaves, and the capacity-dispatch training
@@ -119,6 +121,7 @@ def test_moe_quantized_decode_and_training_path():
     assert out.shape == x.shape and np.isfinite(float(aux))
 
 
+@pytest.mark.slow
 def test_serving_engine_quantized_matches_one_shot():
     """The continuous-batching engine is parameter-format agnostic: with
     quantized weights it still matches its own one-shot generate
@@ -145,6 +148,7 @@ def test_embed_rows_gather_parity():
     assert float(jnp.max(jnp.abs(raw - q))) < 0.05 * float(jnp.max(jnp.abs(raw)))
 
 
+@pytest.mark.slow
 def test_sharded_int8_decode_matches_single_device():
     """Multi-chip int8 serving: quantize ON device under the mesh (GSPMD
     propagates the weight shardings onto the int8/scale pair) and decode
@@ -208,6 +212,7 @@ def test_int8_kv_decode_token_parity():
     assert (g[:, 8] == g8[:, 8]).mean() >= 0.5  # later steps may diverge
 
 
+@pytest.mark.slow
 def test_serving_engine_int8_kv_matches_one_shot():
     """Continuous batching over an int8 cache (quantize-at-write in the
     ragged step, scale folds in _attend_ragged) matches its own one-shot
@@ -258,6 +263,7 @@ def test_training_keeps_f32_masters():
         _ = qp["layers"]["wq"]["missing"]
 
 
+@pytest.mark.slow
 def test_quantized_params_checkpoint_roundtrip(tmp_path):
     """Deployment flow: quantize once, save, restore onto a fresh
     template, serve — restored int8/scale leaves are bit-identical and

@@ -32,6 +32,7 @@ def test_ring_matches_reference(causal):
                                atol=3e-5, rtol=3e-5)
 
 
+@pytest.mark.slow
 def test_ring_grad_matches_reference():
     q, k, v = qkv((1, 16, 2, 8))
     plan = build_mesh({"dp": 1, "sp": 8, "tp": 1})
@@ -64,6 +65,7 @@ def test_model_forward_ring_matches_unsharded():
                                atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_train_step_with_ring_runs():
     plan = build_mesh({"dp": 2, "sp": 2, "tp": 2})
     state = make_sharded_state(plan, CFG, jax.random.key(0))
@@ -143,6 +145,7 @@ def test_ring_flash_grads_match_reference():
                                    atol=5e-5, rtol=5e-5, err_msg=f"d{name}")
 
 
+@pytest.mark.slow
 def test_ring_flash_gqa_narrow_rotation():
     """GQA: the NARROW K/V rotates; expansion happens per-step at kernel
     entry and dK/dV reduce back to the narrow groups."""

@@ -79,6 +79,7 @@ def test_eos_stops_a_sequence_early():
     assert stopped_early >= 1, "probe failed to exercise EOS"
 
 
+@pytest.mark.slow
 def test_mid_stream_admission_reuses_freed_slots():
     """More requests than slots: finished sequences leave, queued ones
     join mid-stream, outputs still match per-request generate — and no
@@ -132,6 +133,7 @@ def test_moe_serving_matches_generate():
         assert results[rid] == _one_shot(params, p, 4, cfg), rid
 
 
+@pytest.mark.slow
 def test_steps_per_tick_chunking_equivalent():
     """Chained decode steps (dispatch amortization) change nothing about
     the outputs, only the admission granularity."""
@@ -200,6 +202,7 @@ def test_sharded_serving_matches_single_device():
         assert results[rid] == ref, rid
 
 
+@pytest.mark.slow
 def test_bucketed_prefill_parity_and_trace_count():
     """Multi-bucket prefill: each admission pads to the smallest covering
     bucket (one compiled prefill per bucket), outputs unchanged."""
@@ -223,7 +226,12 @@ def test_bucketed_prefill_parity_and_trace_count():
         ServingEngine(params, CFG, slots=1, max_len=8, prompt_pad=())
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+@pytest.mark.parametrize("seed", [
+    0, 1,
+    pytest.param(2, marks=pytest.mark.slow),
+    pytest.param(3, marks=pytest.mark.slow),
+    pytest.param(4, marks=pytest.mark.slow),
+])
 def test_randomized_schedules_match_per_request_generate(seed):
     """Property test: any mix of prompt lengths, budgets, slot counts,
     tick chunking, and buckets must reproduce per-request generate
@@ -332,6 +340,7 @@ def test_chunked_prefill_int8_kv():
         assert results[rid] == np.asarray(one)[0].tolist(), rid
 
 
+@pytest.mark.slow
 def test_prefix_cache_matches_one_shot():
     """register_prefix computes the shared prefix KV once; every request
     with prefix=pid must match a one-shot generate of prefix + suffix
@@ -352,6 +361,7 @@ def test_prefix_cache_matches_one_shot():
     assert eng.metrics["prefix_admits"] == 4
 
 
+@pytest.mark.slow
 def test_prefix_cache_with_chunked_suffix():
     """A prefix admission's suffix rides the same chunk machinery at
     start=P: chunked and unchunked produce identical tokens."""
@@ -369,6 +379,7 @@ def test_prefix_cache_with_chunked_suffix():
     assert eng.metrics["prefill_chunks"] > 0
 
 
+@pytest.mark.slow
 def test_prefix_cache_int8_kv():
     """Prefix KV built, copied, and attended through the int8 cache:
     quantize-at-build equals quantize-at-prefill (same rows in, same

@@ -61,6 +61,7 @@ def test_perfect_draft_accepts_everything():
     assert total in (9, 10), total
 
 
+@pytest.mark.slow
 def test_int8_spec_decode_lossless_vs_int8_greedy():
     """The draft slice works on quantized {int8, scale} leaves (leading
     layer axis everywhere) and int8 KV caches; parity holds against the
@@ -152,6 +153,7 @@ def test_spec_engine_eos_early_exit():
     assert stopped >= 1, "probe failed to exercise EOS"
 
 
+@pytest.mark.slow
 def test_spec_engine_int8_stack():
     """Quantized weights + int8 KV caches (target AND draft) through the
     slotted speculative path: parity against the int8 one-shot."""
@@ -183,6 +185,7 @@ def test_spec_engine_accounting():
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.slow
 def test_spec_engine_randomized_schedules(seed):
     """Property test: random prompt lengths, budgets, slot counts, draft
     depths, and gammas — every request must reproduce its one-shot
@@ -225,6 +228,7 @@ def test_spec_engine_at_the_max_len_frontier():
     assert len(results[rid]) == max_len
 
 
+@pytest.mark.slow
 def test_spec_engine_sharded_mesh_matches_single_device():
     """Speculative continuous batching on a dp x tp mesh (target and
     draft caches shard KV heads over tp) must reproduce the

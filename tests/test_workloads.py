@@ -75,6 +75,7 @@ def test_plan_mesh_policy():
         plan_mesh(8, pp=3)
 
 
+@pytest.mark.slow
 def test_remat_policies_agree():
     """remat is a memory policy, not math: block/dots/none forwards and
     grads must agree up to f32 noise."""
@@ -138,6 +139,7 @@ def test_param_shardings_land_on_mesh():
     assert shard_shapes == {(L, D, H // 4)}
 
 
+@pytest.mark.slow
 def test_sp_sequence_sharding_runs():
     """SP (sequence) axis active: activations split along seq dim."""
     plan = build_mesh({"dp": 2, "sp": 2, "tp": 2})
