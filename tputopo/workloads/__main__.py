@@ -358,6 +358,21 @@ def main() -> int:
     p.set_defaults(fn=cmd_train_vision)
 
     args = ap.parse_args()
+    # Multi-host gangs rendezvous BEFORE the first jax backend touch so
+    # jax.devices() spans the scheduled slice (no-op for single-process
+    # jobs) — workloads/distributed.py documents the env contract the
+    # gang Job template wires.
+    from tputopo.workloads.distributed import initialize_from_env
+
+    try:
+        group = initialize_from_env()
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not group.single:
+        print(f"jax.distributed: rank {group.process_id}/"
+              f"{group.num_processes} via {group.coordinator}",
+              file=sys.stderr)
     return args.fn(args)
 
 

@@ -1,0 +1,118 @@
+"""Multi-host process bootstrap: ``jax.distributed`` from the gang's env.
+
+The scheduler places a multi-host gang (pods sharing ``tpu.dev/gang-id``)
+on a contiguous host box — but JAX on TPU is one *process per host*, and
+those processes must rendezvous (``jax.distributed.initialize``) before
+``jax.devices()`` spans the slice and collectives can ride ICI/DCN.  The
+reference leaves everything inside the container to the workload
+(SURVEY.md §1 L5); here the bootstrap is part of the framework: every
+workload CLI entry calls :func:`initialize_from_env` first, which is a
+no-op for single-process jobs and a full rendezvous for gangs.
+
+Env contract (all have k8s-native defaults, see
+``deploy/examples/job-gang-4x4.yaml``):
+
+- ``TPUTOPO_COORDINATOR`` — ``host:port`` of the rank-0 process (in k8s: a
+  headless Service name + the job's pod index 0, e.g.
+  ``llama-dp4-0.llama-dp4:8476``).  Required when num_processes > 1.
+- ``TPUTOPO_NUM_PROCESSES`` (alias ``TPUTOPO_GANG_SIZE``) — gang size;
+  defaults to 1 (single-process).  Must be set explicitly in the gang's
+  Job template — there is no implicit k8s-label default.
+- ``TPUTOPO_PROCESS_ID`` — this process's rank.  When num_processes > 1
+  and unset, falls back to ``JOB_COMPLETION_INDEX`` (k8s Indexed Job, the
+  gang example's mode), then ``TPU_WORKER_ID`` / ``CLOUD_TPU_TASK_ID``
+  (the host ordinals the device plugin and stock Cloud TPU VMs inject —
+  the same chain discovery/shim.py resolves).  Single-process jobs ignore
+  the fallbacks entirely: the device plugin injects ``TPU_WORKER_ID``
+  into EVERY container, and a 1-pod job on a non-zero host must not be
+  misread as rank 1 of 1.
+
+Ranks must be dense 0..n-1 and agree with the coordinator's own index —
+the k8s Indexed Job provides exactly that for free.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+DEFAULT_PORT = 8476
+
+
+@dataclass(frozen=True)
+class ProcessGroup:
+    """The resolved multi-process identity of this workload container."""
+
+    coordinator: str | None
+    num_processes: int
+    process_id: int
+
+    @property
+    def single(self) -> bool:
+        return self.num_processes <= 1
+
+
+def _int_env(env: dict, *names: str) -> int | None:
+    for name in names:
+        raw = env.get(name, "").strip()
+        if raw:
+            try:
+                return int(raw)
+            except ValueError:
+                raise ValueError(f"{name} must be an integer, got {raw!r}")
+    return None
+
+
+def process_group_from_env(env: dict | None = None) -> ProcessGroup:
+    """Resolve (coordinator, num_processes, process_id) per the module
+    contract; raises on inconsistent configuration instead of letting a
+    half-configured gang hang in rendezvous."""
+    env = dict(os.environ if env is None else env)
+    num = _int_env(env, "TPUTOPO_NUM_PROCESSES", "TPUTOPO_GANG_SIZE")
+    if num is None:
+        num = 1
+    if num > 1:
+        pid = _int_env(env, "TPUTOPO_PROCESS_ID", "JOB_COMPLETION_INDEX",
+                       "TPU_WORKER_ID", "CLOUD_TPU_TASK_ID")
+    else:
+        # Only the explicit variable counts for single-process jobs: the
+        # device plugin injects TPU_WORKER_ID (its host ordinal) into
+        # every container, and a 1-pod job on worker 1 is still rank 0.
+        pid = _int_env(env, "TPUTOPO_PROCESS_ID")
+    if pid is None:
+        pid = 0
+    coord = env.get("TPUTOPO_COORDINATOR", "").strip() or None
+    if coord is not None and ":" not in coord:
+        coord = f"{coord}:{DEFAULT_PORT}"
+    if num < 1:
+        raise ValueError(f"num_processes must be >= 1, got {num}")
+    if not 0 <= pid < num:
+        raise ValueError(
+            f"process_id {pid} out of range for {num} processes (ranks "
+            "must be dense 0..n-1 — is JOB_COMPLETION_INDEX wired?)")
+    if num > 1 and coord is None:
+        raise ValueError(
+            "TPUTOPO_NUM_PROCESSES > 1 needs TPUTOPO_COORDINATOR "
+            "(rank-0 'host:port'; in k8s a headless Service name, see "
+            "deploy/examples/job-gang-4x4.yaml)")
+    return ProcessGroup(coordinator=coord, num_processes=num, process_id=pid)
+
+
+def initialize_from_env(env: dict | None = None, **kwargs) -> ProcessGroup:
+    """Rendezvous the gang if this is a multi-process job; no-op otherwise.
+
+    Call BEFORE the first jax backend touch (the same before-first-touch
+    rule the dry-run entry enforces).  Extra kwargs pass through to
+    ``jax.distributed.initialize`` (e.g.
+    ``initialization_timeout`` for a fail-loud bound instead of the
+    default block — design.md:109's posture applied to rendezvous).
+    """
+    group = process_group_from_env(env)
+    if not group.single:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=group.coordinator,
+            num_processes=group.num_processes,
+            process_id=group.process_id, **kwargs)
+    return group
