@@ -1,0 +1,79 @@
+"""Graceful preemption of the training CLI: kubernetes evicts with SIGTERM
+(then SIGKILL after the grace period); the train loop must checkpoint and
+exit 0 so the replacement pod resumes instead of losing the run."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _train_cmd(steps: int, ckpt: str) -> list[str]:
+    # jax.config (not the env var) forces CPU: some images pin a hardware
+    # platform via sitecustomize that ignores JAX_PLATFORMS — same dance
+    # as tests/conftest.py.
+    code = (
+        "import jax, sys; jax.config.update('jax_platforms', 'cpu'); "
+        f"sys.argv = ['tputopo-workload', 'train', '--steps', '{steps}', "
+        f"'--seq', '32', '--batch', '2', '--ckpt-dir', {ckpt!r}, "
+        "'--save-every', '50']; "
+        "from tputopo.workloads.__main__ import main; "
+        "raise SystemExit(main())")
+    return [sys.executable, "-c", code]
+
+
+def _cpu_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+@pytest.mark.slow
+def test_sigterm_checkpoints_and_exits_zero(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    proc = subprocess.Popen(_train_cmd(500_000, ckpt),
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, env=_cpu_env(), cwd=REPO)
+    try:
+        # Wait until training is demonstrably underway (first periodic
+        # checkpoint lands), then preempt the way kubelet does.
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if os.path.isdir(ckpt) and any(os.scandir(ckpt)):
+                break
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"train exited early: {proc.communicate()[1][-2000:]}")
+            time.sleep(0.5)
+        else:
+            raise AssertionError("no checkpoint appeared before the deadline")
+        proc.send_signal(signal.SIGTERM)
+        stdout, stderr = proc.communicate(timeout=180)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, stderr[-2000:]
+    report = json.loads([ln for ln in stdout.splitlines() if ln.strip()][-1])
+    assert report["preempted"] is True
+    assert 0 < report["final_step"] < 500_000
+    # The final save holds the step the loop stopped at.
+    from tputopo.workloads.checkpoint import latest_step
+
+    assert latest_step(ckpt) == report["final_step"]
+
+    # The replacement pod resumes from the preemption checkpoint.
+    proc2 = subprocess.run(_train_cmd(2, ckpt) + [], capture_output=True,
+                           text=True, timeout=240, env=_cpu_env(), cwd=REPO)
+    assert proc2.returncode == 0, proc2.stderr[-2000:]
+    report2 = json.loads(
+        [ln for ln in proc2.stdout.splitlines() if ln.strip()][-1])
+    assert report2["resumed_from"] == report["final_step"]
+    assert report2["preempted"] is False

@@ -86,27 +86,80 @@ def cmd_train(args) -> int:
     # Fixed batch: the convergence check is memorization, which must always
     # reduce loss — fresh random batches each step need not.
     tokens = jnp.asarray(rng.integers(0, config.vocab_size, (batch, args.seq)))
+
+    # Graceful preemption: kubernetes sends SIGTERM (then SIGKILL after
+    # terminationGracePeriodSeconds) when it evicts or preempts the pod —
+    # e.g. the extender re-placing a gang after a chip failure.  Finish
+    # the in-flight step, save a checkpoint, and exit cleanly so the
+    # replacement pod resumes instead of losing the epoch.  The flag flips
+    # between steps; nothing async-unsafe happens in the handler.
+    import signal
+
+    preempted = {"flag": False}
+
+    def _on_preempt(signum, frame):
+        preempted["flag"] = True
+
+    try:
+        prev_term = signal.signal(signal.SIGTERM, _on_preempt)
+    except ValueError:  # non-main thread (tests driving main() directly)
+        prev_term = None
+    # Multi-host gangs must AGREE on the stop step: kubelet delivers
+    # SIGTERM to each pod independently, and a rank that breaks one step
+    # before its peers leaves them blocked in a collective (then the
+    # checkpoint save — itself a cross-host collective — deadlocks too).
+    # One tiny allgather per step settles it; against real step times the
+    # cost is noise.
+    sync_preempt = None
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        def sync_preempt(local: bool) -> bool:
+            got = multihost_utils.process_allgather(
+                np.asarray([1 if local else 0], dtype=np.int32))
+            return bool(np.asarray(got).max())
+
     losses = []
     last_saved = None
-    for i in range(args.steps):
-        state, loss = step(state, tokens)
-        losses.append(float(loss))
-        if args.ckpt_dir and args.save_every and (i + 1) % args.save_every == 0:
+    try:
+        for i in range(args.steps):
+            state, loss = step(state, tokens)
+            losses.append(float(loss))
+            if args.ckpt_dir and args.save_every and (i + 1) % args.save_every == 0:
+                from tputopo.workloads import checkpoint as ckptlib
+
+                last_saved = ckptlib.save(args.ckpt_dir, state)
+            stop = preempted["flag"]
+            if sync_preempt is not None:
+                stop = sync_preempt(stop)
+            if stop:
+                preempted["flag"] = True
+                break
+        # Final save INSIDE the handler's scope — a second SIGTERM during
+        # the save must not kill the very write that preserves the run.
+        # Skipped when the in-loop save already wrote this exact step
+        # (orbax refuses to overwrite an existing step_N directory, which
+        # would fail the pod after a fully successful run).
+        if args.ckpt_dir and last_saved != int(state.step):
             from tputopo.workloads import checkpoint as ckptlib
 
-            last_saved = ckptlib.save(args.ckpt_dir, state)
-    # Final save — but not when the in-loop save already wrote this exact
-    # step (orbax refuses to overwrite an existing step_N directory, which
-    # would fail the pod after a fully successful run).
-    if args.ckpt_dir and last_saved != int(state.step):
-        from tputopo.workloads import checkpoint as ckptlib
-
-        ckptlib.save(args.ckpt_dir, state)
+            ckptlib.save(args.ckpt_dir, state)
+    finally:
+        if prev_term is not None:
+            signal.signal(signal.SIGTERM, prev_term)
     print(json.dumps({
         "devices": n, "mesh": plan.axes, "steps": args.steps,
         "resumed_from": resumed_from, "final_step": int(state.step),
+        "preempted": preempted["flag"],
         "first_loss": round(losses[0], 4), "last_loss": round(losses[-1], 4),
     }))
+    if preempted["flag"]:
+        # With a checkpoint saved, exit 0 so the Job controller counts the
+        # pod done rather than retry-looping a node the scheduler is
+        # draining; the resumed replacement carries the convergence check
+        # forward.  WITHOUT --ckpt-dir nothing was preserved — exit
+        # nonzero so the work is retried, not silently recorded as done.
+        return 0 if args.ckpt_dir else 1
     return 0 if losses[-1] < losses[0] or resumed_from else 1
 
 
