@@ -1009,6 +1009,26 @@ def bench_decode(measured_hbm_gbps: float | None = None) -> dict | None:
             }
         except Exception as e:
             out["int8"] = f"skipped: {type(e).__name__}: {e}"
+        # Grouped int4 A/B (same in-run control): XLA bit-packs s4
+        # two-per-byte on TPU, so the weight stream halves again vs int8;
+        # the group-scale reduction adds a small [.., G, O] epilogue.
+        try:
+            qp4 = jax.jit(lambda p: quantize_params(p, bits=4))(params)
+            dt4 = _decode_slope_s(qp4, prompt, cfg, short, long,
+                                  prompt_len + long)
+            if dt4 <= 0:
+                raise RuntimeError("non-positive int4 differencing slope")
+            q4_streamed = streamed_bytes(qp4)
+            out["int4"] = {
+                "decode_step_ms": round(dt4 * 1e3, 3),
+                "decode_tokens_per_s": round(batch / dt4, 1),
+                "speedup_vs_bf16": round(dt / dt4, 3),
+                "streamed_param_gb": round(q4_streamed / 1e9, 3),
+                "effective_param_stream_gbps": round(
+                    q4_streamed / dt4 / 1e9, 1),
+            }
+        except Exception as e:
+            out["int4"] = f"skipped: {type(e).__name__}: {e}"
         # Long-context serving A/B: batch 32 x prompt 1024, where the KV
         # cache read (not the weight stream) dominates each step's HBM
         # traffic — the full int8 stack (weights + kv_dtype="int8" cache,

@@ -227,3 +227,23 @@ def test_calibrate_both_ici_and_hbm_roundtrips_through_config():
     cfg = ExtenderConfig(cost_overrides={"v5e": {
         "ici_link_gbps": cal.ici_link_gbps, "hbm_gbps": cal.hbm_gbps}})
     assert cfg.cost_model("v5e") == cal
+
+
+def test_train_cli_profile_writes_trace(tmp_path):
+    """--profile captures a steady-state jax.profiler trace (SURVEY aux
+    5.1's workload leg): the XProf-openable artifacts must land in DIR."""
+    import subprocess
+    import sys
+
+    code = (
+        "import jax, sys; jax.config.update('jax_platforms', 'cpu'); "
+        f"sys.argv = ['x', 'train', '--steps', '3', '--seq', '32', "
+        f"'--batch', '2', '--profile', {str(tmp_path)!r}]; "
+        "from tputopo.workloads.__main__ import main; "
+        "raise SystemExit(main())")
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    traces = [p for p in (tmp_path / "plugins" / "profile").rglob("*")
+              if p.is_file()]
+    assert any(p.name.endswith(".xplane.pb") for p in traces), traces

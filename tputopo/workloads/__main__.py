@@ -119,12 +119,21 @@ def cmd_train(args) -> int:
                 np.asarray([1 if local else 0], dtype=np.int32))
             return bool(np.asarray(got).max())
 
+    if args.profile and args.steps < 2:
+        print("warning: --profile needs --steps >= 2 (step 0 is the "
+              "compile step and is excluded); no trace will be written",
+              file=sys.stderr)
     losses = []
     last_saved = None
+    profiling = False
     try:
         for i in range(args.steps):
             state, loss = step(state, tokens)
             losses.append(float(loss))
+            if args.profile and i == 0 and args.steps > 1:
+                # Trace steady-state steps only: step 0 is the compile.
+                jax.profiler.start_trace(args.profile)
+                profiling = True
             if args.ckpt_dir and args.save_every and (i + 1) % args.save_every == 0:
                 from tputopo.workloads import checkpoint as ckptlib
 
@@ -135,6 +144,9 @@ def cmd_train(args) -> int:
             if stop:
                 preempted["flag"] = True
                 break
+        if profiling:
+            jax.profiler.stop_trace()
+            profiling = False
         # Final save INSIDE the handler's scope — a second SIGTERM during
         # the save must not kill the very write that preserves the run.
         # Skipped when the in-loop save already wrote this exact step
@@ -145,6 +157,11 @@ def cmd_train(args) -> int:
 
             ckptlib.save(args.ckpt_dir, state)
     finally:
+        if profiling:  # crash mid-trace: flush what exists
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
         if prev_term is not None:
             signal.signal(signal.SIGTERM, prev_term)
     print(json.dumps({
@@ -163,18 +180,24 @@ def cmd_train(args) -> int:
     return 0 if losses[-1] < losses[0] or resumed_from else 1
 
 
-def _maybe_quantize(params, plan, int8: bool):
-    """Weight-only int8 for the serving CLIs: quantize ON device under the
-    mesh so GSPMD propagates the weight shardings onto the int8/scale pair
-    (no hand-written spec tree for the quantized layout)."""
-    if not int8:
+def _maybe_quantize(params, plan, int8: bool, int4: bool = False):
+    """Weight-only quantization for the serving CLIs: quantize ON device
+    under the mesh so GSPMD propagates the weight shardings onto the
+    quantized/scale pair (no hand-written spec tree for the quantized
+    layout).  --int4 stacks on the int8 KV cache: weights stream grouped
+    s4 (half of int8's bytes again), the cache stays int8."""
+    if not (int8 or int4):
         return params
+    import functools
+
     import jax
 
     from tputopo.workloads.quant import quantize_params
 
+    fn = (functools.partial(quantize_params, bits=4) if int4
+          else quantize_params)
     with plan.mesh:
-        return jax.jit(quantize_params)(params)
+        return jax.jit(fn)(params)
 
 
 def cmd_decode(args) -> int:
@@ -192,7 +215,7 @@ def cmd_decode(args) -> int:
     cfg = ModelConfig(vocab_size=2048, d_model=256, n_layers=4, n_heads=8,
                       n_kv_heads=4, d_ff=512,
                       max_seq=args.prompt_len + args.max_new,
-                      kv_dtype="int8" if args.int8 else "bf16")
+                      kv_dtype="int8" if args.int8 or args.int4 else "bf16")
     # Serving mesh: batch over dp, KV heads over tp (the cache's tp axis),
     # mirroring cmd_train — a multi-chip serving pod actually shards the
     # cache and weights (ADVICE r2; on one chip everything is a no-op).
@@ -202,7 +225,7 @@ def cmd_decode(args) -> int:
     batch = max(dp, args.batch // dp * dp)
     params = init_params(cfg, jax.random.key(0))
     params = jax.device_put(params, shardlib.param_shardings(plan, cfg))
-    params = _maybe_quantize(params, plan, args.int8)
+    params = _maybe_quantize(params, plan, args.int8, args.int4)
     prompt = np.random.default_rng(0).integers(
         0, cfg.vocab_size, (batch, args.prompt_len))
     prompt = jax.device_put(jnp.asarray(prompt), plan.sharding("dp", None))
@@ -238,7 +261,7 @@ def cmd_serve(args) -> int:
     cfg = ModelConfig(vocab_size=2048, d_model=256, n_layers=4, n_heads=8,
                       n_kv_heads=4, d_ff=512,
                       max_seq=args.prompt_len + args.max_new,
-                      kv_dtype="int8" if args.int8 else "bf16")
+                      kv_dtype="int8" if args.int8 or args.int4 else "bf16")
     # Flag validation BEFORE any device work (init/device_put/quantize).
     if args.spec_draft_layers:
         if not 0 < args.spec_draft_layers < cfg.n_layers:
@@ -263,7 +286,7 @@ def cmd_serve(args) -> int:
     plan = mesh_for_slice((n,), heads=cfg.n_kv_heads)
     params = init_params(cfg, jax.random.key(0))
     params = jax.device_put(params, shardlib.param_shardings(plan, cfg))
-    params = _maybe_quantize(params, plan, args.int8)
+    params = _maybe_quantize(params, plan, args.int8, args.int4)
     rng = np.random.default_rng(0)
     lens = rng.integers(max(1, args.prompt_len // 4), args.prompt_len + 1,
                         args.requests)
@@ -366,6 +389,11 @@ def main() -> int:
                    help="orbax checkpoint dir: resume if present, save at end "
                         "(and every --save-every steps)")
     p.add_argument("--save-every", type=int, default=0)
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the steady-state "
+                        "steps into DIR (open with XProf/TensorBoard; "
+                        "step 0 is excluded as the compile step, so "
+                        "--steps must be >= 2 for a trace to appear)")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("decode", help="KV-cache greedy decode throughput")
@@ -375,6 +403,9 @@ def main() -> int:
     p.add_argument("--int8", action="store_true",
                    help="full int8 serving stack: weight-only int8 + int8 "
                         "KV cache (decode is HBM-bound; bytes are the lever)")
+    p.add_argument("--int4", action="store_true",
+                   help="grouped int4 weights (half of int8's stream "
+                        "again) over the int8 KV cache")
     p.set_defaults(fn=cmd_decode)
 
     p = sub.add_parser("serve", help="continuous-batching serving engine "
@@ -394,6 +425,9 @@ def main() -> int:
                         "(register_prefix) and every request reuses it")
     p.add_argument("--int8", action="store_true",
                    help="full int8 serving stack: weights + KV cache")
+    p.add_argument("--int4", action="store_true",
+                   help="grouped int4 weights (half of int8's stream "
+                        "again) over the int8 KV cache")
     p.add_argument("--spec-draft-layers", type=int, default=0,
                    help="speculative continuous batching: draft with this "
                         "many leading layers, verify per tick (greedy; "
