@@ -979,56 +979,49 @@ def bench_decode(measured_hbm_gbps: float | None = None) -> dict | None:
                     "ratio > 1: decode's stream estimate exceeded the "
                     "separately-measured HBM bandwidth within cross-run "
                     "noise; treat min(the two) as the conservative floor")
-        # One quantized tree for both A/B blocks below.
-        try:
-            from tputopo.workloads.quant import quantize_params
+        from tputopo.workloads.quant import quantize_params
 
+        def quant_leg(label: str, qtree) -> None:
+            """One weight-quantized A/B leg (in-run control): bf16 decode
+            runs at the HBM ceiling, so streaming fewer weight bytes is
+            the one lever left — int8 halves them (measured 1.84x on
+            v5e); grouped int4 halves them again (XLA bit-packs s4
+            two-per-byte on TPU, one group-scale epilogue per dot)."""
+            try:
+                dtq = _decode_slope_s(qtree, prompt, cfg, short, long,
+                                      prompt_len + long)
+                if dtq <= 0:
+                    raise RuntimeError(
+                        f"non-positive {label} differencing slope")
+                q_streamed = streamed_bytes(qtree)
+                out[label] = {
+                    "decode_step_ms": round(dtq * 1e3, 3),
+                    "decode_tokens_per_s": round(batch / dtq, 1),
+                    "speedup_vs_bf16": round(dt / dtq, 3),
+                    "streamed_param_gb": round(q_streamed / 1e9, 3),
+                    "effective_param_stream_gbps": round(
+                        q_streamed / dtq / 1e9, 1),
+                }
+            except Exception as e:
+                out[label] = f"skipped: {type(e).__name__}: {e}"
+
+        # One int8 tree shared with the long-context leg below.
+        try:
             qp = quantize_params(params)
         except Exception as e:
             qp = None
             print(f"bench: quantize skipped: {type(e).__name__}: {e}",
                   file=sys.stderr)
-        # Weight-only int8 A/B (in-run control): bf16 decode runs at the
-        # HBM ceiling, so halving streamed weight bytes is the one lever
-        # left — quantize.quantize_params is a drop-in parameter swap on
-        # the same compiled path.  Measured 1.84x on v5e.
-        try:
-            if qp is None:
-                raise RuntimeError("no quantized tree")
-            dt8 = _decode_slope_s(qp, prompt, cfg, short, long,
-                                  prompt_len + long)
-            if dt8 <= 0:
-                raise RuntimeError("non-positive int8 differencing slope")
-            q_streamed = streamed_bytes(qp)
-            out["int8"] = {
-                "decode_step_ms": round(dt8 * 1e3, 3),
-                "decode_tokens_per_s": round(batch / dt8, 1),
-                "speedup_vs_bf16": round(dt / dt8, 3),
-                "streamed_param_gb": round(q_streamed / 1e9, 3),
-                "effective_param_stream_gbps": round(q_streamed / dt8 / 1e9, 1),
-            }
-        except Exception as e:
-            out["int8"] = f"skipped: {type(e).__name__}: {e}"
-        # Grouped int4 A/B (same in-run control): XLA bit-packs s4
-        # two-per-byte on TPU, so the weight stream halves again vs int8;
-        # the group-scale reduction adds a small [.., G, O] epilogue.
+        if qp is None:
+            out["int8"] = "skipped: no quantized tree"
+        else:
+            quant_leg("int8", qp)
         try:
             qp4 = jax.jit(lambda p: quantize_params(p, bits=4))(params)
-            dt4 = _decode_slope_s(qp4, prompt, cfg, short, long,
-                                  prompt_len + long)
-            if dt4 <= 0:
-                raise RuntimeError("non-positive int4 differencing slope")
-            q4_streamed = streamed_bytes(qp4)
-            out["int4"] = {
-                "decode_step_ms": round(dt4 * 1e3, 3),
-                "decode_tokens_per_s": round(batch / dt4, 1),
-                "speedup_vs_bf16": round(dt / dt4, 3),
-                "streamed_param_gb": round(q4_streamed / 1e9, 3),
-                "effective_param_stream_gbps": round(
-                    q4_streamed / dt4 / 1e9, 1),
-            }
         except Exception as e:
             out["int4"] = f"skipped: {type(e).__name__}: {e}"
+        else:
+            quant_leg("int4", qp4)
         # Long-context serving A/B: batch 32 x prompt 1024, where the KV
         # cache read (not the weight stream) dominates each step's HBM
         # traffic — the full int8 stack (weights + kv_dtype="int8" cache,

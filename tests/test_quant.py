@@ -371,3 +371,30 @@ def test_int4_moe_forward_runs_and_matches_twin():
     outd = forward(twin, toks, cfg)
     np.testing.assert_allclose(np.asarray(out4), np.asarray(outd),
                                atol=3e-5, rtol=3e-5)
+
+
+def test_int4_bf16_compute_path_runs_on_cpu():
+    """The int4 dot casts operands to f32 rather than relying on
+    bf16 x bf16 = f32 dot support (the CPU backend rejects that mode);
+    a bf16-compute int4 forward must run everywhere the suite does."""
+    cfg = ModelConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=64, max_seq=64,
+                      compute_dtype=jnp.bfloat16)
+    params = quantize_params(init_params(cfg, jax.random.key(0)),
+                             bits=4, group_size=8)
+    toks = jnp.asarray(np.random.default_rng(5).integers(0, 64, (2, 16)))
+    out = forward(params, toks, cfg)
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_int4_degraded_group_warns():
+    """A prime inner dim collapses the divisor walk toward per-element
+    scales; that regression must warn, not silently ship as 'int4'."""
+    import warnings
+
+    w = jnp.ones((13, 8))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        quantize_params({"embed": w, "lm_head": w, "final_norm": w[0],
+                         "layers": {"wq": w[None]}}, bits=4, group_size=4)
+    assert any("group size degraded" in str(r.message) for r in rec)

@@ -75,6 +75,20 @@ def test_int8_spec_decode_lossless_vs_int8_greedy():
     np.testing.assert_array_equal(want, np.asarray(got))
 
 
+@pytest.mark.slow
+def test_int4_spec_decode_lossless_vs_int4_greedy():
+    """Grouped int4 leaves carry a [L, G, g, out] layout; the draft's
+    leading-layer slice and the one-stream verify must still match the
+    int4 greedy path token-for-token (f32 compute here, so exact)."""
+    cfg8 = dataclasses.replace(CFG, kv_dtype="int8")
+    params = quantize_params(_params(), bits=4, group_size=16)
+    prompt = jax.random.randint(jax.random.key(4), (1, 6), 0, CFG.vocab_size)
+    want = np.asarray(generate(params, prompt, cfg8, max_new=8))
+    got, _ = spec_generate(params, prompt, cfg8, max_new=8,
+                           draft_layers=2, gamma=3)
+    np.testing.assert_array_equal(want, np.asarray(got))
+
+
 def test_draft_slice_validation_and_shapes():
     params = _params()
     dp, dc = draft_slice(params, CFG, 2)

@@ -72,6 +72,18 @@ def _quantize_leaf4(w: jax.Array, group: int) -> dict:
     g = max(1, min(group, din))
     while din % g:
         g -= 1
+    if g < min(group, din) and g < 8:
+        # The divisor walk collapsed (e.g. a prime input dim): with
+        # near-per-element f32 scales the "int4" tree streams MORE bytes
+        # than bf16 — surface the cliff instead of silently labeling a
+        # regression int4.
+        import warnings
+
+        warnings.warn(
+            f"int4 group size degraded to {g} for input dim {din} "
+            f"(requested {group}); scales now dominate the stream — "
+            "pick a group_size dividing the model's inner dims",
+            stacklevel=2)
     G = din // g
     wg = w.reshape(*lead, G, g, dout)
     amax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)
@@ -131,13 +143,21 @@ def qdot(x: jax.Array, w) -> jax.Array:
         # Grouped int4: per-group partial dots, scale, then sum over
         # groups.  The einsum reads the packed s4 tensor directly (the
         # convert fuses into the dot operand, as with int8); the group
-        # axis adds one cheap [.., G, O] reduction.
-        q = w["int4"].astype(x.dtype)                     # [..., G, g, O]
-        s = jnp.squeeze(w["scale"], axis=-2).astype(x.dtype)  # [..., G, O]
+        # axis adds one cheap [.., G, O] reduction.  Partials accumulate
+        # in f32 (preferred_element_type + f32 scales) — at bf16 compute
+        # a G-way chain of bf16 adds would stack ~eps*sqrt(G) error on
+        # top of the quantization error; the cast back to x.dtype happens
+        # once, after the group sum.
+        # f32 operands rather than preferred_element_type: the CPU
+        # backend's dot thunk rejects bf16 x bf16 = f32, and on TPU the
+        # s4->f32 convert fuses into the dot operand exactly like
+        # s4->bf16 would — the leg stays HBM-bound either way.
+        q = w["int4"].astype(jnp.float32)                 # [..., G, g, O]
+        s = jnp.squeeze(w["scale"], axis=-2)              # [..., G, O] f32
         G, g = q.shape[-3], q.shape[-2]
-        xg = x.reshape(*x.shape[:-1], G, g)
+        xg = x.reshape(*x.shape[:-1], G, g).astype(jnp.float32)
         part = jnp.einsum("...Gg,...Ggo->...Go", xg, q)
-        return (part * s).sum(axis=-2)
+        return (part * s).sum(axis=-2).astype(x.dtype)
     if is_quantized(w):
         s = jnp.squeeze(w["scale"], axis=-2).astype(x.dtype)
         return (x @ w["int8"].astype(x.dtype)) * s
