@@ -247,3 +247,71 @@ def test_train_cli_profile_writes_trace(tmp_path):
     traces = [p for p in (tmp_path / "plugins" / "profile").rglob("*")
               if p.is_file()]
     assert any(p.name.endswith(".xplane.pb") for p in traces), traces
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps=2 must produce the full-batch gradient exactly for the
+    dense model (cross-entropy means over equal chunks average to the
+    full-batch mean), so one step from the same state lands on the same
+    params and loss."""
+    from tputopo.workloads.train import make_train_state, train_step
+
+    cfg = ModelConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=64, max_seq=32,
+                      compute_dtype=jnp.float32)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, 64, (4, 16)))
+    s0 = make_train_state(cfg, jax.random.key(0))
+    s1, l1 = jax.jit(lambda s, t: train_step(s, t, cfg))(s0, tokens)
+    s0b = make_train_state(cfg, jax.random.key(0))
+    s2, l2 = jax.jit(lambda s, t: train_step(s, t, cfg, accum_steps=2))(
+        s0b, tokens)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5),
+        s1.params, s2.params)
+
+
+@pytest.mark.slow
+def test_sharded_grad_accumulation_runs_and_converges():
+    from tputopo.workloads.train import (make_sharded_state,
+                                         make_sharded_train_step)
+
+    cfg = ModelConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=64, max_seq=32,
+                      compute_dtype=jnp.float32)
+    plan = build_mesh({"dp": 2, "tp": 2, "sp": 2})
+    state = make_sharded_state(plan, cfg, jax.random.key(0))
+    step = make_sharded_train_step(plan, cfg, accum_steps=2)
+    toks = jnp.asarray(np.random.default_rng(1).integers(0, 64, (8, 32)))
+    prev = None
+    for _ in range(3):
+        state, loss = step(state, toks)
+        assert bool(jnp.isfinite(loss))
+        if prev is not None:
+            assert float(loss) < prev
+        prev = float(loss)
+
+
+@pytest.mark.slow
+def test_pipelined_grad_accumulation_composes():
+    """accum's lax.scan of value_and_grad over the shard_map pipeline
+    (pp>1) must stay differentiable and converge — the CLI advertises the
+    composition, so it gets its own regression test."""
+    from tputopo.workloads.train import (make_sharded_state,
+                                         make_sharded_train_step)
+
+    cfg = ModelConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=64, max_seq=32,
+                      compute_dtype=jnp.float32)
+    plan = build_mesh({"pp": 2, "dp": 2, "tp": 2})
+    state = make_sharded_state(plan, cfg, jax.random.key(0))
+    step = make_sharded_train_step(plan, cfg, accum_steps=2)
+    # batch quantum: dp * pp * accum = 8.
+    toks = jnp.asarray(np.random.default_rng(2).integers(0, 64, (8, 32)))
+    prev = None
+    for _ in range(3):
+        state, loss = step(state, toks)
+        assert bool(jnp.isfinite(loss))
+        if prev is not None:
+            assert float(loss) < prev
+        prev = float(loss)

@@ -78,10 +78,13 @@ def cmd_train(args) -> int:
         if restored is not None:
             state = restored
             resumed_from = int(state.step)
-    step = make_sharded_train_step(plan, config)
+    step = make_sharded_train_step(plan, config,
+                                   accum_steps=max(1, args.accum))
     rng = np.random.default_rng(0)
-    # Batch must shard over dp AND split into pp microbatches.
-    q = max(1, plan.axes["dp"]) * max(1, plan.axes["pp"])
+    # Batch must shard over dp, split into pp microbatches, AND divide
+    # into gradient-accumulation microbatches.
+    q = (max(1, plan.axes["dp"]) * max(1, plan.axes["pp"])
+         * max(1, args.accum))
     batch = max(q, args.batch // q * q)
     # Fixed batch: the convergence check is memorization, which must always
     # reduce loss — fresh random batches each step need not.
@@ -389,6 +392,11 @@ def main() -> int:
                    help="orbax checkpoint dir: resume if present, save at end "
                         "(and every --save-every steps)")
     p.add_argument("--save-every", type=int, default=0)
+    p.add_argument("--accum", type=int, default=1,
+                   help="gradient-accumulation microbatches per optimizer "
+                        "step: activation memory drops to one microbatch's "
+                        "worth while the update sees the full-batch "
+                        "gradient")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the steady-state "
                         "steps into DIR (open with XProf/TensorBoard; "

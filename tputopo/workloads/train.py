@@ -56,11 +56,42 @@ def loss_fn(params: Any, tokens: jax.Array, config: ModelConfig,
 
 
 def train_step(state: TrainState, tokens: jax.Array, config: ModelConfig,
-               lr: float = 3e-4,
-               forward_fn=forward_with_aux) -> tuple[TrainState, jax.Array]:
-    """One optimizer step; jit-able as-is (config/lr static via closure)."""
-    loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, config,
-                                              forward_fn)
+               lr: float = 3e-4, forward_fn=forward_with_aux,
+               accum_steps: int = 1) -> tuple[TrainState, jax.Array]:
+    """One optimizer step; jit-able as-is (config/lr static via closure).
+
+    ``accum_steps > 1`` splits the batch into that many microbatches and
+    runs forward+backward per microbatch under a ``lax.scan``, summing
+    grads and applying ONE optimizer update — activation memory drops to
+    one microbatch's worth (the scan serializes the backward) while the
+    update sees the full-batch gradient.  For the dense model the result
+    is the full-batch gradient exactly (cross-entropy means over equal
+    chunks average to the full mean); an MoE router's load-balancing aux
+    is averaged per-microbatch, a standard and benign difference.
+    """
+    if accum_steps <= 1:
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens,
+                                                  config, forward_fn)
+    else:
+        B = tokens.shape[0]
+        if B % accum_steps:
+            raise ValueError(
+                f"batch {B} not divisible by accum_steps {accum_steps}")
+        micro = tokens.reshape(accum_steps, B // accum_steps,
+                               tokens.shape[1])
+        micro = shardlib.constrain(micro, None, "dp", "sp")
+
+        def acc(carry, mb):
+            loss_sum, grad_sum = carry
+            l, g = jax.value_and_grad(loss_fn)(state.params, mb, config,
+                                               forward_fn)
+            return (loss_sum + l, jax.tree.map(jnp.add, grad_sum, g)), None
+
+        zeros = jax.tree.map(jnp.zeros_like, state.params)
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            acc, (jnp.zeros((), jnp.float32), zeros), micro)
+        loss = loss_sum / accum_steps
+        grads = jax.tree.map(lambda g: g / accum_steps, grad_sum)
     opt = make_optimizer(lr)
     updates, opt_state = opt.update(grads, state.opt_state, state.params)
     params = optax.apply_updates(state.params, updates)
@@ -90,7 +121,8 @@ def state_shardings(plan: shardlib.MeshPlan, config: ModelConfig,
 
 
 def make_sharded_train_step(plan: shardlib.MeshPlan, config: ModelConfig,
-                            lr: float = 3e-4, n_micro: int | None = None):
+                            lr: float = 3e-4, n_micro: int | None = None,
+                            accum_steps: int = 1):
     """Compile train_step with explicit in/out shardings over ``plan``.
 
     Params (and therefore AdamW moments, which mirror the param pytree)
@@ -98,6 +130,8 @@ def make_sharded_train_step(plan: shardlib.MeshPlan, config: ModelConfig,
     batch-over-dp, sequence-over-sp.  Donates the state buffers.  When the
     plan has pp > 1 the forward pass runs the SPMD pipeline
     (:mod:`tputopo.workloads.pipeline`) with ``n_micro`` microbatches.
+    ``accum_steps`` layers gradient accumulation on top (each accumulation
+    microbatch still splits over dp, and pipelines over pp when active).
     """
     shardings = state_shardings(plan, config, lr)
     if plan.axes.get("pp", 1) > 1:
@@ -109,7 +143,8 @@ def make_sharded_train_step(plan: shardlib.MeshPlan, config: ModelConfig,
 
     def step_fn(state: TrainState, tokens: jax.Array):
         with shardlib.activate(plan):
-            return train_step(state, tokens, config, lr, forward_fn=fwd)
+            return train_step(state, tokens, config, lr, forward_fn=fwd,
+                              accum_steps=accum_steps)
 
     return jax.jit(
         step_fn,
