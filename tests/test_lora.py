@@ -1,0 +1,138 @@
+"""LoRA adapters (tputopo.workloads.lora): the contract is that the
+adapter is invisible at init (b = 0), trains WITHOUT touching the frozen
+base, merges exactly into raw weights, and rides on a quantized base
+(the QLoRA serving shape)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tputopo.workloads.lora import (init_lora, lora_view, merge_lora,
+                                    make_sharded_lora_state,
+                                    make_sharded_lora_train_step)
+from tputopo.workloads.model import ModelConfig, forward, init_params
+from tputopo.workloads.quant import quantize_params
+from tputopo.workloads.sharding import build_mesh
+
+CFG = ModelConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                  n_kv_heads=2, d_ff=64, max_seq=32,
+                  compute_dtype=jnp.float32)
+
+
+def _toks(seed=0, shape=(4, 16)):
+    return jnp.asarray(np.random.default_rng(seed).integers(0, 64, shape))
+
+
+def test_zero_init_adapter_is_invisible():
+    base = init_params(CFG, jax.random.key(0))
+    lora = init_lora(CFG, jax.random.key(1), rank=4)
+    o_base = forward(base, _toks(), CFG)
+    o_lora = forward(lora_view(base, lora), _toks(), CFG)
+    np.testing.assert_array_equal(np.asarray(o_base), np.asarray(o_lora))
+
+
+def test_invalid_targets_are_loud():
+    with pytest.raises(ValueError, match="column-parallel"):
+        init_lora(CFG, jax.random.key(0), targets=("wo",))
+    with pytest.raises(ValueError, match="rank"):
+        init_lora(CFG, jax.random.key(0), rank=0)
+    lora = init_lora(CFG, jax.random.key(0), targets=("wq",))
+    lora["layers"]["nope"] = lora["layers"].pop("wq")
+    with pytest.raises(ValueError, match="not in base"):
+        lora_view(init_params(CFG, jax.random.key(0)), lora)
+
+
+def test_sharded_training_reduces_loss_and_freezes_base():
+    base = init_params(CFG, jax.random.key(0))
+    base0 = jax.tree.map(lambda a: np.asarray(a).copy(), base)
+    plan = build_mesh({"dp": 2, "sp": 2, "tp": 2})
+    state = make_sharded_lora_state(plan, CFG, jax.random.key(1), rank=4)
+    step = make_sharded_lora_train_step(plan, CFG, state.params)
+    toks = _toks(1)
+    prev = None
+    for _ in range(3):
+        state, loss = step(state, base, toks)
+        assert bool(jnp.isfinite(loss))
+        if prev is not None:
+            assert float(loss) < prev
+        prev = float(loss)
+    for a, b in zip(jax.tree.leaves(base), jax.tree.leaves(base0)):
+        np.testing.assert_array_equal(np.asarray(a), b)
+    # The adapter really moved (b left zero).
+    assert float(jnp.abs(state.params["layers"]["wq"]["b"]).max()) > 0
+
+
+def test_merged_weights_match_adapter_path():
+    base = init_params(CFG, jax.random.key(0))
+    lora = init_lora(CFG, jax.random.key(1), rank=4)
+    # Give the adapter a real delta.
+    lora["layers"]["wq"]["b"] = jax.random.normal(
+        jax.random.key(2), lora["layers"]["wq"]["b"].shape) * 0.02
+    o_view = forward(lora_view(base, lora), _toks(2), CFG)
+    o_merged = forward(merge_lora(base, lora), _toks(2), CFG)
+    np.testing.assert_allclose(np.asarray(o_view), np.asarray(o_merged),
+                               atol=3e-5, rtol=3e-5)
+
+
+def test_qlora_quantized_base_serves_and_refuses_merge():
+    """An int8 (or int4) base streams quantized under the adapter — and a
+    lossless merge into it is impossible, so merge must refuse."""
+    base = init_params(CFG, jax.random.key(0))
+    lora = init_lora(CFG, jax.random.key(1), rank=4)
+    lora["layers"]["wq"]["b"] = jax.random.normal(
+        jax.random.key(2), lora["layers"]["wq"]["b"].shape) * 0.02
+    for kw in ({"bits": 8}, {"bits": 4, "group_size": 8}):
+        qbase = quantize_params(base, **kw)
+        out = forward(lora_view(qbase, lora), _toks(3), CFG)
+        assert bool(jnp.isfinite(out).all())
+        with pytest.raises(ValueError, match="quantized"):
+            merge_lora(qbase, lora)
+
+
+@pytest.mark.slow
+def test_qlora_decode_matches_dequantized_twin():
+    """KV-cache decode through the wrapped tree: int8 base + adapter must
+    equal decoding the dequantized base + same adapter (the adapter is
+    orthogonal to the base's quantization)."""
+    from tputopo.workloads.decode import generate
+    from tputopo.workloads.quant import deq, is_quantized
+
+    base = init_params(CFG, jax.random.key(0))
+    lora = init_lora(CFG, jax.random.key(1), rank=4)
+    lora["layers"]["wq"]["b"] = jax.random.normal(
+        jax.random.key(2), lora["layers"]["wq"]["b"].shape) * 0.02
+    qbase = quantize_params(base)
+
+    def dequantize_tree(t):
+        if is_quantized(t):
+            return deq(t, jnp.float32)
+        if isinstance(t, dict):
+            return {k: dequantize_tree(v) for k, v in t.items()}
+        return t
+
+    prompt = _toks(4, (2, 8))
+    got = np.asarray(generate(lora_view(qbase, lora), prompt, CFG, max_new=6))
+    want = np.asarray(generate(lora_view(dequantize_tree(qbase), lora),
+                               prompt, CFG, max_new=6))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.slow
+def test_lora_pipeline_and_accum_compose():
+    """--lora-rank with pp>1 must run the GPipe pipeline forward (not a
+    plain scan over pp-sharded layers), and accum_steps must accumulate
+    adapter grads — both through one compiled step that converges."""
+    plan = build_mesh({"pp": 2, "dp": 2, "tp": 2})
+    base = init_params(CFG, jax.random.key(0))
+    state = make_sharded_lora_state(plan, CFG, jax.random.key(1), rank=4)
+    step = make_sharded_lora_train_step(plan, CFG, state.params,
+                                        accum_steps=2)
+    toks = _toks(5, (8, 32))  # dp * pp * accum = 8
+    prev = None
+    for _ in range(3):
+        state, loss = step(state, base, toks)
+        assert bool(jnp.isfinite(loss))
+        if prev is not None:
+            assert float(loss) < prev
+        prev = float(loss)

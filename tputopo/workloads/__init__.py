@@ -22,7 +22,10 @@ allocated GPUs).  The TPU-native analog here is twofold:
   conv-classifier second model family (the Gaia Exp.6 MNIST analog) in
   :mod:`tputopo.workloads.vision`.  A second context-parallel strategy —
   all-to-all (Ulysses-style) head re-sharding — lives in
-  :mod:`tputopo.workloads.ulysses`, selected via ``ModelConfig.sp_impl``.
+  :mod:`tputopo.workloads.ulysses`, selected via ``ModelConfig.sp_impl``;
+  multi-host gang rendezvous in :mod:`tputopo.workloads.distributed`;
+  LoRA parameter-efficient finetuning (quantized-base/QLoRA included) in
+  :mod:`tputopo.workloads.lora`.
 
 :mod:`tputopo.workloads.sharding` is the bridge between the scheduler and
 JAX: it turns a scheduled slice shape (a `Placement` from

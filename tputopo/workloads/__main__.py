@@ -69,7 +69,30 @@ def cmd_train(args) -> int:
         print(f"error: --pp {args.pp} must divide {config.n_layers} layers",
               file=sys.stderr)
         return 2
-    state = make_sharded_state(plan, config, jax.random.key(0))
+    lora_rank = getattr(args, "lora_rank", 0)
+    if lora_rank:
+        # Parameter-efficient finetuning: the base tree is frozen (here a
+        # fresh init standing in for restored pretrained weights; point
+        # --ckpt-dir at an adapter dir to resume the ADAPTER), only the
+        # LoRA TrainState trains/checkpoints.
+        from functools import partial
+
+        from tputopo.workloads import sharding as shardlib
+        from tputopo.workloads.lora import (make_sharded_lora_state,
+                                            make_sharded_lora_train_step)
+        from tputopo.workloads.model import init_params
+
+        with plan.mesh:
+            base = jax.jit(
+                partial(init_params, config),
+                out_shardings=shardlib.param_shardings(plan, config),
+            )(jax.random.key(0))
+        state = make_sharded_lora_state(plan, config, jax.random.key(1),
+                                        rank=lora_rank)
+        lora_step = make_sharded_lora_train_step(
+            plan, config, state.params, accum_steps=max(1, args.accum))
+    else:
+        state = make_sharded_state(plan, config, jax.random.key(0))
     resumed_from = None
     if args.ckpt_dir:
         from tputopo.workloads import checkpoint as ckptlib
@@ -78,8 +101,11 @@ def cmd_train(args) -> int:
         if restored is not None:
             state = restored
             resumed_from = int(state.step)
-    step = make_sharded_train_step(plan, config,
-                                   accum_steps=max(1, args.accum))
+    if lora_rank:
+        step = lambda s, t: lora_step(s, base, t)  # noqa: E731
+    else:
+        step = make_sharded_train_step(plan, config,
+                                       accum_steps=max(1, args.accum))
     rng = np.random.default_rng(0)
     # Batch must shard over dp, split into pp microbatches, AND divide
     # into gradient-accumulation microbatches.
@@ -397,6 +423,10 @@ def main() -> int:
                         "step: activation memory drops to one microbatch's "
                         "worth while the update sees the full-batch "
                         "gradient")
+    p.add_argument("--lora-rank", type=int, default=0,
+                   help="train only LoRA adapters of this rank on the "
+                        "attention q/v projections (base frozen; adapter "
+                        "checkpoints via --ckpt-dir)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the steady-state "
                         "steps into DIR (open with XProf/TensorBoard; "

@@ -1,0 +1,253 @@
+"""LoRA adapters: parameter-efficient finetuning of the flagship LM.
+
+A placement framework's workload layer schedules big pretrained models
+onto slices; finetuning all of their weights per task wastes both HBM
+(full AdamW moments) and checkpoint traffic.  LoRA trains a low-rank
+delta ``x @ a @ b * (alpha/rank)`` next to each frozen projection:
+
+- **Leaf wrapper, not a model fork**: a targeted projection becomes
+  ``{"lora_base": w, "lora_a": [L, d, r], "lora_b": [L, r, out],
+  "lora_scale": [L]}`` and :func:`tputopo.workloads.quant.qdot` — the
+  single matmul site every projection already goes through — adds the
+  low-rank term.  The stacked leading layer axis means the decode /
+  prefill / pipeline ``lax.scan`` machinery is untouched.
+- **Composes with quantization** (the QLoRA serving shape): the frozen
+  base may be an int8 or grouped-int4 leaf — the adapter rides on top of
+  the quantized stream, so a finetuned variant costs ``2 L d r`` extra
+  floats instead of a second full model copy.
+- **Training state is the adapter only**: the optimizer sees just the
+  a/b tensors (AdamW moments shrink by the same factor), the base tree
+  is a frozen argument.  ``b`` initializes to zero, so step 0's forward
+  equals the base model exactly.
+
+Sharding: ``a`` is replicated (tiny — d x r); ``b``'s output axis
+follows the base's column-parallel ``tp`` sharding so the delta lands
+already-sharded where the base dot's output lives.  Default targets are
+the attention q/v projections (the standard LoRA recipe); any
+column-parallel projection name works.  Row-parallel targets (wo,
+w_down) are rejected: their inputs arrive tp-sharded, and the low-rank
+contraction would need its own psum — a cost the adapter should not
+silently add.
+
+The reference schedules training containers and has no finetuning story
+(SURVEY §0); this is workload-layer capability (SURVEY §1 L5).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tputopo.workloads import sharding as shardlib
+from tputopo.workloads.model import ModelConfig
+
+#: Column-parallel projections LoRA may target ([.., d_in, d_out] with the
+#: output axis tp-sharded).  Row-parallel ones (wo, w_down) would need a
+#: psum for the adapter contraction — rejected, see module docstring.
+_COL_PARALLEL = ("wq", "wk", "wv", "w_gate", "w_up")
+DEFAULT_TARGETS = ("wq", "wv")
+
+
+def _target_dims(c: ModelConfig, name: str) -> tuple[int, int]:
+    return {
+        "wq": (c.d_model, c.n_heads * c.head_dim),
+        "wk": (c.d_model, c.n_kv_heads * c.head_dim),
+        "wv": (c.d_model, c.n_kv_heads * c.head_dim),
+        "w_gate": (c.d_model, c.d_ff),
+        "w_up": (c.d_model, c.d_ff),
+    }[name]
+
+
+def init_lora(config: ModelConfig, key: jax.Array, *, rank: int = 8,
+              alpha: float = 16.0,
+              targets: tuple[str, ...] = DEFAULT_TARGETS) -> dict:
+    """Adapter pytree: ``{"layers": {name: {"a", "b", "scale"}}}``.
+
+    ``a`` ~ N(0, 1/d) (the base init's scaling), ``b`` = 0 — the delta
+    starts exactly zero.  ``scale`` carries alpha/rank per layer so scan
+    slices stay self-contained.
+    """
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    for name in targets:
+        if name not in _COL_PARALLEL:
+            raise ValueError(
+                f"LoRA target {name!r} is not column-parallel; supported: "
+                f"{_COL_PARALLEL} (row-parallel targets would need their "
+                "own psum)")
+        if config.moe is not None and name in ("w_gate", "w_up"):
+            raise ValueError(
+                f"target {name!r} is an MoE expert table under this "
+                "config; adapter routing over experts is not supported")
+    L = config.n_layers
+    out = {}
+    for i, name in enumerate(targets):
+        din, dout = _target_dims(config, name)
+        k = jax.random.fold_in(key, i)
+        out[name] = {
+            "a": jax.random.normal(k, (L, din, rank), jnp.float32)
+            / jnp.sqrt(jnp.float32(din)),
+            "b": jnp.zeros((L, rank, dout), jnp.float32),
+            "scale": jnp.full((L,), alpha / rank, jnp.float32),
+        }
+    return {"layers": out}
+
+
+def lora_view(base_params: dict, lora: dict) -> dict:
+    """The parameter tree the forward pass consumes: targeted leaves
+    wrapped as lora dicts (qdot applies the delta), everything else the
+    frozen base.  Pure tree surgery — no copies of the base weights."""
+    layers = dict(base_params["layers"])
+    for name, ad in lora["layers"].items():
+        if name not in layers:
+            raise ValueError(f"lora target {name!r} not in base layers")
+        layers[name] = {"lora_base": layers[name], "lora_a": ad["a"],
+                        "lora_b": ad["b"], "lora_scale": ad["scale"]}
+    out = dict(base_params)
+    out["layers"] = layers
+    return out
+
+
+def merge_lora(base_params: dict, lora: dict) -> dict:
+    """Fold the adapter into raw float base weights (deployment without
+    the extra dot).  Quantized bases cannot merge losslessly — serve them
+    through the lora_view path instead (that IS the QLoRA shape)."""
+    from tputopo.workloads.quant import is_quantized
+
+    layers = dict(base_params["layers"])
+    for name, ad in lora["layers"].items():
+        w = layers[name]
+        if is_quantized(w):
+            raise ValueError(
+                f"cannot merge into quantized base leaf {name!r}; serve "
+                "via lora_view instead")
+        delta = jnp.einsum("ldr,lro->ldo", ad["a"], ad["b"])
+        layers[name] = w + delta * ad["scale"][:, None, None]
+    out = dict(base_params)
+    out["layers"] = layers
+    return out
+
+
+def lora_shardings(plan: shardlib.MeshPlan, lora: dict):
+    """NamedShardings for the adapter tree: ``a`` replicated (d x r is
+    tiny), ``b`` output-axis over ``tp`` (matching the base's
+    column-parallel layout), stacked layer axis over ``pp`` when active."""
+    pp = "pp" if plan.axes.get("pp", 1) > 1 else None
+
+    def leaf(name: str):
+        if name == "b":
+            return plan.sharding(pp, None, "tp")
+        if name == "a":
+            return plan.sharding(pp, None, None)
+        return plan.sharding(pp)  # scale [L]
+
+    return {"layers": {t: {k: leaf(k) for k in ad}
+                       for t, ad in lora["layers"].items()}}
+
+
+def make_sharded_lora_train_step(plan: shardlib.MeshPlan,
+                                 config: ModelConfig, lora: dict,
+                                 lr: float = 3e-4,
+                                 n_micro: int | None = None,
+                                 accum_steps: int = 1):
+    """Compile one LoRA optimizer step over ``plan``.
+
+    ``(lora_state, base_params, tokens) -> (lora_state, loss)`` — grads
+    flow only to the adapter (the base is a frozen argument; its
+    stop-gradient is implicit in differentiating w.r.t. the lora arg),
+    AdamW moments exist only for a/b, and only the adapter state is
+    donated.  The base may be raw f32/bf16 or a quantized serving tree
+    (the QLoRA shape).  Composes exactly like the full train step: the
+    forward runs the GPipe pipeline when the plan has pp > 1, and
+    ``accum_steps`` layers gradient accumulation on top.
+    """
+    import optax
+
+    from tputopo.workloads.model import forward_with_aux
+    from tputopo.workloads.train import (TrainState, loss_fn,
+                                         make_optimizer, opt_shardings)
+
+    ad_shard = lora_shardings(plan, lora)
+    state_shard = TrainState(
+        params=ad_shard,
+        opt_state=opt_shardings(make_optimizer(lr), lora, ad_shard, plan),
+        step=plan.replicated())
+    if plan.axes.get("pp", 1) > 1:
+        from functools import partial
+
+        from tputopo.workloads.pipeline import pipelined_forward_with_aux
+
+        fwd = partial(pipelined_forward_with_aux, plan=plan, n_micro=n_micro)
+    else:
+        fwd = forward_with_aux
+
+    def step_fn(state: TrainState, base_params, tokens):
+        with shardlib.activate(plan):
+            def lora_loss(adapter, mb):
+                return loss_fn(lora_view(base_params, adapter), mb,
+                               config, fwd)
+
+            if accum_steps <= 1:
+                loss, grads = jax.value_and_grad(lora_loss)(state.params,
+                                                            tokens)
+            else:
+                B = tokens.shape[0]
+                if B % accum_steps:
+                    raise ValueError(f"batch {B} not divisible by "
+                                     f"accum_steps {accum_steps}")
+                micro = tokens.reshape(accum_steps, B // accum_steps,
+                                       tokens.shape[1])
+                micro = shardlib.constrain(micro, None, "dp", "sp")
+
+                def acc(carry, mb):
+                    loss_sum, grad_sum = carry
+                    l, g = jax.value_and_grad(lora_loss)(state.params, mb)
+                    return (loss_sum + l,
+                            jax.tree.map(jnp.add, grad_sum, g)), None
+
+                zeros = jax.tree.map(jnp.zeros_like, state.params)
+                (loss_sum, grad_sum), _ = jax.lax.scan(
+                    acc, (jnp.zeros((), jnp.float32), zeros), micro)
+                loss = loss_sum / accum_steps
+                grads = jax.tree.map(lambda g: g / accum_steps, grad_sum)
+            opt = make_optimizer(lr)
+            updates, opt_state = opt.update(grads, state.opt_state,
+                                            state.params)
+            params = optax.apply_updates(state.params, updates)
+            return TrainState(params=params, opt_state=opt_state,
+                              step=state.step + 1), loss
+
+    return jax.jit(step_fn, donate_argnums=(0,),
+                   out_shardings=(state_shard, plan.replicated()))
+
+
+def make_sharded_lora_state(plan: shardlib.MeshPlan, config: ModelConfig,
+                            key: jax.Array, *, rank: int = 8,
+                            alpha: float = 16.0,
+                            targets: tuple[str, ...] = DEFAULT_TARGETS,
+                            lr: float = 3e-4):
+    """Adapter TrainState initialized directly into its sharded layout."""
+    from functools import partial
+
+    from tputopo.workloads.train import (TrainState, make_optimizer,
+                                         opt_shardings)
+
+    template = jax.eval_shape(partial(init_lora, config, rank=rank,
+                                      alpha=alpha, targets=targets), key)
+    ad_shard = lora_shardings(plan, template)
+    shardings = TrainState(
+        params=ad_shard,
+        opt_state=opt_shardings(make_optimizer(lr), template, ad_shard,
+                                plan),
+        step=plan.replicated())
+
+    @partial(jax.jit, out_shardings=shardings)
+    def init():
+        lora = init_lora(config, key, rank=rank, alpha=alpha,
+                         targets=targets)
+        return TrainState(params=lora,
+                          opt_state=make_optimizer(lr).init(lora),
+                          step=jnp.zeros((), jnp.int32))
+
+    with plan.mesh:
+        return init()

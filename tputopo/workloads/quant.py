@@ -132,13 +132,23 @@ def quantize_params(params: dict, *, bits: int = 8,
 
 
 def qdot(x: jax.Array, w) -> jax.Array:
-    """``x @ w`` for a raw or quantized weight.
+    """``x @ w`` for a raw, quantized, or LoRA-wrapped weight.
 
     Quantized: ``(x @ q) * s`` — scale applied after the contraction, so
     the dot's HBM read is the int8 tensor.  ``w`` may carry leading batch
     axes (a scan slice or a stacked expert table); the scale's kept
     ``in`` axis is squeezed to broadcast over the dot output.
+
+    LoRA (``{"lora_base", "lora_a", "lora_b", "lora_scale"}`` — see
+    workloads/lora.py): the frozen base dot (itself raw or quantized)
+    plus the low-rank delta ``(x @ a) @ b * scale``.  The adapter math
+    runs in f32 (a/b are f32 masters being trained) and casts once.
     """
+    if isinstance(w, dict) and "lora_base" in w:
+        base = qdot(x, w["lora_base"])
+        xf = x.astype(jnp.float32)
+        delta = (xf @ w["lora_a"]) @ w["lora_b"] * w["lora_scale"]
+        return base + delta.astype(base.dtype)
     if _is_int4(w):
         # Grouped int4: per-group partial dots, scale, then sum over
         # groups.  The einsum reads the packed s4 tensor directly (the

@@ -99,25 +99,36 @@ def train_step(state: TrainState, tokens: jax.Array, config: ModelConfig,
                       step=state.step + 1), loss
 
 
+def opt_shardings(opt: optax.GradientTransformation, template,
+                  tree_shard, plan: shardlib.MeshPlan):
+    """Optimizer-state shardings for any trainable tree: AdamW moments
+    mirror the tree's own shardings, counts/schedule scalars replicated.
+    Shared by the full-model state and the LoRA adapter state so the two
+    never diverge when the optimizer recipe changes."""
+
+    def fix(node):
+        if isinstance(node, optax.ScaleByAdamState):
+            return optax.ScaleByAdamState(
+                count=plan.replicated(), mu=tree_shard, nu=tree_shard)
+        return jax.tree.map(lambda _: plan.replicated(), node)
+
+    dummy = jax.eval_shape(opt.init, template)
+    return jax.tree.map(
+        fix, dummy, is_leaf=lambda n: isinstance(n, optax.ScaleByAdamState))
+
+
 def state_shardings(plan: shardlib.MeshPlan, config: ModelConfig,
                     lr: float = 3e-4) -> TrainState:
     """NamedSharding pytree for the full TrainState: params per the
     Megatron-style layout, AdamW moments mirroring the params they track,
     scalars replicated."""
     pshard = shardlib.param_shardings(plan, config)
-
-    def fix(node):
-        if isinstance(node, optax.ScaleByAdamState):
-            return optax.ScaleByAdamState(
-                count=plan.replicated(), mu=pshard, nu=pshard)
-        return jax.tree.map(lambda _: plan.replicated(), node)
-
-    dummy_opt = make_optimizer(lr).init(
-        jax.eval_shape(partial(init_params, config), jax.random.key(0)))
-    opt_shard = jax.tree.map(
-        fix, dummy_opt, is_leaf=lambda n: isinstance(n, optax.ScaleByAdamState))
-    return TrainState(params=pshard, opt_state=opt_shard,
-                      step=plan.replicated())
+    template = jax.eval_shape(partial(init_params, config),
+                              jax.random.key(0))
+    return TrainState(
+        params=pshard,
+        opt_state=opt_shardings(make_optimizer(lr), template, pshard, plan),
+        step=plan.replicated())
 
 
 def make_sharded_train_step(plan: shardlib.MeshPlan, config: ModelConfig,
