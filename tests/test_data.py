@@ -1,0 +1,109 @@
+"""Token-stream data loading (tputopo.workloads.data): deterministic,
+disjoint-by-construction rank shards, exact resume, static shapes."""
+
+import numpy as np
+import pytest
+
+from tputopo.workloads.data import (TokenDataset, batch_iterator,
+                                    steps_per_epoch, write_tokens)
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    path = str(tmp_path / "tokens.bin")
+    rng = np.random.default_rng(0)
+    write_tokens(path, rng.integers(0, 1000, 4096))
+    return TokenDataset(path)
+
+
+def test_roundtrip_and_shapes(corpus):
+    assert len(corpus) == 4096
+    b = corpus.batch(0, batch=4, seq=16)
+    assert b.shape == (4, 16) and b.dtype == np.int32
+    assert corpus.max_token() < 1000
+
+
+def test_write_rejects_overflow(tmp_path):
+    with pytest.raises(ValueError, match="do not fit"):
+        write_tokens(str(tmp_path / "t.bin"), [0, 70000], "uint16")
+
+
+def test_batches_are_deterministic_and_resumable(corpus):
+    a = corpus.batch(7, batch=4, seq=16, seed=3)
+    b = corpus.batch(7, batch=4, seq=16, seed=3)
+    np.testing.assert_array_equal(a, b)
+    # Iterator resume from a checkpointed step replays the schedule.
+    it = batch_iterator(corpus, 4, 16, start_step=7, seed=3)
+    np.testing.assert_array_equal(next(it), a)
+
+
+def test_rank_shards_are_disjoint_within_a_step(corpus):
+    """world ranks draw disjoint windows in every step — the property
+    that lets a dp gang load with zero coordination."""
+    seq, batch, world = 16, 4, 4
+    for step in range(3):
+        seen: set[tuple] = set()
+        for rank in range(world):
+            b = corpus.batch(step, batch, seq, rank=rank, world=world,
+                             seed=1)
+            for row in b:
+                key = tuple(row.tolist())
+                assert key not in seen, f"window repeated in step {step}"
+                seen.add(key)
+
+
+def test_epoch_covers_all_windows_once(corpus):
+    """Within one epoch every non-overlapping window appears at most once
+    across all steps and ranks (a permutation, not sampling)."""
+    seq, batch, world = 16, 8, 2
+    spe = steps_per_epoch(corpus, batch, seq, world)
+    starts: set[int] = set()
+    toks = np.asarray(corpus.tokens)
+    window_of = {toks[i * seq:(i + 1) * seq].tobytes(): i
+                 for i in range(corpus.n_windows(seq))}
+    for step in range(spe):
+        for rank in range(world):
+            for row in corpus.batch(step, batch, seq, rank=rank,
+                                    world=world, seed=2):
+                w = window_of[row.astype(corpus.tokens.dtype).tobytes()]
+                assert w not in starts
+                starts.add(w)
+    assert len(starts) == spe * world * batch
+
+
+def test_epoch_rollover_reshuffles(corpus):
+    seq, batch = 16, 4
+    spe = steps_per_epoch(corpus, batch, seq)
+    first = corpus.batch(0, batch, seq, seed=5)
+    again = corpus.batch(spe, batch, seq, seed=5)  # epoch 1, slot 0
+    assert not np.array_equal(first, again)
+
+
+def test_too_small_corpus_is_loud(corpus):
+    with pytest.raises(ValueError, match="windows"):
+        corpus.batch(0, batch=300, seq=16)
+    with pytest.raises(ValueError, match="rank"):
+        corpus.batch(0, batch=2, seq=16, rank=2, world=2)
+
+
+def test_train_cli_on_real_corpus(tmp_path):
+    """End-to-end: the train CLI consumes a corpus file and exits 0 with
+    finite losses (fresh batches need not fall monotonically)."""
+    import json
+    import subprocess
+    import sys
+
+    path = str(tmp_path / "corpus.bin")
+    write_tokens(path, np.random.default_rng(1).integers(0, 2048, 8192))
+    code = (
+        "import jax, sys; jax.config.update('jax_platforms', 'cpu'); "
+        f"sys.argv = ['x', 'train', '--steps', '3', '--seq', '32', "
+        f"'--batch', '2', '--data', {path!r}]; "
+        "from tputopo.workloads.__main__ import main; "
+        "raise SystemExit(main())")
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads(
+        [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+    assert report["final_step"] == 3

@@ -25,7 +25,8 @@ allocated GPUs).  The TPU-native analog here is twofold:
   :mod:`tputopo.workloads.ulysses`, selected via ``ModelConfig.sp_impl``;
   multi-host gang rendezvous in :mod:`tputopo.workloads.distributed`;
   LoRA parameter-efficient finetuning (quantized-base/QLoRA included) in
-  :mod:`tputopo.workloads.lora`.
+  :mod:`tputopo.workloads.lora`; memory-mapped token-corpus loading with
+  deterministic per-rank sharding in :mod:`tputopo.workloads.data`.
 
 :mod:`tputopo.workloads.sharding` is the bridge between the scheduler and
 JAX: it turns a scheduled slice shape (a `Placement` from

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 
@@ -112,8 +113,49 @@ def cmd_train(args) -> int:
     q = (max(1, plan.axes["dp"]) * max(1, plan.axes["pp"])
          * max(1, args.accum))
     batch = max(q, args.batch // q * q)
-    # Fixed batch: the convergence check is memorization, which must always
-    # reduce loss — fresh random batches each step need not.
+    data_path = getattr(args, "data", None)
+    batch_for = None
+    if data_path:
+        # Real corpus: deterministic disjoint shards per (step, process) —
+        # resumable from the checkpointed step (workloads/data.py).
+        from tputopo.workloads.data import TokenDataset
+
+        ds = TokenDataset(data_path, dtype=args.data_dtype)
+        hi = ds.max_token()
+        if hi >= config.vocab_size:
+            print(f"error: corpus has token id {hi} >= vocab "
+                  f"{config.vocab_size}", file=sys.stderr)
+            return 2
+        nproc = jax.process_count()
+        if batch % nproc:
+            print(f"error: batch {batch} not divisible by {nproc} "
+                  "processes", file=sys.stderr)
+            return 2
+        if nproc > 1 and plan.axes.get("dp", 1) % nproc:
+            # Per-process shards stitch into the global batch along dp;
+            # a dp axis that doesn't split over the processes would
+            # declare differing host-local halves "replicated" — silent
+            # divergence, the one failure mode worse than an error.
+            print(f"error: --data with {nproc} processes needs the dp "
+                  f"axis ({plan.axes.get('dp', 1)}) divisible by the "
+                  "process count", file=sys.stderr)
+            return 2
+
+        def batch_for(i: int):
+            local = ds.batch(i, batch // nproc, args.seq,
+                             rank=jax.process_index(), world=nproc)
+            arr = jnp.asarray(local)
+            if nproc > 1:
+                from jax.experimental import multihost_utils
+                from jax.sharding import PartitionSpec as P
+
+                arr = multihost_utils.host_local_array_to_global_array(
+                    arr, plan.mesh, P("dp", None))
+            return arr
+
+    # Fixed synthetic batch otherwise: the convergence check is
+    # memorization, which must always reduce loss — fresh random batches
+    # each step need not.
     tokens = jnp.asarray(rng.integers(0, config.vocab_size, (batch, args.seq)))
 
     # Graceful preemption: kubernetes sends SIGTERM (then SIGKILL after
@@ -157,6 +199,8 @@ def cmd_train(args) -> int:
     profiling = False
     try:
         for i in range(args.steps):
+            if batch_for is not None:
+                tokens = batch_for(i + (resumed_from or 0))
             state, loss = step(state, tokens)
             losses.append(float(loss))
             if args.profile and i == 0 and args.steps > 1:
@@ -206,6 +250,10 @@ def cmd_train(args) -> int:
         # forward.  WITHOUT --ckpt-dir nothing was preserved — exit
         # nonzero so the work is retried, not silently recorded as done.
         return 0 if args.ckpt_dir else 1
+    if batch_for is not None:
+        # Fresh corpus batches each step need not reduce loss monotonically
+        # (the memorization check is for the fixed synthetic batch).
+        return 0 if all(math.isfinite(l) for l in losses) else 1
     return 0 if losses[-1] < losses[0] or resumed_from else 1
 
 
@@ -423,6 +471,13 @@ def main() -> int:
                         "step: activation memory drops to one microbatch's "
                         "worth while the update sees the full-batch "
                         "gradient")
+    p.add_argument("--data", default=None, metavar="TOKENS.bin",
+                   help="train on a flat binary token-id corpus "
+                        "(np.memmap'd; deterministic disjoint shards per "
+                        "step/process, resumable) instead of the fixed "
+                        "synthetic batch")
+    p.add_argument("--data-dtype", default="uint16",
+                   help="stored token dtype of --data (uint16 default)")
     p.add_argument("--lora-rank", type=int, default=0,
                    help="train only LoRA adapters of this rank on the "
                         "attention q/v projections (base frozen; adapter "
