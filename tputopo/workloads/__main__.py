@@ -368,6 +368,12 @@ def cmd_serve(args) -> int:
     lens = rng.integers(max(1, args.prompt_len // 4), args.prompt_len + 1,
                         args.requests)
     max_len = args.prefix_len + args.prompt_len + args.max_new
+    on_tokens = None
+    if getattr(args, "stream", False):
+        # JSONL stream ahead of the final summary line: one record per
+        # engine tick per request with its newly committed tokens.
+        def on_tokens(rid, toks):
+            print(json.dumps({"rid": rid, "tokens": toks}), flush=True)
     with shardlib.activate(plan):
         if args.spec_draft_layers:
             from tputopo.workloads.speculative import SpecServingEngine
@@ -376,13 +382,15 @@ def cmd_serve(args) -> int:
                                     max_len=max_len,
                                     prompt_pad=args.prompt_len,
                                     draft_layers=args.spec_draft_layers,
-                                    gamma=args.spec_gamma)
+                                    gamma=args.spec_gamma,
+                                    on_tokens=on_tokens)
         else:
             eng = ServingEngine(params, cfg, slots=args.slots,
                                 max_len=max_len,
                                 prompt_pad=args.prompt_len,
                                 steps_per_tick=args.steps_per_tick,
-                                prefill_chunk=args.prefill_chunk)
+                                prefill_chunk=args.prefill_chunk,
+                                on_tokens=on_tokens)
         pid = None
         if args.prefix_len:
             # Shared system-prompt demo: its KV computes once, every
@@ -406,6 +414,10 @@ def cmd_serve(args) -> int:
         "tokens_per_s": round(generated / dt, 1),
         "wall_s": round(dt, 3),
     }
+    if getattr(args, "stream", False):
+        # The timed window includes the stream's host I/O: mark the
+        # record so throughput is not compared across flag sets.
+        out["stream"] = True
     if args.spec_draft_layers:
         out["drafted_accepted"] = eng.metrics["drafted_accepted"]
     print(json.dumps(out))
@@ -516,6 +528,12 @@ def main() -> int:
     p.add_argument("--prefix-len", type=int, default=0,
                    help="shared system-prompt length: its KV computes once "
                         "(register_prefix) and every request reuses it")
+    p.add_argument("--stream", action="store_true",
+                   help="emit a JSONL token stream ({rid, tokens} per "
+                        "engine tick) ahead of the final summary line; "
+                        "the summary's tokens_per_s then includes the "
+                        "stream's host I/O (it carries stream:true so "
+                        "numbers are not compared across flag sets)")
     p.add_argument("--int8", action="store_true",
                    help="full int8 serving stack: weights + KV cache")
     p.add_argument("--int4", action="store_true",

@@ -495,7 +495,8 @@ class ServingEngine:
                  key: jax.Array | None = None,
                  steps_per_tick: int = 1,
                  prefill_chunk: int | None = None,
-                 buffer_margin: int = 0) -> None:
+                 buffer_margin: int = 0,
+                 on_tokens=None) -> None:
         buckets = ((prompt_pad,) if isinstance(prompt_pad, int)
                    else tuple(sorted(set(prompt_pad))))
         if not buckets or any(b < 1 for b in buckets):
@@ -525,6 +526,18 @@ class ServingEngine:
         self.key = key if key is not None else jax.random.key(0)
         self.steps_per_tick = steps_per_tick
         self.prefill_chunk = prefill_chunk
+        # Streaming: ``on_tokens(rid, [token_ids])`` fires after each
+        # engine tick with the GENERATED tokens newly committed for that
+        # request (prompt tokens are the caller's own input; chunked
+        # prefill progress is not streamed).  Granularity is the tick —
+        # up to steps_per_tick tokens per call — which is the natural TPU
+        # batching; enabling it costs one extra host readback per tick,
+        # so the hot path is untouched when no callback is set.
+        self.on_tokens = on_tokens
+        # rid -> emission cursor, seeded at submit() with the prompt
+        # length (prompt tokens are the caller's own input); empty — and
+        # untouched — when no callback is set.
+        self._streamed: dict[int, int] = {}
         # buffer_margin: extra cache/token rows past the logical max_len
         # (which still bounds submissions) for subclasses whose device
         # programs write fixed-width windows at the frontier — the
@@ -606,6 +619,8 @@ class ServingEngine:
                 f"max_len {self.max_len}")
         rid = self._next_id
         self._next_id += 1
+        if self.on_tokens is not None:
+            self._streamed[rid] = plen
         self._queue.append((rid, prompt, max_new, prefix))
         return rid
 
@@ -720,6 +735,10 @@ class ServingEngine:
             if rid >= 0:
                 self._results[rid] = tokens[slot, : int(length[slot])].tolist()
                 self.metrics["finished"] += 1
+                # Streaming bookkeeping: the final emission happened at
+                # the end of the tick that finished this slot (before
+                # this harvest).
+                self._streamed.pop(rid, None)
             clear.append(int(slot))
         idx = jnp.asarray(clear, jnp.int32)
         self.state = self.state._replace(
@@ -739,6 +758,30 @@ class ServingEngine:
         self._admit_pending()
         if bool(np.asarray(self.state.active).any()):
             self._decode_tick()
+        if self.on_tokens is not None:
+            self._emit_stream()
+
+    def _emit_stream(self) -> None:
+        """Fire ``on_tokens`` with each live request's newly committed
+        generated tokens (length growth past its prompt since the last
+        emission).  Runs before harvest clears a finished slot, so the
+        final tokens — EOS included — stream before run() returns them."""
+        seq = np.asarray(self.state.seq_id)
+        length = np.asarray(self.state.length)
+        tokens = None
+        for slot in range(self.slots):
+            rid = int(seq[slot])
+            if rid < 0:
+                continue
+            sent = self._streamed.get(rid)
+            if sent is None:
+                continue
+            cur = int(length[slot])
+            if cur > sent:
+                if tokens is None:  # one readback, only when needed
+                    tokens = np.asarray(self.state.tokens)
+                self.on_tokens(rid, tokens[slot, sent:cur].tolist())
+                self._streamed[rid] = cur
 
     def _decode_tick(self) -> None:
         """``steps_per_tick`` batched decode steps, chained device-side

@@ -338,7 +338,7 @@ class SpecServingEngine(ServingEngine):
 
     def __init__(self, params: dict, config: ModelConfig, *, slots: int,
                  max_len: int, prompt_pad, draft_layers: int,
-                 gamma: int = 4, eos_id: int = -1) -> None:
+                 gamma: int = 4, eos_id: int = -1, on_tokens=None) -> None:
         if gamma < 1:
             raise ValueError(f"gamma must be >= 1, got {gamma}")
         self.gamma = gamma
@@ -349,7 +349,7 @@ class SpecServingEngine(ServingEngine):
         # contract); submissions stay bounded by the logical max_len.
         super().__init__(params, config, slots=slots, max_len=max_len,
                          prompt_pad=prompt_pad, eos_id=eos_id,
-                         buffer_margin=gamma + 1)
+                         buffer_margin=gamma + 1, on_tokens=on_tokens)
         self._dcache = _constrain_cache(
             KVCache.create(self.draft_cfg, slots, max_len + gamma + 1))
         self._dlen = jnp.zeros((slots,), jnp.int32)
