@@ -112,6 +112,7 @@ def test_health_metrics_state_policy(server):
     assert "tputopo_extender_sort_requests_total 1" in metrics
     assert "tputopo_extender_bind_success_total 1" in metrics
     assert "tputopo_extender_sort_latency_p50_ms" in metrics
+    assert "tputopo_extender_sort_latency_p95_ms" in metrics
 
     _, state_raw = get(srv, "/state")
     state = json.loads(state_raw)

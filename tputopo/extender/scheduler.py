@@ -75,8 +75,21 @@ class Metrics:
         self.latencies_ms.setdefault(name, []).append(ms)
 
     def p50_ms(self, name: str) -> float | None:
+        return (self.quantiles_ms(name, (0.5,)) or (None,))[0]
+
+    def p95_ms(self, name: str) -> float | None:
+        return (self.quantiles_ms(name, (0.95,)) or (None,))[0]
+
+    def quantiles_ms(self, name: str,
+                     qs: tuple[float, ...]) -> tuple[float, ...] | None:
+        """Several quantiles from ONE sort (scrapes ask for p50+p95 on
+        ever-growing lists), using the same rank convention as bench.py's
+        pct() — ``xs[max(0, int(n*q) - 1)]`` — so the exported p95 and
+        the benched/gated p95 agree on identical data."""
         xs = sorted(self.latencies_ms.get(name, []))
-        return xs[len(xs) // 2] if xs else None
+        if not xs:
+            return None
+        return tuple(xs[max(0, int(len(xs) * q) - 1)] for q in qs)
 
 
 def _wanted_generation(pod: dict) -> str | None:

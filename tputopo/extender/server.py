@@ -137,9 +137,12 @@ class _Handler(BaseHTTPRequestHandler):
         for name, v in sorted(m.counters.items()):
             lines.append(f"tputopo_extender_{name}_total {v}")
         for verb in sorted(m.latencies_ms):
-            p50 = m.p50_ms(verb)
-            if p50 is not None:
-                lines.append(f"tputopo_extender_{verb}_latency_p50_ms {p50:.3f}")
+            qs = m.quantiles_ms(verb, (0.5, 0.95))
+            if qs is not None:
+                # Tail latency is what a scheduling SLO is written against
+                # (the scale bench gates on p95 for the same reason).
+                lines.append(f"tputopo_extender_{verb}_latency_p50_ms {qs[0]:.3f}")
+                lines.append(f"tputopo_extender_{verb}_latency_p95_ms {qs[1]:.3f}")
         return "\n".join(lines) + "\n"
 
 
