@@ -155,3 +155,29 @@ def test_dryrun_multichip_is_cpu_only_and_hang_immune():
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "dryrun_multichip OK" in proc.stdout
     assert "multislice" in proc.stdout
+
+
+def test_calibration_provenance_split_lands(monkeypatch, capsys,
+                                            restore_sigterm):
+    """When the hbm sub-bench reports a measurement, the calibration
+    record must carry the calibrated/spec_only provenance split — a
+    deployer needs to know which cost-model axes are measured vs
+    spec-sheet (the design.md:47 weight-table lesson)."""
+    _stub_headline(monkeypatch)
+    monkeypatch.delenv("BENCH_BUDGET_S", raising=False)  # need budget > 45s
+    monkeypatch.setattr(bench, "_tpu_preflight",
+                        lambda t: {"ok": True, "platform": "stub"})
+
+    def fake_sub(name, timeout_s, extra):
+        if name == "hbm":
+            return {"measured_hbm_gbps": 600.0, "generation": "v5e"}
+        return {"skipped": "stub"}
+
+    monkeypatch.setattr(bench, "_run_sub", fake_sub)
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip())
+    cal = out["extras"]["calibration"]
+    assert cal["provenance"]["calibrated"] == ["hbm_gbps"]
+    assert "dcn_host_gbps" in cal["provenance"]["spec_only"]
+    assert "ici_link_gbps" in cal["provenance"]["spec_only"]
+    assert cal["cost_override"]["v5e"]["hbm_gbps"] == 600.0
