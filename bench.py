@@ -37,6 +37,15 @@ import time
 GAIA_SCHED_MS = 2700.0  # Gaia topology-aware mean scheduling time, PDF Fig. 10
 
 
+def pct(xs: list[float], q: float) -> float:
+    """Ceil-based rank quantile, in lockstep with the extender's exported
+    Metrics.quantiles_ms (scheduler.quantile) so the benched p95 and the
+    /metrics p95 are the same statistic on identical data."""
+    from tputopo.extender.scheduler import quantile
+
+    return quantile(sorted(xs), q)
+
+
 def bench_scheduler(repeats: int = 5) -> dict:
     from tests.cluster import build_cluster
     from tputopo.extender.config import ExtenderConfig
@@ -117,10 +126,9 @@ def bench_scheduler(repeats: int = 5) -> dict:
             raise SystemExit("bench: gang replicas did not tile disjointly")
         informer.stop()
 
-    lat_ms.sort()
     return {
-        "p50_ms": statistics.median(lat_ms),
-        "p95_ms": lat_ms[int(len(lat_ms) * 0.95) - 1],
+        "p50_ms": pct(lat_ms, 0.5),
+        "p95_ms": pct(lat_ms, 0.95),
         "pods_scheduled": len(lat_ms),
         "quality_vs_ideal": min(quality) if quality else None,
     }
@@ -371,10 +379,6 @@ def bench_scale(n_domains: int = 4, spec: str = "v5p:8x8x4",
 
     informer.stop()
 
-    def pct(xs: list[float], q: float) -> float:
-        xs = sorted(xs)
-        return xs[max(0, int(len(xs) * q) - 1)]
-
     sort_ms = sched.metrics.latencies_ms.get("sort", [])
     bind_ms = sched.metrics.latencies_ms.get("bind", [])
     c = sched.metrics.counters
@@ -388,9 +392,9 @@ def bench_scale(n_domains: int = 4, spec: str = "v5p:8x8x4",
         "pods": pods_created,
         "sorts": len(sort_ms),
         "binds": len(bind_ms),
-        "sort_p50_ms": round(statistics.median(sort_ms), 3),
+        "sort_p50_ms": round(pct(sort_ms, 0.5), 3),
         "sort_p95_ms": round(pct(sort_ms, 0.95), 3),
-        "bind_p50_ms": round(statistics.median(bind_ms), 3),
+        "bind_p50_ms": round(pct(bind_ms, 0.5), 3),
         "bind_p95_ms": round(pct(bind_ms, 0.95), 3),
         "state_cache_hit_rate": round(hits / max(1, hits + builds), 3),
         "gang_plan_reuse_hits": c.get("gang_plan_reuse_hits", 0),
@@ -419,6 +423,45 @@ def bench_scale(n_domains: int = 4, spec: str = "v5p:8x8x4",
         raise SystemExit(
             f"bench scale: {out['informer']['lists']} LISTs — steady state "
             "must be watch-driven (one initial LIST per kind)")
+    return out
+
+
+def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0) -> dict:
+    """Trace-driven sim scenario (tputopo.sim): one deterministic Poisson
+    trace replayed under the ICI-aware policy AND the count-only baseline,
+    reported as the A/B block future perf/policy PRs diff against.  Pure
+    CPU Python, virtual time — runs in seconds.  Refuses to publish
+    (SystemExit) when the A/B delta is exactly zero on every axis: that
+    means the harness stopped distinguishing policies, which is the one
+    way this scenario can silently rot."""
+    from tputopo.sim.engine import run_trace
+    from tputopo.sim.trace import TraceConfig
+
+    cfg = TraceConfig(seed=seed, nodes=nodes, arrivals=arrivals)
+    report = run_trace(cfg, ["ici", "naive"])
+    deltas = report["ab"]["deltas"]["ici-vs-naive"]
+    if not any(v != 0 for v in deltas.values()):
+        raise SystemExit("bench sim: zero A/B delta on every axis — the "
+                         "sim no longer distinguishes policies")
+    out = {
+        "nodes": report["trace"]["nodes"],
+        "chips": report["trace"]["chips"],
+        "arrivals": arrivals,
+        "virtual_horizon_s": report["virtual_horizon_s"],
+        "ab_deltas": deltas,
+    }
+    for name in ("ici", "naive"):
+        p = report["policies"][name]
+        out[name] = {
+            "queue_wait_p50_s": p["queue_wait_s"]["p50"],
+            "queue_wait_p95_s": p["queue_wait_s"]["p95"],
+            "utilization": p["chip_utilization"]["time_weighted_mean"],
+            "fragmentation": p["fragmentation"]["time_weighted_mean"],
+            "bw_vs_ideal": p["ici_bw_score"]["mean_vs_ideal"],
+            "contiguous_frac": p["ici_bw_score"]["contiguous_frac"],
+            "scheduled": p["jobs"]["scheduled"],
+            "ghost_reclaimed": p["jobs"]["ghost_reclaimed"],
+        }
     return out
 
 
@@ -1477,6 +1520,7 @@ def main() -> None:
     extras["scale"] = isolated("scale", bench_scale, strict=True)
     extras["bandwidth_gain_vs_count_only"] = isolated(
         "ab_gain", bench_ab_gain, strict=True)
+    extras["sim"] = isolated("sim", bench_sim, strict=True)
 
     try:
         preflight_cap = float(os.environ.get("BENCH_TPU_PREFLIGHT_S", "120"))

@@ -71,6 +71,17 @@ def test_epoch_covers_all_windows_once(corpus):
     assert len(starts) == spe * world * batch
 
 
+def test_seed_epoch_pairs_do_not_collide(corpus):
+    """(seed=1, epoch=0) must not replay (seed=0, epoch=1)'s permutation:
+    the old key=seed+epoch folding made nominally independent runs replay
+    each other's epoch schedules shifted by one (ADVICE r5)."""
+    import numpy as np
+
+    a = corpus._perm(128, seed=1, epoch=0)
+    b = corpus._perm(128, seed=0, epoch=1)
+    assert not np.array_equal(a, b)
+
+
 def test_epoch_rollover_reshuffles(corpus):
     seq, batch = 16, 4
     spe = steps_per_epoch(corpus, batch, seq)

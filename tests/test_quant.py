@@ -331,6 +331,22 @@ def test_int4_qdot_matches_deq_reference():
                                atol=2e-5, rtol=2e-5)
 
 
+def test_int4_qdot_rejects_unsliced_stacked_leaf():
+    """The int4 group einsum cannot broadcast x's batch ellipsis against
+    a weight's leading layer/expert axis; an un-sliced stacked leaf must
+    error loudly (scan-slice contract in qdot's docstring), not broadcast
+    silently wrong when the dims happen to coincide."""
+    rng = np.random.default_rng(4)
+    w = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+    stacked = quantize_params({"embed": w, "lm_head": w, "final_norm": w[0],
+                               "layers": {"wq": w[None]}}, bits=4,
+                              group_size=16)["layers"]["wq"]
+    assert stacked["int4"].ndim == 4  # [L, G, g, out] — NOT sliced
+    x = jnp.asarray(rng.normal(size=(2, 8, 64)), jnp.float32)
+    with pytest.raises(ValueError, match="scan-slice"):
+        qdot(x, stacked)
+
+
 def test_int4_decode_token_parity_with_dequantized_twin():
     """Greedy decode through the live int4 path must equal decoding the
     dequantized-f32 copy of the same tree — the quantization is in the

@@ -28,6 +28,15 @@ def make_batch(config, batch=4, seq=16, seed=0):
     return jnp.asarray(rng.integers(0, config.vocab_size, (batch, seq)))
 
 
+def test_sp_impl_typo_is_rejected_at_construction():
+    """A bad sp_impl must error eagerly in __post_init__, not only when a
+    context-parallel plan happens to be active (ADVICE r5)."""
+    with pytest.raises(ValueError, match="sp_impl"):
+        ModelConfig(sp_impl="a2A")
+    ModelConfig(sp_impl="a2a")  # both valid strategies still construct
+    ModelConfig(sp_impl="ring")
+
+
 def test_forward_shapes_and_dtype():
     params = init_params(TINY, jax.random.key(0))
     tokens = make_batch(TINY)

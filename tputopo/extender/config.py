@@ -32,6 +32,14 @@ class ExtenderConfig:
     # derived state, bind write-throughs its own delta, and the API
     # server's optimistic concurrency remains the authority on writes.
     state_cache_s: float = 0.0
+    # Informer-less assume-cache mode (the kube-scheduler cache pattern
+    # without a watch): bind plans from the state_cache_s-cached derived
+    # state and, on success, publishes a copy-on-write clone with its own
+    # delta applied — so a burst of sort/bind cycles pays ONE sync.  Only
+    # safe when this extender is the sole writer of assignments (the
+    # sim's virtual-time engine, single-binary dev rigs); the deployed
+    # shape keeps an informer and leaves this off.
+    bind_from_cache: bool = False
     # Per-generation LinkCostModel field overrides, e.g.
     # {"v5p": {"ici_link_gbps": 95.0, "dcn_host_gbps": 42.0}} — the explicit,
     # measured replacement for the reference's TODO weight table.

@@ -63,6 +63,17 @@ class BindError(RuntimeError):
     pass
 
 
+def quantile(sorted_xs, q: float):
+    """Ceil-based empirical quantile ``xs[min(n-1, ceil(n*q)-1)]`` over an
+    already-sorted sequence — the one rank convention every exporter in
+    this repo uses (Metrics here, bench.py's pct(), the sim report), so a
+    p95 compared across surfaces is the same statistic.  Unlike the old
+    ``int(n*q)-1`` rank it is not biased low at small n: p95 of 10
+    samples is the max (rank 10), not the 9th value (p90)."""
+    n = len(sorted_xs)
+    return sorted_xs[min(n - 1, max(0, math.ceil(n * q) - 1))]
+
+
 @dataclass
 class Metrics:
     counters: dict[str, int] = field(default_factory=dict)
@@ -83,13 +94,13 @@ class Metrics:
     def quantiles_ms(self, name: str,
                      qs: tuple[float, ...]) -> tuple[float, ...] | None:
         """Several quantiles from ONE sort (scrapes ask for p50+p95 on
-        ever-growing lists), using the same rank convention as bench.py's
-        pct() — ``xs[max(0, int(n*q) - 1)]`` — so the exported p95 and
-        the benched/gated p95 agree on identical data."""
+        ever-growing lists), via :func:`quantile` — the ceil-based rank
+        shared with bench.py's pct(), so the exported p95 and the
+        benched/gated p95 agree on identical data."""
         xs = sorted(self.latencies_ms.get(name, []))
         if not xs:
             return None
-        return tuple(xs[max(0, int(len(xs) * q) - 1)] for q in qs)
+        return tuple(quantile(xs, q) for q in qs)
 
 
 def _wanted_generation(pod: dict) -> str | None:
@@ -167,6 +178,15 @@ class ExtenderScheduler:
     # time, not by watch events.  5 s keeps worst-case expiry staleness far
     # under the 60 s assume TTL while still absorbing sort bursts.
     _INFORMER_STATE_MAX_AGE_S = 5.0
+
+    def invalidate_cached_state(self) -> None:
+        """Drop the cached derived state.  The public invalidation hook a
+        ``bind_from_cache`` deployment MUST call after any out-of-band
+        cluster mutation (pod create/delete, node churn, annotation wipes
+        by an external GC) — the config's "sole writer" rule is only
+        satisfiable through this method (the sim's engine is the model
+        consumer)."""
+        self._cached_state = None
 
     def _state(self, allow_cache: bool = False, reader=None) -> ClusterState:
         if allow_cache and reader is not None:
@@ -779,6 +799,23 @@ class ExtenderScheduler:
             self._unmirrored_binds.discard(key)
             self.metrics.inc("bind_write_through_repaired")
 
+    def _bind_delta_state(self, state: ClusterState, pod_name: str,
+                          namespace: str, node_name: str, placement,
+                          now: float, gang_id: str | None):
+        """``state`` plus this just-committed bind applied (the O(chips)
+        copy-on-write delta both cache modes publish), or None when the
+        delta cannot apply and the caller must drop the derived state."""
+        try:
+            return state.with_bind(PodAssignment(
+                pod_name=pod_name,
+                namespace=namespace or "default",
+                node_name=node_name,
+                chips=list(placement.chips),
+                assigned=False, assume_time=now,
+                gang_id=gang_id))
+        except ValueError:
+            return None
+
     def _bind_locked(self, pod_name: str, namespace: str, node_name: str) -> dict:
         t0 = time.perf_counter()
         self.metrics.inc("bind_requests")
@@ -818,7 +855,11 @@ class ExtenderScheduler:
             state = self._state(allow_cache=True, reader=informer_reader)
             state_token = self._cached_informer_version
         else:
-            state = self._state()
+            # bind_from_cache (ExtenderConfig): informer-less single-writer
+            # deployments (the sim's virtual-time engine) may plan binds
+            # from the cached derived state; the post-bind delta below
+            # keeps the cache coherent with this extender's own writes.
+            state = self._state(allow_cache=self.config.bind_from_cache)
             state_token = None
         k = ko.pod_requested_chips(pod)
         if k <= 0:
@@ -944,14 +985,11 @@ class ExtenderScheduler:
                 except (ValueError, IndexError):
                     expected = None
                 if new_token == expected:
-                    try:
-                        self._cached_state = state.with_bind(PodAssignment(
-                            pod_name=pod_name,
-                            namespace=namespace or "default",
-                            node_name=node_name,
-                            chips=list(placement.chips),
-                            assigned=False, assume_time=now,
-                            gang_id=gang_id))
+                    new_state = self._bind_delta_state(
+                        state, pod_name, namespace, node_name, placement,
+                        now, gang_id)
+                    if new_state is not None:
+                        self._cached_state = new_state
                         self._cached_informer_version = new_token
                         # _cached_at deliberately NOT refreshed: it stamps
                         # when occupancy was last judged against the clock
@@ -961,13 +999,22 @@ class ExtenderScheduler:
                         # timestamp forward.
                         published = True
                         self.metrics.inc("bind_state_delta")
-                    except ValueError:
-                        published = False
             if not published:
                 # Either external events intervened or the delta could not
                 # apply: drop the derived state; the next verb rebuilds
                 # from the (write-through-fresh) mirror.
                 self._cached_state = None
+        elif self.config.bind_from_cache:
+            # Informer-less assume cache (single-writer mode): apply our
+            # own bind to the cached derived state so the next verb in the
+            # burst reuses it instead of re-syncing — the cache's coherence
+            # is exactly this delta, since no one else writes assignments.
+            new_state = (self._bind_delta_state(
+                state, pod_name, namespace, node_name, placement, now,
+                gang_id) if state is self._cached_state else None)
+            self._cached_state = new_state
+            if new_state is not None:
+                self.metrics.inc("bind_state_delta")
 
         decision = {
             "pod": f"{namespace}/{pod_name}",

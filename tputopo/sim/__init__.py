@@ -1,0 +1,19 @@
+"""tputopo.sim — trace-driven cluster simulator for topology-aware
+scheduling.
+
+The evaluation engine behind every scheduler perf/policy claim in this
+repo: a deterministic, seedable discrete-event simulator that replays
+synthetic workload traces (Poisson/bursty gang arrivals, lognormal
+durations, node churn, never-confirming "ghost" jobs) against the real
+``ExtenderScheduler`` + ``FakeApiServer`` stack on a virtual clock, and
+reports queue-wait quantiles, chip utilization, fragmentation, and
+achieved-vs-ideal ICI bandwidth per policy — with count-only baselines
+(:mod:`tputopo.topology.baselines`) run over the identical trace for A/B
+deltas.  ``python -m tputopo.sim --help`` is the front door; bench.py's
+``sim`` scenario feeds a compact summary into the BENCH record.
+"""
+
+from tputopo.sim.engine import SimEngine, SimError, VirtualClock, run_trace  # noqa: F401
+from tputopo.sim.policies import available_policies, get_policy  # noqa: F401
+from tputopo.sim.report import SCHEMA, build_report  # noqa: F401
+from tputopo.sim.trace import JobSpec, Trace, TraceConfig, generate_trace  # noqa: F401

@@ -1,0 +1,95 @@
+"""CLI: ``python -m tputopo.sim --nodes 64 --arrivals 500 --seed 0``.
+
+Prints ONE deterministic JSON report (sorted keys, stable rounding) to
+stdout — byte-identical for a fixed (seed, config) — and wall-clock
+telemetry to stderr, so the report stays diffable across runs and
+machines.  ``--out`` additionally writes the report to a file for
+bench.py / CI artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from tputopo.sim.engine import run_trace
+from tputopo.sim.policies import available_policies
+from tputopo.sim.trace import TraceConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tputopo.sim",
+        description="Trace-driven cluster simulator for topology-aware "
+                    "scheduling (virtual time; deterministic per seed).")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--nodes", type=int, default=64,
+                   help="host count (rounded up to whole ICI domains)")
+    p.add_argument("--spec", default="v5p:4x4x4",
+                   help="per-domain torus, e.g. v5p:4x4x4 / v5e:8x8")
+    p.add_argument("--arrivals", type=int, default=500,
+                   help="number of job arrivals in the trace")
+    p.add_argument("--process", choices=("poisson", "bursty"),
+                   default="poisson")
+    p.add_argument("--rate", type=float, default=0.1,
+                   help="mean arrival rate, jobs per virtual second "
+                        "(default tuned to ~0.73 offered load at the "
+                        "default fleet)")
+    p.add_argument("--duration-mean", type=float, default=300.0,
+                   help="mean job duration, virtual seconds (lognormal)")
+    p.add_argument("--ghost-prob", type=float, default=0.02,
+                   help="fraction of jobs that bind but never confirm "
+                        "(TTL-GC path)")
+    p.add_argument("--node-failures", type=int, default=2)
+    p.add_argument("--policies", default="ici,naive",
+                   help=f"comma list from {available_policies()}; first is "
+                        "the A/B reference")
+    p.add_argument("--assume-ttl", type=float, default=60.0,
+                   help="assumption TTL (virtual seconds)")
+    p.add_argument("--gc-period", type=float, default=30.0,
+                   help="GC sweep period (virtual seconds)")
+    p.add_argument("--out", default=None, help="also write the report here")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    policies = [s.strip() for s in args.policies.split(",") if s.strip()]
+    known = set(available_policies())
+    unknown = [p for p in policies if p not in known]
+    if unknown:
+        print(f"unknown policies {unknown}; available: "
+              f"{available_policies()}", file=sys.stderr)
+        return 2
+    if len(set(policies)) != len(policies):
+        # '--policies ici,ici' would silently run the trace twice and emit
+        # a report with an empty A/B block — reject like other bad input.
+        print(f"duplicate policies in {policies}", file=sys.stderr)
+        return 2
+    cfg = TraceConfig(
+        seed=args.seed, nodes=args.nodes, spec=args.spec,
+        arrivals=args.arrivals, process=args.process, rate_per_s=args.rate,
+        duration_mean_s=args.duration_mean, ghost_prob=args.ghost_prob,
+        node_failures=args.node_failures,
+    )
+    t0 = time.perf_counter()
+    report = run_trace(cfg, policies, assume_ttl_s=args.assume_ttl,
+                       gc_period_s=args.gc_period)
+    wall_s = time.perf_counter() - t0
+    text = json.dumps(report, indent=2, sort_keys=True)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    # Wall clock is telemetry, NOT part of the report: the report must be
+    # byte-identical per (seed, config) across hosts.
+    print(f"sim: {args.arrivals} arrivals x {len(policies)} policies over "
+          f"{report['virtual_horizon_s']:.0f} virtual s in {wall_s:.2f} "
+          "wall s", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,553 @@
+"""Discrete-event cluster simulator core.
+
+Replays a :class:`~tputopo.sim.trace.Trace` against the *real*
+``ExtenderScheduler`` + ``FakeApiServer`` stack on a **virtual clock**:
+the event loop jumps time from event to event (arrivals, completions,
+node failures/repairs, GC sweeps), so thousands of scheduling decisions —
+each one a genuine sort/bind through the production code path, with
+assume-timestamps and the TTL GC reading sim time — run in seconds of
+wall clock with zero ``time.sleep``.
+
+Correctness is enforced, not assumed: an independent chip ledger cross-
+checks every placement the policy commits; any double-booked chip raises
+:class:`SimError` (the same refuse-to-report posture as bench.py's scale
+trace).
+
+One engine run = one (policy, trace) pair; :func:`run_trace` drives the
+A/B across policies and assembles the report.
+"""
+
+from __future__ import annotations
+
+import heapq
+
+from tputopo.deviceplugin.reporter import node_object_for_probe
+from tputopo.discovery.shim import _probe_python, _to_host_probe
+from tputopo.extender.gc import AssumptionGC
+from tputopo.extender.state import ClusterState
+from tputopo.k8s import objects as ko
+from tputopo.k8s.fakeapi import FakeApiServer, NotFound
+from tputopo.sim.policies import get_policy, pods_for_job
+from tputopo.sim.report import MetricsCollector, build_report
+from tputopo.sim.trace import JobSpec, Trace, TraceConfig, generate_trace
+from tputopo.topology.slices import Allocator, enumerate_shapes
+from tputopo.topology.score import (_box_of, predict_allreduce_gbps,
+                                    predict_multidomain_allreduce_gbps,
+                                    score_chip_set)
+
+
+class SimError(RuntimeError):
+    """A correctness violation inside a sim run (e.g. double-booked chip)."""
+
+
+class VirtualClock:
+    """The sim's time source — advanced only by the event loop, read by
+    the scheduler/GC through their existing ``clock`` hooks."""
+
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class _CopyFreeApi:
+    """Read-optimized facade over the sim's FakeApiServer: ``list`` honors
+    the ``copy=False`` hint ClusterState/_gang_members already send (via
+    :meth:`FakeApiServer.list_nocopy`), writes delegate untouched.  Only
+    valid because the engine is strictly single-threaded — see
+    list_nocopy's contract."""
+
+    def __init__(self, api: FakeApiServer) -> None:
+        self._api = api
+
+    def __getattr__(self, name):
+        return getattr(self._api, name)
+
+    def list(self, kind, selector=None, label_selector=None,
+             copy: bool = True):
+        if not copy and label_selector is None:
+            return self._api.list_nocopy(kind, selector)
+        return self._api.list(kind, selector, label_selector)
+
+
+class _JobRun:
+    """Mutable per-job lifecycle state (the trace JobSpec stays frozen)."""
+
+    __slots__ = ("spec", "enqueued_t", "incarnation", "chips_held",
+                 "failed_epoch")
+
+    def __init__(self, spec: JobSpec, enqueued_t: float) -> None:
+        self.spec = spec
+        self.enqueued_t = enqueued_t
+        self.incarnation = 0
+        self.chips_held: list[tuple[str, tuple]] = []  # (slice_id, chip)
+        self.failed_epoch = -1  # capacity epoch of the last failed attempt
+
+
+def stage_nodes(cfg: TraceConfig) -> tuple[FakeApiServer, list[dict], dict]:
+    """A fresh API server holding the trace's fleet: ``n_domains`` ICI
+    domains of ``hosts_per_domain`` nodes each, annotated exactly like the
+    device plugin would (same probe -> reporter pipeline), staged in bulk.
+    Returns (api, node_objects, chips_by_node)."""
+    api = FakeApiServer()
+    probes = [
+        _to_host_probe(_probe_python({"TPUTOPO_FAKE": f"{cfg.spec}@{w}"}))
+        for w in range(cfg.hosts_per_domain)
+    ]
+    for p in probes:
+        if not p.ok:
+            raise ValueError(f"bad trace spec {cfg.spec!r}: {p.error}")
+    nodes = []
+    chips_by_node: dict[str, list[tuple]] = {}
+    for d in range(cfg.n_domains):
+        for w in range(cfg.hosts_per_domain):
+            name = f"n{d:02d}-{w:02d}"
+            nodes.append(node_object_for_probe(probes[w], name,
+                                               f"slice-{d:02d}"))
+            chips_by_node[name] = [tuple(c["coords"]) for c in probes[w].chips]
+    api.create_many("nodes", nodes)
+    return api, nodes, chips_by_node
+
+
+class SimEngine:
+    """One policy's run over one trace."""
+
+    # Event kinds, in tie-break order at equal timestamps: completions
+    # free capacity before the same-instant arrival tries to use it.
+    _COMPLETE, _REPAIR, _FAIL, _ARRIVAL, _GC = 0, 1, 2, 3, 4
+
+    def __init__(self, trace: Trace, policy_name: str, *,
+                 assume_ttl_s: float = 60.0, gc_period_s: float = 30.0,
+                 max_backfill_failures: int = 8) -> None:
+        self.trace = trace
+        self.cfg = trace.config
+        self.clock = VirtualClock(0.0)
+        self.api, self._node_objects, self.chips_by_node = stage_nodes(self.cfg)
+        self._node_obj_by_name = {n["metadata"]["name"]: n
+                                  for n in self._node_objects}
+        self.node_names = sorted(self._node_obj_by_name)
+        read_api = _CopyFreeApi(self.api)
+        self.policy = get_policy(policy_name, read_api, self.clock,
+                                 assume_ttl_s)
+        self.gc = AssumptionGC(read_api, assume_ttl_s=assume_ttl_s,
+                               clock=self.clock)
+        self.assume_ttl_s = assume_ttl_s
+        self.gc_period_s = gc_period_s
+        self.max_backfill_failures = max_backfill_failures
+
+        # Twin occupancy model (metrics + the double-booking cross-check):
+        # one Allocator per domain, fed only by this engine's own ledger.
+        state0 = ClusterState(self.api, clock=self.clock).sync()
+        self.domains = {sid: dom.topology for sid, dom in state0.domains.items()}
+        self._cost = {sid: dom.allocator.cost
+                      for sid, dom in state0.domains.items()}
+        self.twin = {sid: Allocator(topo, self._cost[sid])
+                     for sid, topo in self.domains.items()}
+        self._frag_dirty: set[str] = set(self.twin)
+        self._frag_cache: dict[str, tuple[int, int]] = {}
+        self.domain_of_node = {
+            node: dom.slice_id for sid, dom in state0.domains.items()
+            for node in dom.host_by_node}
+        self._ideal_gbps: dict[tuple[str, int], float] = {}
+
+        self.metrics = MetricsCollector(self.cfg.total_chips)
+        self.queue: list[_JobRun] = []
+        self.jobs: dict[str, _JobRun] = {}
+        self.ledger: dict[tuple[str, tuple], str] = {}  # (slice, chip) -> job
+        self.placed_chips = 0
+        # Bumped whenever capacity can have GROWN (job freed, node back).
+        # A queued job that failed at the current epoch is skipped without
+        # re-sorting: within one epoch capacity only shrinks, so the retry
+        # could not succeed — this is what keeps a saturated queue from
+        # costing O(queue) full sorts on every event.
+        self.capacity_epoch = 0
+        self._scan_start = 0  # rotating backfill window (see _try_schedule)
+        self.failed_nodes: set[str] = set()
+        self._blocked: dict[str, list[tuple]] = {}  # failed node -> chips blocked in twin
+        self.ghosts: dict[str, float] = {}  # job name -> assume expiry time
+        self._heap: list[tuple] = []
+        self._seq = 0
+        self._gc_pending = False
+        self.horizon_s = 0.0
+
+    # ---- event plumbing ----------------------------------------------------
+
+    def _push(self, t: float, kind: int, payload) -> None:
+        self._seq += 1
+        if kind == self._GC:
+            self._gc_pending = True
+        heapq.heappush(self._heap, (t, kind, self._seq, payload))
+
+    # ---- run ---------------------------------------------------------------
+
+    def run(self, report_horizon_s: float | None = None) -> dict:
+        """Replay the whole trace and build this policy's report.
+
+        ``report_horizon_s`` extends the time-weighted integrals to a
+        SHARED horizon (the max across an A/B's runs): each policy's own
+        run may end at a different virtual time, and normalizing means
+        over different windows would let the A/B deltas measure window
+        length instead of placement quality.  ``run_trace`` passes the
+        shared value via :meth:`finalize`; a bare run() reports over its
+        own horizon."""
+        self.run_events()
+        return self.finalize(report_horizon_s or self.horizon_s)
+
+    def finalize(self, horizon_s: float) -> dict:
+        """Report over ``horizon_s`` (>= this run's own horizon): the
+        occupancy step functions are extended at their final values so
+        the integrals cover the full window."""
+        if horizon_s > self.clock.t:
+            self.clock.t = horizon_s
+            self._sample_occupancy()
+        return self.metrics.report(max(horizon_s, self.horizon_s),
+                                   self.policy.counters())
+
+    def run_events(self) -> None:
+        for job in self.trace.jobs:
+            self._push(job.arrival_s, self._ARRIVAL, job)
+        for fail_t, repair_t, victim in self.trace.node_events:
+            self._push(fail_t, self._FAIL, (victim, repair_t))
+        if self.gc_period_s > 0:
+            self._push(self.gc_period_s, self._GC, None)
+
+        self._sample_occupancy()  # t=0 anchor for the time-weighted means
+        while self._heap:
+            t, kind, _, payload = heapq.heappop(self._heap)
+            self.clock.t = max(self.clock.t, t)
+            self.horizon_s = max(self.horizon_s, self.clock.t)
+            if kind == self._ARRIVAL:
+                self._on_arrival(payload)
+            elif kind == self._COMPLETE:
+                self._on_complete(*payload)
+            elif kind == self._FAIL:
+                self._on_node_fail(*payload)
+            elif kind == self._REPAIR:
+                self._on_node_repair(payload)
+            elif kind == self._GC:
+                self._gc_pending = False
+                self._on_gc()
+            if not self._heap and self.queue:
+                # Terminal drain: no future event will ever wake the queue
+                # again, so the per-wake failure budget must not be what
+                # leaves a feasible job stranded — retry everything once
+                # without it.  Placements push completion events, so the
+                # loop resumes; a drain that places nothing ends the run,
+                # and what remains is genuinely infeasible.
+                budget = self.max_backfill_failures
+                self.max_backfill_failures = len(self.queue) + 1
+                self.capacity_epoch += 1  # clear per-epoch failure memos
+                try:
+                    self._try_schedule()
+                finally:
+                    self.max_backfill_failures = budget
+            # Invariant: an outstanding unconfirmed assumption always has
+            # a future GC sweep to reclaim it — a ghost placed by THIS
+            # event's try_schedule OR by the terminal drain just above
+            # must not strand the loop with held chips and no reclaim
+            # event (hence this check runs AFTER the drain).
+            # (gc_period_s <= 0 disables periodic sweeps entirely; ghosts
+            # are then reaped only lazily by _try_schedule's expiry check
+            # — a zero period must not re-arm at the same virtual instant
+            # forever.)
+            if self.ghosts and not self._gc_pending and self.gc_period_s > 0:
+                self._push(self.clock.t + self.gc_period_s, self._GC, None)
+        self.metrics.counts["unplaced_at_end"] = len(self.queue)
+        self._sample_occupancy()
+
+    # ---- handlers ----------------------------------------------------------
+
+    def _on_arrival(self, spec: JobSpec) -> None:
+        self.metrics.counts["arrived"] += 1
+        run = _JobRun(spec, self.clock.t)
+        self.jobs[spec.name] = run
+        self.api.create_many("pods", pods_for_job(spec))
+        self.policy.invalidate()
+        self.queue.append(run)
+        self._try_schedule()
+
+    def _on_complete(self, name: str, incarnation: int) -> None:
+        run = self.jobs.get(name)
+        if run is None or run.incarnation != incarnation:
+            return  # stale completion of an evicted/requeued incarnation
+        self._free_job(run)
+        self._delete_job_pods(run.spec)
+        self.metrics.counts["completed"] += 1
+        del self.jobs[name]
+        self._try_schedule()
+
+    def _on_node_fail(self, victim: int, repair_t: float) -> None:
+        if victim >= len(self.node_names):
+            return
+        name = self.node_names[victim]
+        if name in self.failed_nodes:
+            return  # overlapping failure of the same node — ignore
+        self.failed_nodes.add(name)
+        self.metrics.preempt["node_failures"] += 1
+        try:
+            self.api.delete("nodes", name)
+        except NotFound:
+            pass
+        self.policy.invalidate()
+        # Evict every job with a pod on the dead node — gangs are atomic,
+        # so the whole job dies and re-queues (the job-controller recreate).
+        sid = self.domain_of_node[name]
+        dead = {(sid, c) for c in self.chips_by_node[name]}
+        victims = sorted({self.ledger[key] for key in dead
+                          if key in self.ledger})
+        for jname in victims:
+            run = self.jobs[jname]
+            self.metrics.preempt["pods_evicted"] += run.spec.replicas
+            self.metrics.preempt["jobs_requeued"] += 1
+            self.metrics.counts["evicted_requeues"] += 1
+            self._free_job(run)
+            self._delete_job_pods(run.spec)
+            self.ghosts.pop(jname, None)
+            run.incarnation += 1
+            run.enqueued_t = self.clock.t  # wait clock restarts at requeue
+            self.api.create_many("pods", pods_for_job(run.spec))
+            self.queue.append(run)
+        # The dead node's remaining chips leave the placeable pool.
+        blocked = [c for c in self.chips_by_node[name]
+                   if c in self.twin[sid].free]
+        self._twin_mark(sid, blocked)
+        self._blocked[name] = blocked
+        self._push(max(repair_t, self.clock.t), self._REPAIR, name)
+        self._sample_occupancy()
+        if victims:
+            # Evicted gangs freed chips on SURVIVING nodes too — requeued
+            # and queued jobs may fit right now, not at the next event.
+            self._try_schedule()
+
+    def _on_node_repair(self, name: str) -> None:
+        if name not in self.failed_nodes:
+            return
+        self.failed_nodes.discard(name)
+        self.api.create("nodes", self._node_obj_by_name[name])
+        self.policy.invalidate()
+        self._twin_release(self.domain_of_node[name],
+                           self._blocked.pop(name, []))
+        self.capacity_epoch += 1
+        self._try_schedule()
+
+    def _on_gc(self) -> None:
+        n = self._sweep()
+        # Keep sweeping while there is anything left to happen; once the
+        # heap holds no other events and no unconfirmed assumption is
+        # outstanding, the loop is allowed to drain.
+        if (self._heap or self.ghosts) and self.gc_period_s > 0:
+            self._push(self.clock.t + self.gc_period_s, self._GC, None)
+        if n:  # an idle sweep freed nothing — no point re-sorting the queue
+            self._try_schedule()
+
+    def _sweep(self) -> int:
+        released = self.gc.sweep()
+        self.metrics.gc["sweeps"] += 1
+        self.metrics.gc["assumptions_released"] += len(released)
+        if released:
+            self.policy.invalidate()  # the sweep wiped annotations
+        reclaimed = sorted({self._job_of_pod(r.split("/", 1)[1])
+                            for r in released})
+        for jname in reclaimed:
+            run = self.jobs.pop(jname, None)
+            if run is None:
+                continue
+            self._free_job(run)
+            self._delete_job_pods(run.spec)
+            self.ghosts.pop(jname, None)
+            self.metrics.counts["ghost_reclaimed"] += 1
+        if reclaimed:
+            self._sample_occupancy()
+        return len(released)
+
+    @staticmethod
+    def _job_of_pod(pod_name: str) -> str:
+        return pod_name.rsplit("-", 1)[0]
+
+    # ---- scheduling --------------------------------------------------------
+
+    def _try_schedule(self) -> None:
+        # Ghost assumptions past their TTL are ALREADY free in the
+        # scheduler's ClusterState view; reap them before placing so the
+        # engine's ledger agrees (otherwise a legitimate placement onto
+        # reclaimed chips would read as double-booking).
+        if self.ghosts and min(self.ghosts.values()) <= self.clock.t:
+            self._sweep()
+        alive = [n for n in self.node_names if n not in self.failed_nodes]
+        # One pass with backfill over a ROTATED view of the FIFO queue:
+        # capacity only shrinks as this wake places jobs, so a job that
+        # failed once this wake cannot fit later in the same wake, and the
+        # failure budget bounds sort work on a long stuck queue.  The
+        # rotation is what keeps the budget fair: when >= budget
+        # never-feasible jobs sit at the queue head (e.g. an 8-replica
+        # gang in a 4-host domain), a fixed head-first scan would burn the
+        # whole budget on them every wake and permanently starve feasible
+        # jobs behind them.  Advancing the start past this wake's failures
+        # sweeps the attempt window across the entire queue over
+        # successive wakes.  Arrival (FIFO) order of the queue itself is
+        # preserved for the jobs that remain.
+        n = len(self.queue)
+        start = self._scan_start % n if n else 0
+        failures = 0
+        placed: set[int] = set()
+        for i in range(n):
+            run = self.queue[(start + i) % n]
+            if (failures >= self.max_backfill_failures
+                    or run.failed_epoch == self.capacity_epoch):
+                continue
+            decisions = self.policy.place(run.spec, alive)
+            if decisions is None:
+                if run.spec.replicas > 1:
+                    self._reset_if_partially_bound(run)
+                run.failed_epoch = self.capacity_epoch
+                failures += 1
+                continue
+            self._commit(run, decisions)
+            placed.add(id(run))
+        if placed:
+            self.queue = [r for r in self.queue if id(r) not in placed]
+        self._scan_start = (start + failures) if failures else 0
+        self._sample_occupancy()
+
+    def _reset_if_partially_bound(self, run: _JobRun) -> None:
+        """Defensive: a policy returning None must leave no member bound;
+        if one slipped through (released-then-aborted gang), recreate the
+        job's pods so the next attempt starts clean."""
+        bound = False
+        for m in range(run.spec.replicas):
+            try:
+                pod = self.api.get("pods", f"{run.spec.name}-{m}", "default")
+            except NotFound:
+                bound = True  # missing pod also warrants a rebuild
+                break
+            if pod["spec"].get("nodeName"):
+                bound = True
+                break
+        if bound:
+            self._delete_job_pods(run.spec)
+            run.incarnation += 1
+            self.api.create_many("pods", pods_for_job(run.spec))
+
+    def _commit(self, run: _JobRun, decisions: list[dict]) -> None:
+        spec = run.spec
+        now = self.clock.t
+        chips_by_dom: dict[str, set] = {}
+        for d in decisions:
+            sid = d["slice"]
+            for chip in d["chips"]:
+                key = (sid, tuple(chip))
+                holder = self.ledger.get(key)
+                if holder is not None:
+                    raise SimError(
+                        f"policy {self.policy.name}: chip {key} double-booked "
+                        f"by {spec.name} (held by {holder}) at t={now:.3f}")
+                self.ledger[key] = spec.name
+                run.chips_held.append(key)
+                chips_by_dom.setdefault(sid, set()).add(tuple(chip))
+            self._twin_mark(sid, [tuple(c) for c in d["chips"]])
+            self.placed_chips += len(d["chips"])
+        if spec.total_chips > 1:
+            # Job-level achieved collective bandwidth over the UNION of
+            # the job's chips (the quantity a DP/TP job actually syncs
+            # at), against the ideal box of that volume on an empty torus
+            # — this is where gang contiguity vs first-fit scatter shows.
+            sids = sorted(chips_by_dom)
+            cost = self._cost[sids[0]]
+            if len(sids) == 1:
+                chips = frozenset(chips_by_dom[sids[0]])
+                topo = self.domains[sids[0]]
+                gbps = score_chip_set(topo, chips, cost)
+                contiguous = (len(chips) == 1
+                              or _box_of(topo, chips) is not None)
+            else:  # multislice gang: DCN-coupled sub-slices
+                gbps = predict_multidomain_allreduce_gbps(
+                    [(self.domains[s], frozenset(chips_by_dom[s]))
+                     for s in sids], cost)
+                contiguous = False
+            ideal = self._ideal_for(sids[0], spec.total_chips)
+            self.metrics.placement(gbps / ideal if ideal > 0 else 0.0,
+                                   contiguous)
+        self.metrics.job_scheduled(now - run.enqueued_t)
+        if spec.ghost:
+            # Never confirms: the assumption ages out and the TTL GC (on
+            # sim time) reclaims it — the two-phase handshake's failure leg.
+            self.ghosts[spec.name] = now + self.assume_ttl_s
+        else:
+            for d in decisions:
+                self.api.patch_annotations(
+                    "pods", d["pod"], {ko.ANN_ASSIGNED: "true"}, "default")
+            self._push(now + spec.duration_s, self._COMPLETE,
+                       (spec.name, run.incarnation))
+
+    # ---- bookkeeping -------------------------------------------------------
+
+    def _ideal_for(self, sid: str, k: int) -> float:
+        key = (sid, k)
+        if key not in self._ideal_gbps:
+            topo, cost = self.domains[sid], self._cost[sid]
+            shapes = enumerate_shapes(topo, k, cost)
+            self._ideal_gbps[key] = (
+                predict_allreduce_gbps(topo, shapes[0].dims, cost)
+                if shapes else cost.ici_link_gbps)
+        return self._ideal_gbps[key]
+
+    def _free_job(self, run: _JobRun) -> None:
+        by_dom: dict[str, list[tuple]] = {}
+        for key in run.chips_held:
+            if self.ledger.pop(key, None) is not None:
+                by_dom.setdefault(key[0], []).append(key[1])
+                self.placed_chips -= 1
+        for sid, chips in by_dom.items():
+            self._twin_release(sid, chips)
+        run.chips_held = []
+        self.capacity_epoch += 1
+
+    def _delete_job_pods(self, spec: JobSpec) -> None:
+        for m in range(spec.replicas):
+            try:
+                self.api.delete("pods", f"{spec.name}-{m}", "default")
+            except NotFound:
+                pass
+        self.policy.invalidate()
+
+    def _twin_mark(self, sid: str, chips) -> None:
+        self.twin[sid].mark_used(chips)
+        self._frag_dirty.add(sid)
+
+    def _twin_release(self, sid: str, chips) -> None:
+        self.twin[sid].release(chips)
+        self._frag_dirty.add(sid)
+
+    def _sample_occupancy(self) -> None:
+        # largest_free_box is the costly part (a windowed scan per domain);
+        # cache it per domain until that domain's twin occupancy changes —
+        # most events touch one domain but sample all of them.
+        for sid in self._frag_dirty:
+            twin = self.twin[sid]
+            largest = twin.largest_free_box()
+            self._frag_cache[sid] = (len(twin.free),
+                                     largest[0] if largest else 0)
+        self._frag_dirty.clear()
+        frag = [self._frag_cache[sid] for sid in sorted(self._frag_cache)]
+        self.metrics.occupancy(self.clock.t, self.placed_chips, frag)
+
+
+def run_trace(cfg: TraceConfig, policy_names: list[str], *,
+              assume_ttl_s: float = 60.0, gc_period_s: float = 30.0) -> dict:
+    """Replay one deterministic trace under each policy and build the
+    A/B report.  Every policy sees the identical event stream."""
+    trace = generate_trace(cfg)
+    engines: list[tuple[str, SimEngine]] = []
+    for name in policy_names:
+        engine = SimEngine(trace, name, assume_ttl_s=assume_ttl_s,
+                           gc_period_s=gc_period_s)
+        engine.run_events()
+        engines.append((name, engine))
+    # All policies report over the SAME horizon (the slowest run's end),
+    # so time-weighted means in the A/B deltas share one denominator.
+    horizon = max(e.horizon_s for _, e in engines)
+    policies = {name: e.finalize(horizon) for name, e in engines}
+    return build_report(cfg.describe(), horizon, policies,
+                        engine_params={"assume_ttl_s": assume_ttl_s,
+                                       "gc_period_s": gc_period_s})

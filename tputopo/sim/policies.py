@@ -1,0 +1,250 @@
+"""Pluggable placement policies for the simulator's A/B runs.
+
+Two families behind one interface:
+
+- ``ici`` drives the *real* :class:`ExtenderScheduler` — per member pod,
+  the sort verb scores every node and the bind verb stamps the
+  three-field handshake — so a sim run measures the production code
+  path, not a model of it.
+- Every picker registered in :mod:`tputopo.topology.baselines`
+  (``naive``, ``spread``, ...) becomes a count-only baseline that plans
+  against the same :class:`ClusterState`, picks chips with the baseline
+  rule, and commits through the *same* API-server handshake
+  (GROUP/ASSUME_TIME/ASSIGNED + bind) — so cluster accounting, the GC,
+  and the metrics collector treat both sides identically and the only
+  variable in the A/B is the placement decision itself.
+
+:func:`get_policy` / :func:`available_policies` resolve names
+dynamically against the baselines registry; the CLI's ``--policies a,b``
+and bench.py's sim scenario go through them.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from tputopo.extender.config import ExtenderConfig
+from tputopo.extender.scheduler import (BindError, ExtenderScheduler,
+                                        LABEL_ALLOW_MULTISLICE, LABEL_GANG_ID,
+                                        LABEL_GANG_SIZE)
+from tputopo.extender.state import ClusterState
+from tputopo.k8s import objects as ko
+from tputopo.k8s.fakeapi import FakeApiServer
+from tputopo.sim.trace import JobSpec
+from tputopo.topology.baselines import BASELINE_PICKERS
+from tputopo.topology.score import _box_of, score_chip_set
+
+
+def pods_for_job(job: JobSpec) -> list[dict]:
+    """The Pending pod objects a job submits at arrival."""
+    labels = {}
+    if job.replicas > 1:
+        labels[LABEL_GANG_ID] = job.name
+        labels[LABEL_GANG_SIZE] = str(job.replicas)
+        if job.multislice:
+            labels[LABEL_ALLOW_MULTISLICE] = "true"
+    return [ko.make_pod(f"{job.name}-{m}", chips=job.chips, labels=labels)
+            for m in range(job.replicas)]
+
+
+class PlacementPolicy:
+    """One policy instance per (policy, trace) run.
+
+    ``place(job, node_names)`` attempts to bind every member pod of
+    ``job`` (already created, Pending) and returns a list of decision
+    dicts — ``{"pod", "node", "slice", "chips", "predicted_gbps",
+    "contiguous"}`` — or None when the job does not fit *right now*
+    (the engine re-queues it).  A None MUST leave no member bound.
+    """
+
+    name = "abstract"
+
+    def __init__(self, api: FakeApiServer, clock, assume_ttl_s: float) -> None:
+        self.api = api
+        self.clock = clock
+        self.assume_ttl_s = assume_ttl_s
+
+    def place(self, job: JobSpec, node_names: list[str]) -> list[dict] | None:
+        raise NotImplementedError
+
+    def invalidate(self) -> None:
+        """The engine mutated cluster state outside this policy's own
+        binds (pod create/delete, node churn, GC wipe): drop any cached
+        derived state before the next ``place``."""
+
+    def counters(self) -> dict:
+        """Deterministic observability counters for the report."""
+        return {}
+
+
+class IciAwarePolicy(PlacementPolicy):
+    """The framework under test: sort -> max score -> bind, per member."""
+
+    name = "ici"
+
+    def __init__(self, api, clock, assume_ttl_s) -> None:
+        super().__init__(api, clock, assume_ttl_s)
+        # Informer-less assume-cache mode: the engine is the sole writer
+        # and calls invalidate() on every out-of-band mutation, so a
+        # scheduling wake pays ONE cluster sync and each bind publishes
+        # its own delta (ExtenderConfig.bind_from_cache).  The cache TTL
+        # is effectively "until invalidated" — virtual time can jump
+        # hours between wakes and the invalidation discipline, not the
+        # wall TTL, is what keeps the view coherent.
+        self.sched = ExtenderScheduler(
+            api, ExtenderConfig(assume_ttl_s=assume_ttl_s,
+                                state_cache_s=1e12, bind_from_cache=True),
+            clock=clock)
+
+    def invalidate(self) -> None:
+        self.sched.invalidate_cached_state()
+
+    def place(self, job: JobSpec, node_names: list[str]) -> list[dict] | None:
+        decisions = []
+        for m in range(job.replicas):
+            pod_name = f"{job.name}-{m}"
+            pod = self.api.get("pods", pod_name, "default")
+            scores = self.sched.sort(pod, node_names)
+            # scores is empty when every node is failed (alive == []).
+            best = (max(scores, key=lambda s: (s["Score"], s["Host"]))
+                    if scores else None)
+            if best is None or best["Score"] <= 0:
+                # Member infeasible.  For a gang with members already
+                # bound this attempt, bind() on an infeasible plan would
+                # release assumptions — but sort already planned the WHOLE
+                # gang, so member 0 failing means the gang doesn't fit and
+                # no member was bound (single-threaded engine).  m > 0
+                # failing can only follow a cluster change mid-attempt,
+                # which the engine never does — treat it as a hard bug.
+                if decisions:
+                    raise RuntimeError(
+                        f"gang {job.name} became infeasible mid-bind "
+                        f"(member {m} of {job.replicas})")
+                return None
+            try:
+                d = self.sched.bind(pod_name, "default", best["Host"])
+            except BindError:
+                # All-or-nothing: the scheduler released any assumptions;
+                # report "does not fit now" to the engine.
+                return None
+            decisions.append({
+                "pod": pod_name, "node": d["node"], "slice": d["slice"],
+                "chips": [tuple(c) for c in d["chips"]],
+                "predicted_gbps": d["predicted_allreduce_gbps"],
+                "contiguous": d["contiguous"],
+            })
+        return decisions
+
+    def counters(self) -> dict:
+        c = self.sched.metrics.counters
+        keep = ("sort_requests", "bind_requests", "bind_success",
+                "bind_gang_infeasible", "gang_assumptions_released",
+                "gang_plan_reuse_hits", "gang_multislice_plans",
+                "score_memo_hits")
+        return {k: c[k] for k in keep if k in c}
+
+
+class BaselinePolicy(PlacementPolicy):
+    """Count-only node choice + a registered baseline chip picker,
+    committed through the same annotation handshake as the extender."""
+
+    def __init__(self, api, clock, assume_ttl_s, picker_name: str,
+                 picker: Callable) -> None:
+        super().__init__(api, clock, assume_ttl_s)
+        self.name = picker_name
+        self.picker = picker
+        self._counters = {"plans": 0, "infeasible": 0, "binds": 0}
+        # Same assume-cache discipline as the ici policy: one sync per
+        # engine wake; this policy's own binds are reflected by the
+        # mark_used calls during planning, and the engine invalidates on
+        # every external mutation.
+        self._cached_state: ClusterState | None = None
+
+    def invalidate(self) -> None:
+        self._cached_state = None
+
+    def place(self, job: JobSpec, node_names: list[str]) -> list[dict] | None:
+        self._counters["plans"] += 1
+        state = self._cached_state
+        if state is None:
+            state = self._cached_state = ClusterState(
+                self.api, assume_ttl_s=self.assume_ttl_s,
+                clock=self.clock).sync()
+        # Plan every member against one state snapshot (all-or-nothing
+        # without partial binds), marking planned chips used locally; a
+        # count-only scheduler walks nodes in name order — first fit.
+        # An infeasible plan must roll its partial marks back: the state
+        # is cached across place() calls now.
+        plan: list[tuple[str, tuple]] = []
+        for _ in range(job.replicas):
+            placed = None
+            for node in node_names:
+                dom = state.domain_of_node(node)
+                if dom is None:
+                    continue
+                free_here = frozenset(state.free_chips_on_node(node))
+                if len(free_here) < job.chips:
+                    continue
+                picked = self.picker(dom.topology, free_here, job.chips)
+                if picked is not None:
+                    placed = (node, tuple(picked), dom)
+                    break
+            if placed is None:
+                self._counters["infeasible"] += 1
+                for node, picked in plan:
+                    state.domain_of_node(node).allocator.release(picked)
+                return None
+            node, picked, dom = placed
+            dom.allocator.mark_used(picked)
+            plan.append((node, picked))
+        # Commit: same three-field handshake the extender stamps, so the
+        # GC, ClusterState accounting, and metrics read both policies
+        # identically.
+        now = self.clock()
+        decisions = []
+        for m, (node, picked) in enumerate(plan):
+            pod_name = f"{job.name}-{m}"
+            dom = state.domain_of_node(node)
+            gbps = score_chip_set(dom.topology, frozenset(picked),
+                                  dom.allocator.cost) if len(picked) > 1 else 0.0
+            anns = {
+                ko.ANN_GROUP: ko.coords_to_ann(picked),
+                ko.ANN_ASSUME_TIME: str(now),
+                ko.ANN_ASSIGNED: "false",
+                ko.ANN_PREDICTED_GBPS: f"{gbps:.3f}",
+            }
+            if job.replicas > 1:
+                anns[ko.ANN_GANG_ID] = job.name
+            self.api.patch_annotations("pods", pod_name, anns, "default")
+            self.api.bind_pod(pod_name, node, "default")
+            self._counters["binds"] += 1
+            decisions.append({
+                "pod": pod_name, "node": node, "slice": dom.slice_id,
+                "chips": [tuple(c) for c in picked],
+                "predicted_gbps": float(gbps),
+                "contiguous": (len(picked) <= 1
+                               or _box_of(dom.topology, frozenset(picked))
+                               is not None),
+            })
+        return decisions
+
+    def counters(self) -> dict:
+        return dict(self._counters)
+
+
+def available_policies() -> list[str]:
+    """Current policy names: ``ici`` plus every registered baseline picker
+    — resolved dynamically, so a picker registered via
+    :func:`tputopo.topology.baselines.register_picker` after this module
+    imported is still selectable."""
+    return ["ici"] + sorted(BASELINE_PICKERS)
+
+
+def get_policy(name: str, api, clock, assume_ttl_s: float) -> PlacementPolicy:
+    if name == "ici":
+        return IciAwarePolicy(api, clock, assume_ttl_s)
+    picker = BASELINE_PICKERS.get(name)
+    if picker is not None:
+        return BaselinePolicy(api, clock, assume_ttl_s, name, picker)
+    raise KeyError(f"unknown policy {name!r}; available: "
+                   f"{available_policies()}")

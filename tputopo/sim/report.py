@@ -1,0 +1,200 @@
+"""Metrics collection and the sim's JSON report.
+
+Everything reported is a function of *virtual* time and the deterministic
+event stream — no wall-clock numbers leak in, so a fixed (seed, config)
+reproduces the report byte-for-byte (tests/test_sim.py pins this), and
+every future perf/policy PR can diff reports instead of re-arguing
+methodology.  Quantiles use the ceil-based rank convention shared with
+the extender's exported Metrics and bench.py's pct().
+
+Schema (``tputopo.sim/v1``)::
+
+    {
+      "schema": "tputopo.sim/v1",
+      "trace": {<TraceConfig> + n_domains/hosts_per_domain/chips},
+      "virtual_horizon_s": <end of simulation, virtual seconds>,
+      "policies": {
+        "<name>": {
+          "jobs": {"arrived", "scheduled", "completed", "ghost_reclaimed",
+                   "evicted_requeues", "unplaced_at_end"},
+          "queue_wait_s": {"p50", "p95", "mean", "max"},
+          "chip_utilization": {"time_weighted_mean", "peak"},
+          "fragmentation": {"time_weighted_mean", "peak"},
+          "ici_bw_score": {"mean_vs_ideal", "min_vs_ideal",
+                           "multi_chip_placements", "contiguous_frac"},
+          "preemptions": {"node_failures", "pods_evicted", "jobs_requeued"},
+          "gc": {"sweeps", "assumptions_released"},
+          "scheduler": {<deterministic policy counters>}
+        }, ...
+      },
+      "ab": {"policies": [...], "deltas": {<metric>: a_minus_b}}
+    }
+"""
+
+from __future__ import annotations
+
+from tputopo.extender.scheduler import quantile
+
+SCHEMA = "tputopo.sim/v1"
+
+
+def _r(x: float, nd: int = 6) -> float:
+    """Stable rounding: every float in the report passes through here, so
+    the byte-identical determinism contract never hinges on repr noise."""
+    return round(float(x), nd)
+
+
+class TimeWeighted:
+    """Time-weighted mean of a step function sampled at event boundaries."""
+
+    def __init__(self) -> None:
+        self._area = 0.0
+        self._last_t: float | None = None
+        self._last_v = 0.0
+        self.peak = 0.0
+
+    def sample(self, t: float, value: float) -> None:
+        if self._last_t is not None and t > self._last_t:
+            self._area += self._last_v * (t - self._last_t)
+        elif self._last_t is None:
+            self._last_t = t
+        self._last_t = max(self._last_t, t)
+        self._last_v = value
+        self.peak = max(self.peak, value)
+
+    def mean(self, horizon_s: float) -> float:
+        if horizon_s <= 0:
+            return 0.0
+        return self._area / horizon_s
+
+
+class MetricsCollector:
+    """Per-policy-run collector; the engine feeds it scheduling decisions,
+    occupancy samples, and lifecycle events."""
+
+    def __init__(self, total_chips: int) -> None:
+        self.total_chips = total_chips
+        self.queue_waits: list[float] = []
+        self.bw_scores: list[float] = []      # predicted / ideal, per multi-chip pod
+        self.contiguous = 0
+        self.multi_chip = 0
+        self.utilization = TimeWeighted()
+        self.fragmentation = TimeWeighted()
+        self.counts = {
+            "arrived": 0, "scheduled": 0, "completed": 0,
+            "ghost_reclaimed": 0, "evicted_requeues": 0,
+            "unplaced_at_end": 0,
+        }
+        self.preempt = {"node_failures": 0, "pods_evicted": 0,
+                        "jobs_requeued": 0}
+        self.gc = {"sweeps": 0, "assumptions_released": 0}
+
+    # ---- feeders -----------------------------------------------------------
+
+    def job_scheduled(self, wait_s: float) -> None:
+        self.counts["scheduled"] += 1
+        self.queue_waits.append(wait_s)
+
+    def placement(self, bw_vs_ideal: float, contiguous: bool) -> None:
+        self.multi_chip += 1
+        self.bw_scores.append(bw_vs_ideal)
+        if contiguous:
+            self.contiguous += 1
+
+    def occupancy(self, t: float, used_chips: int,
+                  frag_by_domain: list[tuple[int, int]]) -> None:
+        """``frag_by_domain``: (free_chips, largest_free_box_chips) per
+        domain.  Fragmentation of a domain = 1 - largest_box/free (0 when
+        empty-or-full); cluster value = free-chip-weighted mean."""
+        self.utilization.sample(t, used_chips / max(1, self.total_chips))
+        free_total = sum(f for f, _ in frag_by_domain)
+        if free_total > 0:
+            frag = sum(f * (1.0 - box / f) for f, box in frag_by_domain
+                       if f > 0) / free_total
+        else:
+            frag = 0.0
+        self.fragmentation.sample(t, frag)
+
+    # ---- report ------------------------------------------------------------
+
+    def report(self, horizon_s: float, policy_counters: dict) -> dict:
+        waits = sorted(self.queue_waits)
+        qw = {"p50": 0.0, "p95": 0.0, "mean": 0.0, "max": 0.0}
+        if waits:
+            qw = {
+                "p50": _r(quantile(waits, 0.5)),
+                "p95": _r(quantile(waits, 0.95)),
+                "mean": _r(sum(waits) / len(waits)),
+                "max": _r(waits[-1]),
+            }
+        bw = {"mean_vs_ideal": 0.0, "min_vs_ideal": 0.0,
+              "multi_chip_placements": self.multi_chip,
+              "contiguous_frac": 0.0}
+        if self.bw_scores:
+            bw.update(
+                mean_vs_ideal=_r(sum(self.bw_scores) / len(self.bw_scores)),
+                min_vs_ideal=_r(min(self.bw_scores)),
+                contiguous_frac=_r(self.contiguous / self.multi_chip),
+            )
+        return {
+            "jobs": dict(self.counts),
+            "queue_wait_s": qw,
+            "chip_utilization": {
+                "time_weighted_mean": _r(self.utilization.mean(horizon_s)),
+                "peak": _r(self.utilization.peak),
+            },
+            "fragmentation": {
+                "time_weighted_mean": _r(self.fragmentation.mean(horizon_s)),
+                "peak": _r(self.fragmentation.peak),
+            },
+            "ici_bw_score": bw,
+            "preemptions": dict(self.preempt),
+            "gc": dict(self.gc),
+            "scheduler": dict(sorted(policy_counters.items())),
+        }
+
+
+#: Scalar extractors for the A/B delta block: name -> path into a policy
+#: record.  Deltas are first-listed-policy minus each comparator.
+_DELTA_AXES = {
+    "ici_bw_score_mean_vs_ideal": ("ici_bw_score", "mean_vs_ideal"),
+    "queue_wait_p95_s": ("queue_wait_s", "p95"),
+    "queue_wait_p50_s": ("queue_wait_s", "p50"),
+    "chip_utilization_mean": ("chip_utilization", "time_weighted_mean"),
+    "fragmentation_mean": ("fragmentation", "time_weighted_mean"),
+    "jobs_scheduled": ("jobs", "scheduled"),
+    "contiguous_frac": ("ici_bw_score", "contiguous_frac"),
+}
+
+
+def ab_deltas(policies: dict[str, dict]) -> dict:
+    """Pairwise deltas of the headline metrics, reference = the first
+    policy (insertion order — the CLI preserves --policies order)."""
+    names = list(policies)
+    if len(names) < 2:
+        return {"policies": names, "deltas": {}}
+    ref = names[0]
+    deltas: dict[str, dict[str, float]] = {}
+    for other in names[1:]:
+        d = {}
+        for axis, (k1, k2) in _DELTA_AXES.items():
+            d[axis] = _r(policies[ref][k1][k2] - policies[other][k1][k2])
+        deltas[f"{ref}-vs-{other}"] = d
+    return {"policies": names, "deltas": deltas}
+
+
+def build_report(trace_desc: dict, horizon_s: float,
+                 policies: dict[str, dict],
+                 engine_params: dict | None = None) -> dict:
+    return {
+        "schema": SCHEMA,
+        "trace": trace_desc,
+        # Engine knobs that change results but are not part of the trace
+        # (--assume-ttl / --gc-period): recorded so two reports differing
+        # only here are distinguishable — a perf PR diffing reports must
+        # never mistake a knob change for a code change.
+        "engine": dict(engine_params or {}),
+        "virtual_horizon_s": _r(horizon_s),
+        "policies": policies,
+        "ab": ab_deltas(policies),
+    }

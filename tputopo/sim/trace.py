@@ -1,0 +1,204 @@
+"""Synthetic workload traces for the cluster simulator.
+
+A trace is the *input* half of the Gaia evidence base (PDF §IV: repeated
+allocations against staged occupancy states), generalized to sustained
+load: a time-ordered stream of gang arrivals (Poisson or bursty), each
+with a slice shape drawn from the BASELINE request vocabulary (singles,
+ICI pairs, host quads, multi-host gangs), a lognormal service duration,
+plus node failure/repair events and a small fraction of "ghost" jobs that
+bind but never confirm (the TTL-GC path).
+
+Everything is a pure function of :class:`TraceConfig` via one Philox
+stream — the same trace replays byte-identically for every policy in an
+A/B run, and across processes (the sim determinism contract,
+tests/test_sim.py).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import asdict, dataclass, field
+
+import numpy as np
+
+from tputopo.topology.generations import get_generation
+
+
+@dataclass(frozen=True)
+class JobSpec:
+    """One job: ``replicas`` pods of ``chips`` chips each (replicas > 1 is
+    a gang; every pod lands on its own host)."""
+
+    name: str
+    arrival_s: float
+    chips: int
+    replicas: int
+    duration_s: float
+    multislice: bool = False  # gang may split across ICI domains
+    ghost: bool = False       # binds but never confirms -> TTL GC reclaims
+
+    @property
+    def total_chips(self) -> int:
+        return self.chips * self.replicas
+
+
+@dataclass(frozen=True)
+class TraceConfig:
+    """Knobs of the synthetic trace.  ``job_mix`` weights the request
+    vocabulary: ("single", "pair", "quad", "gang"); gang replica counts
+    come from ``gang_sizes``."""
+
+    seed: int = 0
+    nodes: int = 64
+    spec: str = "v5p:4x4x4"        # per-ICI-domain torus; nodes are split
+                                   # into ceil(nodes / hosts_per_domain) domains
+    arrivals: int = 500
+    process: str = "poisson"       # "poisson" | "bursty"
+    rate_per_s: float = 0.1        # mean arrival rate, jobs per virtual second
+    burst_factor: float = 6.0      # bursty: high-phase rate multiplier
+    burst_len_s: float = 120.0     # bursty: mean phase length (exp-distributed)
+    job_mix: tuple[float, float, float, float] = (0.35, 0.2, 0.2, 0.25)
+    gang_sizes: tuple[int, ...] = (2, 4, 8)
+    p_multislice: float = 0.15     # fraction of gangs labeled allow-multislice
+    # Mean offered load at the defaults: ~6.2 chips/job x 0.1 jobs/s x
+    # 300 s / 256 chips (--nodes 64 of v5p:4x4x4 = 4 x 64-chip domains)
+    # ~= 0.73 of capacity — busy enough for queueing and fragmentation to
+    # matter, below the collapse regime where backlog drain would drown
+    # the placement-quality signal.
+    duration_mean_s: float = 300.0
+    duration_sigma: float = 0.8    # lognormal shape
+    ghost_prob: float = 0.02       # jobs that never confirm (GC exercise)
+    node_failures: int = 2         # fail events spread over the arrival window
+    repair_mean_s: float = 900.0   # exp-distributed time-to-repair
+
+    def rng(self) -> np.random.Generator:
+        # SeedSequence folds the seed on its own axis (the same collision
+        # lesson as workloads/data.py's epoch permutation).
+        return np.random.Generator(
+            np.random.Philox(seed=np.random.SeedSequence(
+                entropy=(0x7097090, self.seed))))
+
+    # ---- cluster geometry --------------------------------------------------
+
+    @property
+    def generation(self) -> str:
+        return self.spec.split(":", 1)[0]
+
+    @property
+    def domain_dims(self) -> tuple[int, ...]:
+        return tuple(int(x) for x in self.spec.split(":", 1)[1].split("x"))
+
+    @property
+    def hosts_per_domain(self) -> int:
+        gen = get_generation(self.generation)
+        hb = tuple(min(b, d) for b, d in zip(gen.host_bounds, self.domain_dims))
+        return math.prod(self.domain_dims) // math.prod(hb)
+
+    @property
+    def chips_per_host(self) -> int:
+        return math.prod(self.domain_dims) // self.hosts_per_domain
+
+    @property
+    def n_domains(self) -> int:
+        return max(1, math.ceil(self.nodes / self.hosts_per_domain))
+
+    @property
+    def total_chips(self) -> int:
+        return self.n_domains * math.prod(self.domain_dims)
+
+    def describe(self) -> dict:
+        d = asdict(self)
+        d.update(n_domains=self.n_domains, hosts_per_domain=self.hosts_per_domain,
+                 chips=self.total_chips)
+        return d
+
+
+@dataclass(frozen=True)
+class Trace:
+    config: TraceConfig
+    jobs: tuple[JobSpec, ...]
+    # (fail_s, repair_s, node_index) — node_index over the staged node list.
+    node_events: tuple[tuple[float, float, int], ...] = field(default=())
+
+
+def _arrival_times(cfg: TraceConfig, rng: np.random.Generator) -> np.ndarray:
+    if cfg.process == "poisson":
+        gaps = rng.exponential(1.0 / cfg.rate_per_s, cfg.arrivals)
+        return np.cumsum(gaps)
+    if cfg.process == "bursty":
+        # Two-phase Markov-modulated Poisson: burst phases arrive at
+        # burst_factor * rate, quiet phases at rate / burst_factor, and
+        # burst phases last 1/burst_factor as long as quiet ones — which
+        # makes the time-averaged rate exactly rate_per_s for any factor
+        # ((f*r * L/f + r/f * L) / (L/f + L) = r), so a bursty-vs-poisson
+        # A/B measures burstiness, not a hidden load change.
+        f = max(1.0, cfg.burst_factor)
+        times: list[float] = []
+        t, hot = 0.0, False
+        phase_end = rng.exponential(cfg.burst_len_s)
+        while len(times) < cfg.arrivals:
+            rate = cfg.rate_per_s * (f if hot else 1.0 / f)
+            nxt = t + rng.exponential(1.0 / rate)
+            if nxt < phase_end:
+                t = nxt
+                times.append(t)
+            else:
+                # Exponential gaps are memoryless: truncate at the phase
+                # boundary and redraw at the new phase's rate.  (Letting a
+                # long quiet-rate gap jump whole burst phases would censor
+                # exactly the arrivals burstiness exists to model.)
+                t = phase_end
+                hot = not hot
+                phase_end = t + rng.exponential(
+                    cfg.burst_len_s / f if hot else cfg.burst_len_s)
+        return np.asarray(times)
+    raise ValueError(f"unknown arrival process {cfg.process!r} "
+                     "(want 'poisson' or 'bursty')")
+
+
+def generate_trace(cfg: TraceConfig) -> Trace:
+    """The deterministic trace for ``cfg`` — one Philox stream, consumed in
+    a fixed order, so equal configs give byte-equal traces."""
+    rng = cfg.rng()
+    times = _arrival_times(cfg, rng)
+    kinds = rng.choice(4, size=cfg.arrivals,
+                       p=np.asarray(cfg.job_mix) / sum(cfg.job_mix))
+    durations = rng.lognormal(math.log(cfg.duration_mean_s),
+                              cfg.duration_sigma, cfg.arrivals)
+    gang_sizes = rng.choice(list(cfg.gang_sizes), size=cfg.arrivals)
+    multi = rng.random(cfg.arrivals) < cfg.p_multislice
+    ghosts = rng.random(cfg.arrivals) < cfg.ghost_prob
+
+    cph = cfg.chips_per_host
+    jobs = []
+    for i in range(cfg.arrivals):
+        kind = int(kinds[i])
+        if kind == 0:
+            chips, replicas = 1, 1
+        elif kind == 1:
+            chips, replicas = min(2, cph), 1
+        elif kind == 2:
+            chips, replicas = cph, 1
+        else:
+            chips, replicas = cph, int(gang_sizes[i])
+        jobs.append(JobSpec(
+            name=f"job-{i:05d}",
+            arrival_s=round(float(times[i]), 6),
+            chips=chips,
+            replicas=replicas,
+            duration_s=round(float(durations[i]), 6),
+            multislice=bool(kind == 3 and multi[i]),
+            ghost=bool(ghosts[i]),
+        ))
+
+    horizon = float(times[-1]) if cfg.arrivals else 0.0
+    node_events = []
+    if cfg.node_failures > 0 and cfg.nodes > 1:
+        fail_ts = np.sort(rng.uniform(0.0, max(horizon, 1.0),
+                                      cfg.node_failures))
+        victims = rng.integers(0, cfg.nodes, cfg.node_failures)
+        repairs = rng.exponential(cfg.repair_mean_s, cfg.node_failures)
+        for ft, victim, rep in zip(fail_ts, victims, repairs):
+            node_events.append((round(float(ft), 6),
+                                round(float(ft + rep), 6), int(victim)))
+    return Trace(config=cfg, jobs=tuple(jobs), node_events=tuple(node_events))

@@ -25,3 +25,9 @@ from tputopo.topology.slices import (  # noqa: F401
     Allocator,
 )
 from tputopo.topology.score import predict_allreduce_gbps, score_chip_set  # noqa: F401
+from tputopo.topology.baselines import (  # noqa: F401
+    BASELINE_PICKERS,
+    get_picker,
+    naive_pick,
+    register_picker,
+)

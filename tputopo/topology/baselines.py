@@ -12,15 +12,56 @@ fragmentation delta of topology awareness.
 
 from __future__ import annotations
 
+from typing import Callable
+
 from tputopo.topology.model import ChipTopology, Coord
 
+# Registry of named baseline chip pickers, the pluggable half of an A/B
+# study: every entry has the same signature (topo, free, k) -> chips|None,
+# so the sim (tputopo.sim.policies) and tests can wire any of them against
+# the ICI-aware scorer without knowing the policy by name.
+BASELINE_PICKERS: dict[str, "Callable[[ChipTopology, frozenset, int], tuple | None]"] = {}
 
+
+def register_picker(name: str):
+    """Decorator: register a baseline chip picker under ``name``."""
+    def deco(fn):
+        BASELINE_PICKERS[name] = fn
+        return fn
+    return deco
+
+
+def get_picker(name: str):
+    try:
+        return BASELINE_PICKERS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown baseline picker {name!r}; registered: "
+            f"{sorted(BASELINE_PICKERS)}") from None
+
+
+@register_picker("naive")
 def naive_pick(topo: ChipTopology, free: frozenset[Coord], k: int) -> tuple[Coord, ...] | None:
     """First-fit: the k lowest row-major-indexed free chips (count-only)."""
     if len(free) < k:
         return None
     ordered = sorted(free, key=topo.index)
     return tuple(ordered[:k])
+
+
+@register_picker("spread")
+def spread_pick(topo: ChipTopology, free: frozenset[Coord], k: int) -> tuple[Coord, ...] | None:
+    """Striped pick: k free chips taken at an even stride across the
+    row-major order — the load-balancing scatterer some stock schedulers
+    approximate (spread across racks), and the geometric worst case for a
+    collective: maximum pairwise hop distance for the same chip count."""
+    if len(free) < k:
+        return None
+    ordered = sorted(free, key=topo.index)
+    # stride >= 1 (len >= k), so int(i * stride) is strictly increasing —
+    # the k picks are distinct by construction.
+    stride = len(ordered) / k
+    return tuple(ordered[int(i * stride)] for i in range(k))
 
 
 class NaiveAllocator:

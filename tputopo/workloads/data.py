@@ -64,8 +64,14 @@ class TokenDataset:
         key = (n, seed, epoch)
         if key not in self._perm_cache:
             self._perm_cache.clear()  # one epoch resident at a time
+            # SeedSequence folds (seed, epoch) independently: the old
+            # ``key=seed + epoch`` collided (seed=1, epoch=0) with
+            # (seed=0, epoch=1), so nominally independent runs replayed
+            # each other's epoch permutations shifted by one.
             self._perm_cache[key] = np.random.Generator(
-                np.random.Philox(key=seed + epoch)).permutation(n)
+                np.random.Philox(
+                    seed=np.random.SeedSequence(entropy=(seed, epoch)))
+            ).permutation(n)
         return self._perm_cache[key]
 
     def __len__(self) -> int:

@@ -80,6 +80,19 @@ class ModelConfig:
     # prefill math are unaffected (they hold no cache).
     kv_dtype: str = "bf16"
 
+    #: Valid context-parallel strategies — the single source for both the
+    #: eager __post_init__ gate and the _attention dispatch.
+    SP_IMPLS = ("ring", "a2a")
+
+    def __post_init__(self):
+        # Validate eagerly, not at first context-parallel use: with sp<=1
+        # (or attn_impl forced) a typo'd strategy would otherwise run the
+        # default attention path silently instead of erroring.
+        if self.sp_impl not in self.SP_IMPLS:
+            raise ValueError(
+                f"unknown sp_impl {self.sp_impl!r} (want one of "
+                f"{self.SP_IMPLS})")
+
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
@@ -202,8 +215,8 @@ def _attention(x: jax.Array, p: dict, config: ModelConfig,
                 v = jnp.repeat(v, kv_group, axis=2)
                 kv_group = 1
             attn = a2a_attention
-        elif c.sp_impl != "ring":
-            raise ValueError(f"unknown sp_impl {c.sp_impl!r}")
+        # membership in SP_IMPLS is guaranteed by __post_init__; anything
+        # not "a2a" is "ring" here.
         q = constrain(q, "dp", "sp", "tp", None)
         k = constrain(k, "dp", "sp", "tp", None)
         v = constrain(v, "dp", "sp", "tp", None)

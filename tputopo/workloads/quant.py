@@ -134,10 +134,19 @@ def quantize_params(params: dict, *, bits: int = 8,
 def qdot(x: jax.Array, w) -> jax.Array:
     """``x @ w`` for a raw, quantized, or LoRA-wrapped weight.
 
-    Quantized: ``(x @ q) * s`` — scale applied after the contraction, so
-    the dot's HBM read is the int8 tensor.  ``w`` may carry leading batch
-    axes (a scan slice or a stacked expert table); the scale's kept
+    Quantized int8: ``(x @ q) * s`` — scale applied after the contraction,
+    so the dot's HBM read is the int8 tensor.  ``w`` may carry leading
+    batch axes (a scan slice or a stacked expert table); the scale's kept
     ``in`` axis is squeezed to broadcast over the dot output.
+
+    Grouped int4 is stricter: a leaf must be **scan-sliced first** —
+    ``{"int4": [G, g, out], "scale": [G, 1, out]}`` with NO leading axes.
+    The group einsum's ellipsis belongs to ``x``'s batch dims, so a
+    still-stacked table (layer or expert axis) cannot broadcast against
+    it — it would error on mismatched dims or, worse, broadcast silently
+    wrong when they coincide.  Such weights are rejected loudly below;
+    slice them (``jax.tree.map(lambda a: a[i], leaf)`` or ``lax.scan``)
+    or contract via :func:`deq` instead.
 
     LoRA (``{"lora_base", "lora_a", "lora_b", "lora_scale"}`` — see
     workloads/lora.py): the frozen base dot (itself raw or quantized)
@@ -162,7 +171,12 @@ def qdot(x: jax.Array, w) -> jax.Array:
         # backend's dot thunk rejects bf16 x bf16 = f32, and on TPU the
         # s4->f32 convert fuses into the dot operand exactly like
         # s4->bf16 would — the leg stays HBM-bound either way.
-        q = w["int4"].astype(jnp.float32)                 # [..., G, g, O]
+        if w["int4"].ndim > 3:
+            raise ValueError(
+                f"qdot int4 weight has leading axes (shape "
+                f"{tuple(w['int4'].shape)}; want [groups, group, out]): "
+                "scan-slice the stacked leaf before qdot, or use deq()")
+        q = w["int4"].astype(jnp.float32)                 # [G, g, O]
         s = jnp.squeeze(w["scale"], axis=-2)              # [..., G, O] f32
         G, g = q.shape[-3], q.shape[-2]
         xg = x.reshape(*x.shape[:-1], G, g).astype(jnp.float32)
