@@ -397,6 +397,12 @@ def bench_scale(n_domains: int = 4, spec: str = "v5p:8x8x4",
         "bind_p50_ms": round(pct(bind_ms, 0.5), 3),
         "bind_p95_ms": round(pct(bind_ms, 0.95), 3),
         "state_cache_hit_rate": round(hits / max(1, hits + builds), 3),
+        # State-maintenance economics (the incremental-state contract):
+        # folds must dominate rebuilds, or the watch-delta path regressed.
+        "state_delta_applied": c.get("state_delta_applied", 0),
+        "state_full_rebuilds": c.get("state_full_rebuilds", 0),
+        "state_delta_fallbacks": c.get("state_delta_fallbacks", 0),
+        "score_memo_carried": c.get("score_memo_carried", 0),
         "gang_plan_reuse_hits": c.get("gang_plan_reuse_hits", 0),
         "multislice_gang_size": multi_gang,
         "multislice_domains_used": len(wide_domains),

@@ -1,0 +1,362 @@
+"""Incremental mask-native cluster state: apply_event/with_events folding
+(watch-delta maintenance), its exact-equivalence contract against a fresh
+sync, the informer's event journal, the bounded latency window, and the
+differential delta-vs-full-rebuild sim replay."""
+
+import json
+import random
+
+import pytest
+
+from tests.cluster import build_cluster
+from tputopo.extender.scheduler import Metrics
+from tputopo.extender.state import ClusterState
+from tputopo.k8s import objects as ko
+from tputopo.k8s.informer import Informer
+from tputopo.k8s.objects import make_pod
+
+
+class _Clock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _sync(api, clock):
+    return ClusterState(api, clock=clock).sync()
+
+
+def _occupancy(state):
+    """Comparable occupancy snapshot: per-domain used mask + unhealthy."""
+    return {sid: (dom.allocator.used_mask, frozenset(dom.unhealthy))
+            for sid, dom in state.domains.items()}
+
+
+def _bind(api, name, node, chips, clock, *, assigned=False, gang=None):
+    anns = {
+        ko.ANN_GROUP: ko.coords_to_ann(chips),
+        ko.ANN_ASSUME_TIME: str(clock()),
+        ko.ANN_ASSIGNED: "true" if assigned else "false",
+    }
+    if gang:
+        anns[ko.ANN_GANG_ID] = gang
+    api.create("pods", make_pod(name, chips=len(chips), annotations=anns,
+                                node_name=node))
+    return api.get("pods", name, "default")
+
+
+def test_pending_pod_added_is_a_noop_fold():
+    clock = _Clock()
+    api, _ = build_cluster(clock=clock)
+    state = _sync(api, clock)
+    pod = make_pod("p", chips=4)
+    new = state.apply_event("pods", {"type": "ADDED", "object": pod})
+    assert new is not None and new is not state  # fresh COW instance
+    assert _occupancy(new) == _occupancy(state)
+
+
+def test_bind_event_folds_like_a_fresh_sync():
+    clock = _Clock()
+    api, _ = build_cluster(clock=clock)
+    state = _sync(api, clock)
+    obj = _bind(api, "p", "node-0", [(0, 0, 0), (0, 1, 0)], clock)
+    new = state.apply_event("pods", {"type": "ADDED", "object": obj})
+    assert new is not None
+    assert _occupancy(new) == _occupancy(_sync(api, clock))
+    assert (0, 0, 0) not in new.free_chips_on_node("node-0")
+    # The receiver is copy-on-write untouched.
+    assert (0, 0, 0) in state.free_chips_on_node("node-0")
+
+
+def test_assumption_wipe_and_delete_release_chips():
+    clock = _Clock()
+    api, _ = build_cluster(clock=clock)
+    _bind(api, "p", "node-0", [(0, 0, 0), (0, 1, 0)], clock)
+    state = _sync(api, clock)
+    # GC-style wipe: annotations cleared, pod object still around.
+    api.patch_annotations("pods", "p", {ko.ANN_GROUP: None,
+                                        ko.ANN_ASSUME_TIME: None,
+                                        ko.ANN_ASSIGNED: None},
+                          namespace="default")
+    wiped = api.get("pods", "p", "default")
+    new = state.apply_event("pods", {"type": "MODIFIED", "object": wiped})
+    assert new is not None
+    assert _occupancy(new) == _occupancy(_sync(api, clock))
+    assert (0, 0, 0) in new.free_chips_on_node("node-0")
+    # DELETED of a bound pod releases too (fold from the pre-wipe state).
+    new2 = state.apply_event("pods", {"type": "DELETED", "object": wiped})
+    assert new2 is not None
+    assert (0, 0, 0) in new2.free_chips_on_node("node-0")
+
+
+def test_confirm_flip_keeps_occupancy_and_updates_record():
+    clock = _Clock()
+    api, _ = build_cluster(clock=clock)
+    _bind(api, "p", "node-0", [(0, 0, 0)], clock)
+    state = _sync(api, clock)
+    api.patch_annotations("pods", "p", {ko.ANN_ASSIGNED: "true"},
+                          namespace="default")
+    new = state.apply_event(
+        "pods", {"type": "MODIFIED", "object": api.get("pods", "p", "default")})
+    assert new is not None
+    assert _occupancy(new) == _occupancy(state)
+    dom = new.domain_of_node("node-0")
+    assert [pa.assigned for pa in dom.assignments] == [True]
+    # ...and the parent still holds the pre-confirm record (COW).
+    assert [pa.assigned
+            for pa in state.domain_of_node("node-0").assignments] == [False]
+
+
+def test_overlapping_claim_falls_back_to_full_sync():
+    clock = _Clock()
+    api, _ = build_cluster(clock=clock)
+    _bind(api, "a", "node-0", [(0, 0, 0)], clock)
+    state = _sync(api, clock)
+    overlap = _bind(api, "b", "node-0", [(0, 0, 0)], clock)
+    assert state.apply_event(
+        "pods", {"type": "ADDED", "object": overlap}) is None
+
+
+def test_node_churn_falls_back_to_full_sync():
+    clock = _Clock()
+    api, _ = build_cluster(clock=clock)
+    state = _sync(api, clock)
+    node = api.get("nodes", "node-1")
+    assert state.apply_event("nodes", {"type": "DELETED", "object": node}) is None
+    assert state.apply_event("nodes", {"type": "ADDED", "object": node}) is None
+    # A non-TPU node joining is the one node ADDED with no derived impact.
+    assert state.apply_event(
+        "nodes", {"type": "ADDED",
+                  "object": {"metadata": {"name": "cpu-1", "annotations": {}}}}
+    ) is not None
+
+
+def test_unhealthy_report_folds_like_a_fresh_sync():
+    clock = _Clock()
+    api, _ = build_cluster(clock=clock)
+    _bind(api, "p", "node-0", [(0, 0, 0)], clock, assigned=True)
+    state = _sync(api, clock)
+    # Two dead chips: one free (enters used), one held (stays accounted).
+    api.patch_annotations("nodes", "node-0",
+                          {ko.ANN_UNHEALTHY: "0,0,0;0,1,0"})
+    new = state.apply_event(
+        "nodes", {"type": "MODIFIED", "object": api.get("nodes", "node-0")})
+    assert new is not None
+    fresh = _sync(api, clock)
+    assert _occupancy(new) == _occupancy(fresh)
+    assert [f"{pa.namespace}/{pa.pod_name}" for pa in
+            new.domain_of_node("node-0").on_unhealthy] == ["default/p"]
+    # Recovery: the free dead chip comes back, the held one stays used.
+    api.patch_annotations("nodes", "node-0", {ko.ANN_UNHEALTHY: None})
+    newer = new.apply_event(
+        "nodes", {"type": "MODIFIED", "object": api.get("nodes", "node-0")})
+    assert newer is not None
+    assert _occupancy(newer) == _occupancy(_sync(api, clock))
+
+
+def test_randomized_event_folds_match_fresh_sync():
+    """Equivalence fuzz: random bind/confirm/wipe/delete/unhealthy churn,
+    folded event-by-event, must track a from-scratch sync's occupancy at
+    every step (or explicitly fall back)."""
+    clock = _Clock()
+    api, _ = build_cluster(clock=clock)
+    rng = random.Random(11)
+    state = _sync(api, clock)
+    topo_chips = [(x, y, z) for x in range(2) for y in range(2)
+                  for z in range(4)]
+    live: list[str] = []
+    for step in range(120):
+        op = rng.random()
+        clock.t += rng.random()
+        if op < 0.4 or not live:
+            name = f"p{step}"
+            node = f"node-{rng.randrange(4)}"
+            k = rng.choice([1, 2, 4])
+            free = set(ClusterState(api, clock=clock).sync()
+                       .free_chips_on_node(node))
+            chips = sorted(free)[:k]
+            if len(chips) < k:
+                continue
+            obj = _bind(api, name, node, chips, clock,
+                        assigned=rng.random() < 0.5)
+            event = ("pods", {"type": "ADDED", "object": obj})
+            live.append(name)
+        elif op < 0.6:
+            name = rng.choice(live)
+            api.patch_annotations("pods", name, {ko.ANN_ASSIGNED: "true"},
+                                  namespace="default")
+            event = ("pods", {"type": "MODIFIED",
+                              "object": api.get("pods", name, "default")})
+        elif op < 0.8:
+            name = live.pop(rng.randrange(len(live)))
+            api.patch_annotations("pods", name,
+                                  {ko.ANN_GROUP: None, ko.ANN_ASSIGNED: None,
+                                   ko.ANN_ASSUME_TIME: None},
+                                  namespace="default")
+            event = ("pods", {"type": "MODIFIED",
+                              "object": api.get("pods", name, "default")})
+        elif op < 0.9:
+            name = live.pop(rng.randrange(len(live)))
+            obj = api.get("pods", name, "default")
+            api.delete("pods", name, "default")
+            event = ("pods", {"type": "DELETED", "object": obj})
+        else:
+            node = f"node-{rng.randrange(4)}"
+            bad = rng.sample(topo_chips, rng.randrange(0, 3))
+            api.patch_annotations(
+                "nodes", node,
+                {ko.ANN_UNHEALTHY: ko.coords_to_ann(bad) if bad else None})
+            event = ("nodes", {"type": "MODIFIED",
+                               "object": api.get("nodes", node)})
+        folded = state.apply_event(*event)
+        if folded is None:
+            state = _sync(api, clock)  # explicit, counted fallback
+        else:
+            state = folded
+        assert _occupancy(state) == _occupancy(_sync(api, clock)), \
+            (step, event[0], event[1]["type"])
+
+
+# ---- informer event journal --------------------------------------------------
+
+
+def test_informer_events_since_contract():
+    api, _ = build_cluster()
+    inf = Informer(api, watch_timeout_s=1.0).start()
+    try:
+        assert inf.wait_synced(10)
+        token = inf.version()
+        assert inf.events_since(token) == ([], token)
+        api.create("pods", make_pod("a", chips=1))
+        api.create("pods", make_pod("b", chips=1))
+        import time
+        deadline = time.time() + 10
+        while inf.version() == token and time.time() < deadline:
+            time.sleep(0.005)
+        got = inf.events_since(token)
+        assert got is not None
+        events, new_token = got
+        assert new_token == inf.version()
+        assert [e[0] for e in events] == ["pods"] * len(events)
+        assert {e[2]["metadata"]["name"] for e in events} <= {"a", "b"}
+        # A garbage/ancient token is a fallback, never a wrong answer.
+        assert inf.events_since(("bogus",)) is None
+        assert inf.events_since(("-5",)) is None
+    finally:
+        inf.stop()
+
+
+def test_informer_journal_gap_forces_rebuild():
+    """A relist bumps content without a journal entry: any span crossing
+    it must answer None (only a full rebuild is exact)."""
+    api, _ = build_cluster()
+    inf = Informer(api, watch_timeout_s=1.0).start()
+    try:
+        assert inf.wait_synced(10)
+        token = inf.version()
+        inf._relist("pods")  # simulate a watch Gone -> relist
+        assert inf.events_since(token) is None
+    finally:
+        inf.stop()
+
+
+# ---- bounded latency window --------------------------------------------------
+
+
+def test_metrics_latency_window_is_bounded_and_quantile_exact():
+    m = Metrics()
+    n = Metrics.LATENCY_WINDOW
+    xs = [float(i % 997) for i in range(n + 500)]
+    for x in xs:
+        m.observe_ms("sort", x)
+    assert len(m.latencies_ms["sort"]) == n  # bounded: oldest 500 dropped
+    retained = xs[-n:]
+    unbounded = Metrics()
+    # The window's quantiles equal the unbounded computation over exactly
+    # the retained samples (same ceil-rank convention).
+    for x in retained:
+        unbounded.observe_ms("x", x)
+    assert m.quantiles_ms("sort", (0.5, 0.95, 0.99)) == \
+        unbounded.quantiles_ms("x", (0.5, 0.95, 0.99))
+
+
+# ---- differential replay: delta maintenance vs full rebuild ------------------
+
+
+def _ici_run(force_full_rebuild: bool):
+    from tputopo.sim.engine import SimEngine
+    from tputopo.sim.trace import TraceConfig, generate_trace
+
+    cfg = TraceConfig(seed=5, nodes=16, spec="v5p:2x2x4", arrivals=80,
+                      ghost_prob=0.1, node_failures=2)
+    engine = SimEngine(generate_trace(cfg), "ici")
+    if force_full_rebuild:
+        engine.policy.sched.config.state_delta = False
+        engine.policy.sched.config.state_cache_s = 0.0
+        engine.policy.sched.config.bind_from_cache = False
+    stream = []
+    place = engine.policy.place
+
+    def recording_place(job, nodes):
+        out = place(job, nodes)
+        stream.append((job.name, json.dumps(out, sort_keys=True, default=str)))
+        return out
+
+    engine.policy.place = recording_place
+    report = engine.run()
+    return stream, report
+
+
+def test_delta_mode_decisions_match_full_rebuild_every_verb():
+    """The tentpole's hard constraint, replayed: one seeded trace through
+    the real scheduler twice — incremental delta maintenance vs a full
+    sync on every verb — must yield identical decision streams and
+    identical report placement fields."""
+    delta_stream, delta_report = _ici_run(force_full_rebuild=False)
+    full_stream, full_report = _ici_run(force_full_rebuild=True)
+    assert delta_stream == full_stream
+    # engine.run() returns one policy record; everything but the scheduler
+    # counters (which legitimately differ between the modes) must match.
+    d = {k: v for k, v in delta_report.items() if k != "scheduler"}
+    f = {k: v for k, v in full_report.items() if k != "scheduler"}
+    assert json.dumps(d, sort_keys=True) == json.dumps(f, sort_keys=True)
+    # And the delta run actually exercised the delta machinery.
+    c = delta_report["scheduler"]
+    assert c["state_delta_applied"] > 10 * c.get("state_full_rebuilds", 0)
+    assert full_report["scheduler"].get("state_delta_applied", 0) == 0
+
+
+def test_sim_report_carries_state_maintenance_counters():
+    from tputopo.sim.engine import run_trace
+    from tputopo.sim.trace import TraceConfig
+
+    cfg = TraceConfig(seed=0, nodes=8, spec="v5p:2x2x4", arrivals=30)
+    rep = run_trace(cfg, ["ici"])
+    c = rep["policies"]["ici"]["scheduler"]
+    assert "state_delta_applied" in c
+    assert "state_full_rebuilds" in c
+    assert c["state_delta_applied"] > c["state_full_rebuilds"]
+
+
+# ---- perf smoke (slow tier) --------------------------------------------------
+
+
+@pytest.mark.slow
+def test_sort_p95_stays_bounded_at_fleet_scale():
+    """Gross-regression tripwire at the standing evaluation config
+    (--nodes 64): the ici policy's sort p95 through a full trace must stay
+    under a generous ceiling (typical is well under 5 ms; the 100 ms bound
+    only catches complexity regressions, with ~30x headroom for shared-host
+    variance)."""
+    from tputopo.extender.scheduler import quantile
+    from tputopo.sim.engine import SimEngine
+    from tputopo.sim.trace import TraceConfig, generate_trace
+
+    cfg = TraceConfig(seed=0, nodes=64, arrivals=200)
+    engine = SimEngine(generate_trace(cfg), "ici")
+    engine.run()
+    sort_ms = sorted(engine.policy.sched.metrics.latencies_ms["sort"])
+    assert sort_ms, "trace produced no sorts"
+    assert quantile(sort_ms, 0.95) < 100.0

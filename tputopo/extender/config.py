@@ -40,6 +40,13 @@ class ExtenderConfig:
     # sim's virtual-time engine, single-binary dev rigs); the deployed
     # shape keeps an informer and leaves this off.
     bind_from_cache: bool = False
+    # Incremental derived-state maintenance: fold watch/mutation events
+    # into the cached ClusterState copy-on-write (O(event)) instead of
+    # dropping it and re-syncing O(nodes+pods) on the next verb.  Falls
+    # back to a full sync automatically on node-topology changes or any
+    # un-appliable event.  Off = every mirror change forces a rebuild
+    # (the conservative mode the differential test replays against).
+    state_delta: bool = True
     # Per-generation LinkCostModel field overrides, e.g.
     # {"v5p": {"ici_link_gbps": 95.0, "dcn_host_gbps": 42.0}} — the explicit,
     # measured replacement for the reference's TODO weight table.

@@ -77,13 +77,29 @@ def quantile(sorted_xs, q: float):
 @dataclass
 class Metrics:
     counters: dict[str, int] = field(default_factory=dict)
+    # Per-verb latency samples, bounded to a recent window: a long-lived
+    # extender observes millions of verbs, and the former unbounded lists
+    # grew without limit (the "ever-growing lists" note).  Quantiles are
+    # computed over the retained window with the same ceil-rank convention,
+    # so exported p50/p95 become rolling-window statistics.  Plain lists,
+    # not deques: sorted()/list() of a list snapshot atomically under the
+    # GIL, so a /metrics scrape never races a verb thread's append (a
+    # deque iterator raises RuntimeError on any concurrent mutation).
     latencies_ms: dict[str, list[float]] = field(default_factory=dict)
+
+    #: Samples retained per series.  4096 covers minutes of peak verb
+    #: traffic — far more than any quantile needs to be stable — while
+    #: bounding memory at a few tens of KB per series.
+    LATENCY_WINDOW = 4096
 
     def inc(self, name: str, by: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + by
 
     def observe_ms(self, name: str, ms: float) -> None:
-        self.latencies_ms.setdefault(name, []).append(ms)
+        xs = self.latencies_ms.setdefault(name, [])
+        xs.append(ms)
+        if len(xs) > self.LATENCY_WINDOW:
+            del xs[: len(xs) - self.LATENCY_WINDOW]
 
     def p50_ms(self, name: str) -> float | None:
         return (self.quantiles_ms(name, (0.5,)) or (None,))[0]
@@ -150,6 +166,14 @@ class ExtenderScheduler:
         self._cached_state: ClusterState | None = None
         self._cached_at: float = 0.0
         self._cached_informer_version: tuple[str, ...] | None = None
+        # Serializes WRITES of the (state, token) pair: sorts are lock-free
+        # readers, but two concurrent publishers (sort folds, binds) could
+        # otherwise interleave the two attribute writes and pair an old
+        # state with a newer token — which the version check would then
+        # wrongly serve as current.  Reads stay unlocked: the token-first
+        # read order plus idempotent re-folding tolerates every torn READ
+        # pairing (see _delta_from_informer).
+        self._cache_lock = threading.Lock()
         # bind's sync -> select -> patch sequence is not atomic; the HTTP
         # server is threaded, so serialize binds process-wide.  (The
         # kube-scheduler also serializes binds per cycle — this is defense
@@ -184,9 +208,123 @@ class ExtenderScheduler:
         ``bind_from_cache`` deployment MUST call after any out-of-band
         cluster mutation (pod create/delete, node churn, annotation wipes
         by an external GC) — the config's "sole writer" rule is only
-        satisfiable through this method (the sim's engine is the model
-        consumer)."""
+        satisfiable through this method or :meth:`apply_events` (the sim's
+        engine is the model consumer)."""
         self._cached_state = None
+
+    def apply_events(self, events) -> None:
+        """Fold out-of-band cluster mutations the caller just made into the
+        cached derived state copy-on-write (``(kind, event_type, object)``
+        triples, informer vocabulary) instead of dropping it — the delta
+        form of :meth:`invalidate_cached_state` for ``bind_from_cache``
+        single-writer deployments.  Un-appliable events (node churn,
+        overlapping claims) or ``state_delta=False`` degrade to a plain
+        drop: the next verb re-syncs, never serves a stale view."""
+        state = self._cached_state
+        if state is None:
+            return
+        if not self.config.state_delta or \
+                self._cached_informer_version is not None:
+            # Informer-coherent states advance only through the mirror's
+            # version token (the _state delta path) — an out-of-band fold
+            # here would fork them from the token; drop instead.
+            self._cached_state = None
+            return
+        if not events:
+            return  # nothing changed; the cached state is already exact
+        new_state = state.with_events(events)
+        if new_state is None:
+            self.metrics.inc("state_delta_fallbacks")
+            self._cached_state = None
+        else:
+            self.metrics.inc("state_delta_applied")
+            new_state = self._carry_state_memos(state, new_state)
+            with self._cache_lock:
+                if self._cached_state is state:
+                    self._cached_state = new_state
+                else:  # replaced/invalidated meanwhile — stay conservative
+                    self._cached_state = None
+
+    def _carry_state_memos(self, old: ClusterState,
+                           new: ClusterState) -> ClusterState:
+        """Carry occupancy-pure memos (node scores, gang candidate maps)
+        from a replaced derived state onto its delta successor, per domain
+        whose occupancy mask did not move.  A node's score and a domain's
+        per-host candidate map are pure functions of (domain occupancy, k)
+        — folding an event that only touched OTHER domains (or none, e.g.
+        a Pending pod ADDED) cannot invalidate them, and rescoring a
+        256-node fleet per fold was the sort tail's dominant cost."""
+        changed = {sid for sid, dom in old.domains.items()
+                   if new.domains[sid].allocator.used_mask
+                   != dom.allocator.used_mask}
+        memo = getattr(old, "_score_memo", None)
+        if memo:
+            kept = {key: v for key, v in memo.items()
+                    if (d := new.domain_of_node(key[1])) is not None
+                    and d.slice_id not in changed} if changed else dict(memo)
+            if kept:
+                new._score_memo = kept
+                self.metrics.inc("score_memo_carried", len(kept))
+        cand = getattr(old, "_gang_cand_memo", None)
+        if cand:
+            kept = {key: v for key, v in cand.items()
+                    if key[0] not in changed}
+            if kept:
+                new._gang_cand_memo = kept
+        return new
+
+    def _delta_from_informer(self, reader) -> ClusterState | None:
+        """Advance the cached informer-coherent state to the mirror's
+        current content by folding the watch events in between (the
+        journal), or None when only a full rebuild is exact (no cached
+        state, journal gap/relist, un-appliable event, expiry-judgement
+        age bound exceeded)."""
+        # Snapshot, TOKEN FIRST: sorts are lock-free by design, so a
+        # concurrent bind may publish a newer (state, token) pair between
+        # these two reads.  Reading the token before the state means a torn
+        # read can only pair an OLD token with a NEW state — folding the
+        # journal tail then re-applies events the state already reflects,
+        # which the event folding is idempotent for (upsert of an identical
+        # assignment updates in place; delete/wipe of an absent record is a
+        # no-op).  The opposite pairing (new token, old state) would
+        # persist a state MISSING a bind under a token that claims it is
+        # current — that is the order this read forbids.
+        token = self._cached_informer_version
+        state = self._cached_state
+        if (not self.config.state_delta
+                or state is None or token is None
+                or self.clock() - self._cached_at
+                    >= self._INFORMER_STATE_MAX_AGE_S):
+            return None
+        fetch = getattr(reader, "events_since", None)
+        if fetch is None:
+            return None
+        got = fetch(token)
+        if got is None:
+            self.metrics.inc("state_delta_fallbacks")
+            return None
+        events, new_token = got
+        if not events:
+            return state  # token already current (raced version read)
+        new_state = state.with_events(events)
+        if new_state is None:
+            self.metrics.inc("state_delta_fallbacks")
+            return None
+        self.metrics.inc("state_delta_applied")
+        new_state = self._carry_state_memos(state, new_state)
+        with self._cache_lock:
+            # Publish only if no concurrent publisher advanced the pair
+            # past what we folded from; either way new_state is coherent
+            # at new_token and serves THIS verb.
+            if (self._cached_state is state
+                    and self._cached_informer_version == token):
+                self._cached_state = new_state
+                self._cached_informer_version = new_token
+                # _cached_at deliberately NOT refreshed: it stamps when
+                # occupancy was last judged against the clock (assume-TTL
+                # expiry happens only at sync), and the age bound above
+                # must keep holding under sustained event traffic.
+        return new_state
 
     def _state(self, allow_cache: bool = False, reader=None) -> ClusterState:
         if allow_cache and reader is not None:
@@ -194,7 +332,8 @@ class ExtenderScheduler:
             # mirror through the same list() surface — no API-server LISTs.
             # Rebuild only when the mirror changed (rv token) or the derived
             # state aged past the expiry-staleness bound; a sort burst
-            # otherwise reuses one build.
+            # otherwise reuses one build, and a burst under churn folds the
+            # mirror's event deltas instead of rebuilding per tick.
             version = reader.version()
             if (self._cached_state is not None
                     and self._cached_informer_version == version
@@ -202,31 +341,42 @@ class ExtenderScheduler:
                         < self._INFORMER_STATE_MAX_AGE_S):
                 self.metrics.inc("state_cache_hits")
                 return self._cached_state
+            state = self._delta_from_informer(reader)
+            if state is not None:
+                return state
             self.metrics.inc("state_from_informer")
+            self.metrics.inc("state_full_rebuilds")
             state = ClusterState(
                 reader,
                 cost_for_generation=self.config.cost_model,
                 assume_ttl_s=self.config.assume_ttl_s,
                 clock=self.clock,
             ).sync()
-            self._cached_state = state
-            self._cached_at = self.clock()
-            self._cached_informer_version = version
+            with self._cache_lock:
+                self._cached_state = state
+                self._cached_at = self.clock()
+                # The PRE-build token: if the mirror advanced mid-build,
+                # the next verb folds (or re-folds — the event application
+                # is idempotent for upserts the state already reflects) the
+                # tail rather than ever serving a view older than its token.
+                self._cached_informer_version = version
             return state
         ttl = self.config.state_cache_s
         if (allow_cache and ttl > 0 and self._cached_state is not None
                 and self.clock() - self._cached_at < ttl):
             self.metrics.inc("state_cache_hits")
             return self._cached_state
+        self.metrics.inc("state_full_rebuilds")
         state = ClusterState(
             self.api,
             cost_for_generation=self.config.cost_model,
             assume_ttl_s=self.config.assume_ttl_s,
             clock=self.clock,
         ).sync()
-        self._cached_state = state
-        self._cached_at = self.clock()
-        self._cached_informer_version = None  # not an informer-coherent build
+        with self._cache_lock:
+            self._cached_state = state
+            self._cached_at = self.clock()
+            self._cached_informer_version = None  # not informer-coherent
         return state
 
     # ---- sort (Prioritize) -------------------------------------------------
@@ -297,20 +447,20 @@ class ExtenderScheduler:
         dom = state.domain_of_node(node_name)
         if dom is None:
             return 0
-        node_free = frozenset(state.free_chips_on_node(node_name))
-        if len(node_free) < k:
+        node_mask = dom.node_masks.get(node_name, 0)
+        node_free_mask = node_mask & dom.allocator.free_mask
+        if node_free_mask.bit_count() < k:
             return 0
         placement = dom.allocator.find(
-            k, node_free, within=tuple(dom.chips_by_node.get(node_name, ())))
+            k, free_mask=node_free_mask, within_mask=node_mask)
         if placement is None:
             return 0
         if k == 1:
             # Anti-fragmentation quality: fewer free neighbors around the
             # chosen chip is better (Singular policy, Gaia PDF Alg. 3).
             chip = placement.chips[0]
-            free_all = dom.allocator.free
             degree = max(1, len(dom.topology.neighbors(chip)))
-            free_n = sum(1 for n in dom.topology.neighbors(chip) if n in free_all)
+            free_n = dom.allocator.free_neighbor_count(chip)
             return max(1, round(MAX_PRIORITY * (1 - free_n / (degree + 1))))
         ideal = self._ideal_gbps(dom, k)
         if ideal <= 0:
@@ -374,18 +524,35 @@ class ExtenderScheduler:
         grid_dims = tuple(max(1, d // b) for d, b in zip(topo.dims, hb))
         host_grid = _host_grid(topo.generation, grid_dims, topo.wrap)
 
-        candidate: dict[Coord, Placement] = {}
-        for host, node_name in dom.node_by_host.items():
-            if node_name in exclude_nodes:
-                continue
-            node_free = frozenset(state.free_chips_on_node(node_name))
-            if len(node_free) < k:
-                continue
-            p = dom.allocator.find(
-                k, node_free,
-                within=tuple(dom.chips_by_node.get(node_name, ())))
-            if p is not None:
-                candidate[host] = p
+        # Per-host candidate map, memoized on the state instance: it
+        # depends only on (state occupancy, domain, k, exclude), and the
+        # multislice composition search probes the same key for every
+        # feasible replica count m — without the memo, max_feasible re-ran
+        # allocator.find across every host per probe.  States are replaced
+        # wholesale (rebuild, event fold, bind delta), so the memo can
+        # never outlive the occupancy it was computed from.
+        memo = getattr(state, "_gang_cand_memo", None)
+        if memo is None:
+            memo = state._gang_cand_memo = {}
+        memo_key = (dom.slice_id, k, frozenset(exclude_nodes))
+        candidate = memo.get(memo_key)
+        if candidate is None:
+            candidate = {}
+            free_mask = dom.allocator.free_mask
+            for host, node_name in dom.node_by_host.items():
+                if node_name in exclude_nodes:
+                    continue
+                node_mask = dom.node_masks.get(node_name, 0)
+                node_free_mask = node_mask & free_mask
+                if node_free_mask.bit_count() < k:
+                    continue
+                p = dom.allocator.find(
+                    k, free_mask=node_free_mask, within_mask=node_mask)
+                if p is not None:
+                    candidate[host] = p
+            memo[memo_key] = candidate
+        else:
+            self.metrics.inc("gang_candidate_memo_hits")
 
         if len(candidate) < replicas:
             return None
@@ -921,13 +1088,13 @@ class ExtenderScheduler:
                 )
             placement = gang_ctx["plan"][node_name]
         else:
-            node_free = frozenset(state.free_chips_on_node(node_name))
-            placement = dom.allocator.find(k, node_free)
+            node_free_mask = state.free_mask_on_node(node_name)
+            placement = dom.allocator.find(k, free_mask=node_free_mask)
             if placement is None:
                 self.metrics.inc("bind_errors")
                 raise BindError(
                     f"no feasible {k}-chip placement on {node_name} "
-                    f"({len(node_free)} free)"
+                    f"({node_free_mask.bit_count()} free)"
                 )
 
         now = self.clock()
@@ -978,7 +1145,8 @@ class ExtenderScheduler:
             # applied instead of invalidating — the next verb reuses it,
             # and bind stays O(chips) instead of O(pods).
             published = False
-            if (new_token is not None and state_token is not None
+            if (self.config.state_delta and new_token is not None
+                    and state_token is not None
                     and state is self._cached_state):
                 try:
                     expected = (str(int(state_token[0]) + 1),)
@@ -989,8 +1157,10 @@ class ExtenderScheduler:
                         state, pod_name, namespace, node_name, placement,
                         now, gang_id)
                     if new_state is not None:
-                        self._cached_state = new_state
-                        self._cached_informer_version = new_token
+                        new_state = self._carry_state_memos(state, new_state)
+                        with self._cache_lock:
+                            self._cached_state = new_state
+                            self._cached_informer_version = new_token
                         # _cached_at deliberately NOT refreshed: it stamps
                         # when occupancy was last judged against the clock
                         # (assume-TTL expiry happens only at sync), and the
@@ -999,10 +1169,17 @@ class ExtenderScheduler:
                         # timestamp forward.
                         published = True
                         self.metrics.inc("bind_state_delta")
-            if not published:
-                # Either external events intervened or the delta could not
-                # apply: drop the derived state; the next verb rebuilds
-                # from the (write-through-fresh) mirror.
+            if not published and not (self.config.state_delta
+                                      and state_token is not None
+                                      and state is self._cached_state):
+                # The delta could not apply and the cached state is not an
+                # informer-coherent (state, token) pair the event journal
+                # can fold forward — drop it; the next verb rebuilds from
+                # the (write-through-fresh) mirror.  When the pair IS
+                # coherent at its token (external events merely interleaved
+                # with our bind), it stays: the next verb folds the journal
+                # tail — including this bind's own write-through — in
+                # O(events) instead of re-syncing O(pods).
                 self._cached_state = None
         elif self.config.bind_from_cache:
             # Informer-less assume cache (single-writer mode): apply our
@@ -1011,10 +1188,13 @@ class ExtenderScheduler:
             # is exactly this delta, since no one else writes assignments.
             new_state = (self._bind_delta_state(
                 state, pod_name, namespace, node_name, placement, now,
-                gang_id) if state is self._cached_state else None)
-            self._cached_state = new_state
+                gang_id) if self.config.state_delta
+                and state is self._cached_state else None)
             if new_state is not None:
+                new_state = self._carry_state_memos(state, new_state)
                 self.metrics.inc("bind_state_delta")
+            with self._cache_lock:
+                self._cached_state = new_state
 
         decision = {
             "pod": f"{namespace}/{pod_name}",

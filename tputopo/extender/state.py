@@ -22,7 +22,7 @@ from tputopo.k8s import objects as ko
 from tputopo.k8s.fakeapi import FakeApiServer
 from tputopo.topology.cost import LinkCostModel
 from tputopo.topology.model import ChipTopology, Coord, parse_topology
-from tputopo.topology.slices import Allocator
+from tputopo.topology.slices import Allocator, chips_mask
 
 
 @dataclass
@@ -34,6 +34,28 @@ class PodAssignment:
     assigned: bool
     assume_time: float
     gang_id: str | None
+
+
+class _DeltaUnappliable(Exception):
+    """An event the copy-on-write delta machinery cannot fold exactly
+    (node-topology change, overlapping claim, conflicted base state) —
+    the caller falls back to a full sync()."""
+
+
+class _PodRec:
+    """Per-pod derived-state record: what sync concluded about one pod,
+    kept in an index so watch deltas can fold pod events without an
+    O(pods) rescan.  ``held`` is the chip subset this pod actually
+    occupies in the allocator (== chips unless the pod conflicted)."""
+
+    __slots__ = ("pa", "sid", "status", "held")
+
+    def __init__(self, pa: PodAssignment, sid: str, status: str,
+                 held: tuple[Coord, ...]) -> None:
+        self.pa = pa
+        self.sid = sid
+        self.status = status  # "active" | "expired"
+        self.held = held
 
 
 @lru_cache(maxsize=4096)
@@ -58,6 +80,42 @@ def _assume_time_of(pod: dict) -> float:
     return val if math.isfinite(val) else 0.0
 
 
+def _pod_assignment_of(pod: dict) -> PodAssignment | None:
+    """The assignment a pod object carries, or None for a pod with no
+    derived-state impact (no chip group or not bound to a node).  THE pod
+    filter — shared by sync() and the event folders, so the two can never
+    silently diverge on what counts as an assignment."""
+    md = pod.get("metadata", {})
+    anns = md.get("annotations", {})
+    group = anns.get(ko.ANN_GROUP)
+    node_name = pod.get("spec", {}).get("nodeName")
+    if not group or not node_name:
+        return None
+    return PodAssignment(
+        pod_name=md["name"],
+        namespace=md.get("namespace", "default"),
+        node_name=node_name,
+        chips=ko.ann_to_coords(group),
+        assigned=anns.get(ko.ANN_ASSIGNED) == "true",
+        assume_time=_assume_time_of(pod),
+        gang_id=anns.get(ko.ANN_GANG_ID),
+    )
+
+
+def _host_coord_of(anns: dict) -> Coord:
+    """Node ANN_HOST_COORD -> host-grid coordinate (shared parse)."""
+    return tuple(int(x) for x in anns[ko.ANN_HOST_COORD].split(","))
+
+
+def _node_unhealthy_of(anns: dict, valid: frozenset) -> frozenset[Coord]:
+    """Node ANN_UNHEALTHY -> this node's dead-chip set, bogus coords
+    dropped (a hand-written annotation must not wedge sync) — shared by
+    sync() and the node-event folder."""
+    return frozenset(
+        c for c in ko.ann_to_coords(anns.get(ko.ANN_UNHEALTHY, ""))
+        if c in valid)
+
+
 @dataclass
 class SliceDomain:
     """One ICI domain: a set of nodes sharing a torus (same slice-id)."""
@@ -68,6 +126,10 @@ class SliceDomain:
     node_by_host: dict[Coord, str] = field(default_factory=dict)   # host coord -> node name
     host_by_node: dict[str, Coord] = field(default_factory=dict)
     chips_by_node: dict[str, list[Coord]] = field(default_factory=dict)
+    # Per-node chip bitmask over the topology's chip index, precomputed at
+    # sync (immutable afterwards, shared across copy-on-write states):
+    # free_chips_on_node is then one AND against the allocator's free_mask.
+    node_masks: dict[str, int] = field(default_factory=dict)
     assignments: list[PodAssignment] = field(default_factory=list)
     conflicts: list[PodAssignment] = field(default_factory=list)
     expired: list[PodAssignment] = field(default_factory=list)
@@ -99,6 +161,10 @@ class ClusterState:
         # wedge every verb AND the GC that could clean it up.
         self.conflicts: list[PodAssignment] = []
         self._dom_by_node: dict[str, SliceDomain] = {}
+        # Delta-maintenance bookkeeping (populated by sync):
+        self._pod_index: dict[tuple[str, str], _PodRec] = {}
+        self._unhealthy_by_node: dict[str, frozenset[Coord]] = {}
+        self._synced_at: float = 0.0  # clock at sync — expiry judgement time
 
     # ---- sync (SURVEY.md §3.2: parse annotations -> in-memory model) -------
 
@@ -116,6 +182,8 @@ class ClusterState:
         self.expired = []
         self.conflicts = []
         self._dom_by_node = {}
+        self._pod_index = {}
+        self._unhealthy_by_node = {}
         for node in self._list("nodes"):
             anns = node["metadata"].get("annotations", {})
             if ko.ANN_TOPOLOGY not in anns or ko.ANN_SLICE_ID not in anns:
@@ -136,18 +204,21 @@ class ClusterState:
                     f"{dom.topology.describe()} vs {topo.describe()}"
                 )
             name = node["metadata"]["name"]
-            host = tuple(int(x) for x in anns[ko.ANN_HOST_COORD].split(","))
+            host = _host_coord_of(anns)
             dom.node_by_host[host] = name
             dom.host_by_node[name] = host
             self._dom_by_node[name] = dom
             dom.chips_by_node[name] = list(
                 _parse_chips_ann(anns.get(ko.ANN_CHIPS, "[]")))
-            valid = dom.topology.chip_set
-            dom.unhealthy.update(
-                c for c in ko.ann_to_coords(anns.get(ko.ANN_UNHEALTHY, ""))
-                if c in valid)  # a bogus coord must not wedge sync
+            dom.node_masks[name] = chips_mask(
+                dom.topology, dom.chips_by_node[name], ignore_unknown=True)
+            node_unhealthy = _node_unhealthy_of(anns, dom.topology.chip_set)
+            if node_unhealthy:
+                self._unhealthy_by_node[name] = node_unhealthy
+                dom.unhealthy.update(node_unhealthy)
 
         now = self.clock()
+        self._synced_at = now
         valid_chips = {sid: set(dom.topology.chips)
                        for sid, dom in self.domains.items()}
         pods = sorted(
@@ -159,30 +230,19 @@ class ClusterState:
             ),
         )
         for pod in pods:
-            anns = pod["metadata"].get("annotations", {})
-            group = anns.get(ko.ANN_GROUP)
-            node_name = pod["spec"].get("nodeName")
-            if not group or not node_name:
+            pa = _pod_assignment_of(pod)
+            if pa is None:
                 continue
-            assigned = anns.get(ko.ANN_ASSIGNED) == "true"
-            assume_time = _assume_time_of(pod)
-            pa = PodAssignment(
-                pod_name=pod["metadata"]["name"],
-                namespace=pod["metadata"].get("namespace", "default"),
-                node_name=node_name,
-                chips=ko.ann_to_coords(group),
-                assigned=assigned,
-                assume_time=assume_time,
-                gang_id=anns.get(ko.ANN_GANG_ID),
-            )
-            dom = self._domain_of_node(node_name)
+            dom = self._domain_of_node(pa.node_name)
             if dom is None:
                 continue
-            if not assigned and now - assume_time > self.assume_ttl_s:
+            key = (pa.namespace, pa.pod_name)
+            if not pa.assigned and now - pa.assume_time > self.assume_ttl_s:
                 # Stale assumption: bind happened but Allocate never confirmed
                 # within the TTL — the chips are NOT occupied (SURVEY.md §5.2).
                 self.expired.append(pa)
                 dom.expired.append(pa)
+                self._pod_index[key] = _PodRec(pa, dom.slice_id, "expired", ())
                 continue
             dom.assignments.append(pa)
             valid = valid_chips[dom.slice_id]
@@ -195,6 +255,8 @@ class ClusterState:
                 self.conflicts.append(pa)
                 dom.conflicts.append(pa)
             dom.allocator.mark_used(fresh)
+            self._pod_index[key] = _PodRec(pa, dom.slice_id, "active",
+                                           tuple(fresh))
             if any(c in dom.unhealthy for c in pa.chips):
                 # Running (or promised) on silicon the node now reports
                 # dead — surfaced for the job controller; chips stay
@@ -210,19 +272,17 @@ class ClusterState:
     def _domain_of_node(self, node_name: str) -> SliceDomain | None:
         return self._dom_by_node.get(node_name)
 
-    # ---- delta application (the bind fast path) ----------------------------
+    # ---- delta application (the watch/bind fast path) ----------------------
 
-    def with_bind(self, pa: PodAssignment) -> "ClusterState":
-        """A new state equal to this one plus one just-bound assignment —
-        the extender's bind delta (VERDICT r3 #1: bind used to pay a full
-        O(pods) cluster re-sync per call; applying its own delta to the
-        informer-coherent derived state is O(chips)).
-
-        Copy-on-write: the receiver and its domains are never mutated, so
-        concurrently running sorts holding the old state keep a consistent
-        snapshot; the caller atomically publishes the returned state.
-        Raises ValueError when the assignment's chips are not free here
-        (the caller falls back to a full re-sync)."""
+    def _cow(self) -> "ClusterState":
+        """Copy-on-write clone: the receiver and its domains are never
+        mutated, so concurrently running sorts holding the old state keep a
+        consistent snapshot; the caller mutates the clone and atomically
+        publishes it.  Topology, node maps, chip lists/masks are immutable
+        after sync — shared; occupancy (an O(1) mask clone) and assignment
+        lists are copied.  Per-state memos (gang plans, node scores) are
+        attribute-attached by the scheduler and deliberately NOT carried
+        over: the delta invalidates them."""
         new = ClusterState.__new__(ClusterState)
         new.api = self.api
         new.assume_ttl_s = self.assume_ttl_s
@@ -230,20 +290,19 @@ class ClusterState:
         new._cost_for_generation = self._cost_for_generation
         new.expired = list(self.expired)
         new.conflicts = list(self.conflicts)
+        new._pod_index = dict(self._pod_index)
+        new._unhealthy_by_node = self._unhealthy_by_node
+        new._synced_at = self._synced_at
         new.domains = {}
         new._dom_by_node = {}
         for sid, dom in self.domains.items():
-            # Topology, node maps, chip lists, and the unhealthy set are
-            # immutable after sync — shared; occupancy and assignment lists
-            # are copied.  Per-state memos (gang plans, node scores) are
-            # attribute-attached by the scheduler and deliberately NOT
-            # carried over: the delta invalidates them.
             nd = SliceDomain(
                 slice_id=sid, topology=dom.topology,
                 allocator=dom.allocator.clone(),
                 node_by_host=dom.node_by_host,
                 host_by_node=dom.host_by_node,
                 chips_by_node=dom.chips_by_node,
+                node_masks=dom.node_masks,
                 assignments=list(dom.assignments),
                 conflicts=list(dom.conflicts),
                 expired=list(dom.expired),
@@ -253,12 +312,233 @@ class ClusterState:
             new.domains[sid] = nd
             for node in nd.host_by_node:
                 new._dom_by_node[node] = nd
+        return new
+
+    def with_bind(self, pa: PodAssignment) -> "ClusterState":
+        """A new state equal to this one plus one just-bound assignment —
+        the extender's bind delta (VERDICT r3 #1: bind used to pay a full
+        O(pods) cluster re-sync per call; applying its own delta to the
+        informer-coherent derived state is O(chips)).
+
+        Raises ValueError when the assignment's chips are not free here
+        (the caller falls back to a full re-sync)."""
+        new = self._cow()
         dom = new._dom_by_node.get(pa.node_name)
         if dom is None:
             raise ValueError(f"node {pa.node_name} not in any domain")
         dom.allocator.mark_used(pa.chips)  # raises if any chip is taken
         dom.assignments.append(pa)
+        new._pod_index[(pa.namespace, pa.pod_name)] = _PodRec(
+            pa, dom.slice_id, "active", tuple(pa.chips))
         return new
+
+    def apply_event(self, kind: str, event: dict) -> "ClusterState | None":
+        """This state plus one informer-style watch event
+        (``{"type": ADDED|MODIFIED|DELETED, "object": ...}``) folded in
+        copy-on-write, or None when the event cannot be applied exactly
+        (node-topology change, overlapping chip claim, conflicted base
+        state) and the caller must fall back to a full sync()."""
+        return self.with_events([(kind, event.get("type"), event["object"])])
+
+    def with_events(self, events) -> "ClusterState | None":
+        """Fold a sequence of ``(kind, event_type, object)`` watch events
+        into a copy-on-write clone — the generalization of the bind-only
+        delta to the full informer event vocabulary: pod ADDED/MODIFIED/
+        DELETED (binds, assumption wipes, confirms, deletions) and node
+        unhealthy-chip changes apply in O(event); node add/remove or any
+        topology-shaped change returns None (full sync is the only exact
+        answer there).  Expiry is still judged at this state's original
+        sync time — the caller's staleness bound (the scheduler's
+        _INFORMER_STATE_MAX_AGE_S) governs when a real re-sync re-judges
+        the TTL clock."""
+        if self.conflicts:
+            # A conflicted base state's occupancy attribution is
+            # order-dependent (first claimant wins); removing or adding
+            # claims can reshuffle it in ways only a full re-sort sees.
+            return None
+        new = self._cow()
+        try:
+            for kind, etype, obj in events:
+                if etype == "BOOKMARK":
+                    continue
+                if kind == "pods":
+                    new._apply_pod_event(etype, obj)
+                elif kind == "nodes":
+                    new._apply_node_event(etype, obj)
+                else:
+                    raise _DeltaUnappliable(f"unknown kind {kind!r}")
+        except _DeltaUnappliable:
+            return None
+        return new
+
+    # -- event folding internals (mutate a _cow clone only) ------------------
+
+    def _parse_pod_assignment(self, obj: dict) -> PodAssignment | None:
+        """The assignment a pod object carries, or None when it has no
+        derived-state impact — sync()'s shared pod filter
+        (:func:`_pod_assignment_of`) plus the known-node gate."""
+        pa = _pod_assignment_of(obj)
+        if pa is None or self._dom_by_node.get(pa.node_name) is None:
+            return None
+        return pa
+
+    def _apply_pod_event(self, etype: str, obj: dict) -> None:
+        md = obj.get("metadata", {})
+        key = (md.get("namespace", "default"), md["name"])
+        old = self._pod_index.get(key)
+        new_pa = None if etype == "DELETED" else self._parse_pod_assignment(obj)
+        if old is None and new_pa is None:
+            return  # no derived impact before or after (e.g. a Pending pod)
+        if old is not None and new_pa is not None:
+            if (old.pa.node_name == new_pa.node_name
+                    and list(old.pa.chips) == list(new_pa.chips)):
+                self._update_assignment(key, old, new_pa)
+                return
+            # Chips or node moved: remove the old claim, add the new one.
+        if old is not None:
+            self._remove_assignment(key, old)
+        if new_pa is not None:
+            self._add_assignment(new_pa)
+
+    @staticmethod
+    def _replace_in(lst: list, old_pa: PodAssignment,
+                    new_pa: PodAssignment) -> None:
+        for i, x in enumerate(lst):
+            if x is old_pa:
+                lst[i] = new_pa
+                return
+
+    @staticmethod
+    def _remove_from(lst: list, pa: PodAssignment) -> bool:
+        for i, x in enumerate(lst):
+            if x is pa:
+                del lst[i]
+                return True
+        return False
+
+    def _update_assignment(self, key, old: _PodRec,
+                           new_pa: PodAssignment) -> None:
+        """Metadata-only change (ASSIGNED confirm, assume-time restamp,
+        gang label): same chips, same node — occupancy unchanged, replace
+        the record.  The old PodAssignment object is shared with the parent
+        state's lists, so it is replaced, never mutated."""
+        dom = self.domains[old.sid]
+        if old.status == "expired":
+            if (new_pa.assigned == old.pa.assigned
+                    and new_pa.assume_time == old.pa.assume_time):
+                return  # echo — nothing moved
+            # A restamp/confirm of an expired assumption changes whether a
+            # fresh sync would count its chips — only a real sync answers.
+            raise _DeltaUnappliable("expired assumption changed")
+        self._replace_in(dom.assignments, old.pa, new_pa)
+        self._replace_in(dom.on_unhealthy, old.pa, new_pa)
+        self._pod_index[key] = _PodRec(new_pa, old.sid, old.status, old.held)
+
+    def _remove_assignment(self, key, rec: _PodRec) -> None:
+        del self._pod_index[key]
+        dom = self.domains[rec.sid]
+        if rec.status == "expired":
+            self._remove_from(self.expired, rec.pa)
+            self._remove_from(dom.expired, rec.pa)
+            return
+        if not self._remove_from(dom.assignments, rec.pa):
+            raise _DeltaUnappliable("assignment record out of step")
+        self._remove_from(dom.on_unhealthy, rec.pa)
+        if rec.held:
+            dom.allocator.release(rec.held)
+            # Dead chips stay unplaceable even after their holder goes.
+            back = [c for c in rec.held if c in dom.unhealthy]
+            if back:
+                dom.allocator.mark_used(back)
+
+    def _add_assignment(self, pa: PodAssignment) -> None:
+        dom = self._dom_by_node[pa.node_name]
+        key = (pa.namespace, pa.pod_name)
+        if not pa.assigned and \
+                self._synced_at - pa.assume_time > self.assume_ttl_s:
+            # Already stale at this state's sync-time judgement: not
+            # occupancy, exactly as sync() would have filed it.
+            self.expired.append(pa)
+            dom.expired.append(pa)
+            self._pod_index[key] = _PodRec(pa, dom.slice_id, "expired", ())
+            return
+        try:
+            dom.allocator.mark_used(pa.chips)
+        except ValueError:
+            # Overlap, out-of-slice chip, or duplicate within the group —
+            # sync() files these as conflicts with order-dependent
+            # attribution; only a full re-sort reproduces that.
+            raise _DeltaUnappliable("chips not cleanly free") from None
+        dom.assignments.append(pa)
+        self._pod_index[key] = _PodRec(pa, dom.slice_id, "active",
+                                       tuple(pa.chips))
+
+    def _apply_node_event(self, etype: str, obj: dict) -> None:
+        md = obj.get("metadata", {})
+        name = md.get("name")
+        anns = md.get("annotations", {})
+        known = name in self._dom_by_node
+        if etype in ("ADDED", "DELETED"):
+            if not known and (ko.ANN_TOPOLOGY not in anns
+                              or ko.ANN_SLICE_ID not in anns):
+                return  # a non-TPU node joining/leaving changes nothing derived
+            raise _DeltaUnappliable("node set changed")
+        # MODIFIED: appliable iff the node's topology-shaped annotations are
+        # untouched and only the unhealthy-chip report moved.
+        if ko.ANN_TOPOLOGY not in anns or ko.ANN_SLICE_ID not in anns:
+            if known:
+                raise _DeltaUnappliable("node stopped being a TPU node")
+            return
+        if not known:
+            raise _DeltaUnappliable("node became a TPU node")
+        dom = self._dom_by_node[name]
+        if (anns[ko.ANN_SLICE_ID] != dom.slice_id
+                or parse_topology(anns[ko.ANN_TOPOLOGY]) != dom.topology):
+            raise _DeltaUnappliable("node topology changed")
+        if dom.host_by_node.get(name) != _host_coord_of(anns):
+            raise _DeltaUnappliable("host coordinate changed")
+        chips = list(_parse_chips_ann(anns.get(ko.ANN_CHIPS, "[]")))
+        if chips != dom.chips_by_node.get(name):
+            raise _DeltaUnappliable("node chip list changed")
+        node_unhealthy = _node_unhealthy_of(anns, dom.topology.chip_set)
+        if node_unhealthy == self._unhealthy_by_node.get(name, frozenset()):
+            return  # labels or other metadata — no derived impact
+        self._fold_unhealthy(dom, name, node_unhealthy)
+
+    def _fold_unhealthy(self, dom: SliceDomain, name: str,
+                        node_unhealthy: frozenset[Coord]) -> None:
+        """Apply one node's new unhealthy-chip report: dead chips enter the
+        used mask unless an assignment already accounts for them; chips
+        reported healthy again free up unless a live assignment holds them."""
+        per_node = dict(self._unhealthy_by_node)
+        if node_unhealthy:
+            per_node[name] = node_unhealthy
+        else:
+            per_node.pop(name, None)
+        self._unhealthy_by_node = per_node
+        union: set[Coord] = set()
+        for n in dom.host_by_node:
+            union |= per_node.get(n, frozenset())
+        held: set[Coord] = set()
+        for rec in self._pod_index.values():
+            if rec.sid == dom.slice_id and rec.status == "active":
+                held.update(rec.held)
+        alloc = dom.allocator
+        # Mask-native batch: newly-dead chips enter the used mask unless an
+        # assignment (or an overlapping prior report) already covers them;
+        # recovered chips leave it unless an assignment holds them
+        # (release of a not-used chip is a no-op by contract).
+        add = chips_mask(dom.topology,
+                         [c for c in union - dom.unhealthy
+                          if c not in held]) & alloc.free_mask
+        if add:
+            alloc.mark_used(alloc.chips_of_mask(add))
+        gone = [c for c in dom.unhealthy - union if c not in held]
+        if gone:
+            alloc.release(gone)
+        dom.unhealthy = union  # fresh set: the parent's is shared, not ours
+        dom.on_unhealthy = [pa for pa in dom.assignments
+                            if any(c in union for c in pa.chips)]
 
     # ---- views -------------------------------------------------------------
 
@@ -269,8 +549,19 @@ class ClusterState:
         dom = self._domain_of_node(node_name)
         if dom is None:
             return []
-        free = dom.allocator.free
-        return [c for c in dom.chips_by_node.get(node_name, []) if c in free]
+        # One AND against the precomputed node mask; coords come back in
+        # chip-index (== ascending coordinate) order.
+        return dom.allocator.chips_of_mask(
+            dom.node_masks.get(node_name, 0) & dom.allocator.free_mask)
+
+    def free_mask_on_node(self, node_name: str) -> int:
+        """Free chips on a node as a bitmask over its domain's chip index —
+        the mask-native form the sort hot loop feeds straight into
+        :meth:`Allocator.find` (no set round-trip)."""
+        dom = self._domain_of_node(node_name)
+        if dom is None:
+            return 0
+        return dom.node_masks.get(node_name, 0) & dom.allocator.free_mask
 
     def fragmentation_report(self) -> dict:
         """Observability: per-domain free/used and largest free box — the

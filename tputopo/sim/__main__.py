@@ -51,6 +51,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gc-period", type=float, default=30.0,
                    help="GC sweep period (virtual seconds)")
     p.add_argument("--out", default=None, help="also write the report here")
+    p.add_argument("--profile", action="store_true",
+                   help="run under cProfile and emit the top-25 "
+                        "cumulative-time entries to stderr (the report on "
+                        "stdout stays byte-identical)")
     return p
 
 
@@ -75,8 +79,24 @@ def main(argv: list[str] | None = None) -> int:
         node_failures=args.node_failures,
     )
     t0 = time.perf_counter()
-    report = run_trace(cfg, policies, assume_ttl_s=args.assume_ttl,
-                       gc_period_s=args.gc_period)
+    if args.profile:
+        # Profiling output is telemetry like the wall clock: stderr only,
+        # so a profiled report still diffs clean against an unprofiled one.
+        import cProfile
+        import io
+        import pstats
+
+        prof = cProfile.Profile()
+        prof.enable()
+        report = run_trace(cfg, policies, assume_ttl_s=args.assume_ttl,
+                           gc_period_s=args.gc_period)
+        prof.disable()
+        buf = io.StringIO()
+        pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(25)
+        print(buf.getvalue(), file=sys.stderr)
+    else:
+        report = run_trace(cfg, policies, assume_ttl_s=args.assume_ttl,
+                           gc_period_s=args.gc_period)
     wall_s = time.perf_counter() - t0
     text = json.dumps(report, indent=2, sort_keys=True)
     print(text)

@@ -262,8 +262,12 @@ class SimEngine:
         self.metrics.counts["arrived"] += 1
         run = _JobRun(spec, self.clock.t)
         self.jobs[spec.name] = run
-        self.api.create_many("pods", pods_for_job(spec))
-        self.policy.invalidate()
+        pods = pods_for_job(spec)
+        self.api.create_many("pods", pods)
+        # Arrivals are Pending pods — zero derived-state impact, so the
+        # policy folds them as deltas instead of rebuilding O(pods) state
+        # on the very next place() (the per-arrival rebuild storm).
+        self.policy.invalidate(events=[("pods", "ADDED", p) for p in pods])
         self.queue.append(run)
         self._try_schedule()
 
@@ -346,7 +350,17 @@ class SimEngine:
         self.metrics.gc["sweeps"] += 1
         self.metrics.gc["assumptions_released"] += len(released)
         if released:
-            self.policy.invalidate()  # the sweep wiped annotations
+            # The sweep wiped scheduling annotations: an assumption wipe is
+            # a MODIFIED whose object no longer carries a chip group — the
+            # policy releases exactly those chips without a rebuild.  The
+            # minimal object suffices: no group + no matching record means
+            # "this pod holds nothing now".
+            self.policy.invalidate(events=[
+                ("pods", "MODIFIED",
+                 {"metadata": {"name": r.split("/", 1)[1],
+                               "namespace": r.split("/", 1)[0]},
+                  "spec": {}})
+                for r in released])
         reclaimed = sorted({self._job_of_pod(r.split("/", 1)[1])
                             for r in released})
         for jname in reclaimed:
@@ -504,12 +518,17 @@ class SimEngine:
         self.capacity_epoch += 1
 
     def _delete_job_pods(self, spec: JobSpec) -> None:
+        events = []
         for m in range(spec.replicas):
+            name = f"{spec.name}-{m}"
             try:
-                self.api.delete("pods", f"{spec.name}-{m}", "default")
+                self.api.delete("pods", name, "default")
             except NotFound:
-                pass
-        self.policy.invalidate()
+                continue  # never created / already gone — no event either
+            events.append(("pods", "DELETED",
+                           {"metadata": {"name": name,
+                                         "namespace": "default"}}))
+        self.policy.invalidate(events=events)
 
     def _twin_mark(self, sid: str, chips) -> None:
         self.twin[sid].mark_used(chips)

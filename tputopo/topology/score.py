@@ -32,6 +32,8 @@ Model (documented so deployments can calibrate it):
 
 from __future__ import annotations
 
+from functools import lru_cache
+
 from tputopo.topology.cost import LinkCostModel
 from tputopo.topology.model import ChipTopology, Coord
 
@@ -47,6 +49,7 @@ def _axis_algbw(link_gbps: float, d: int, wrapped: bool) -> float:
     return link_gbps * n_dirs * _ring_factor(d)
 
 
+@lru_cache(maxsize=8192)
 def predict_allreduce_gbps(topo: ChipTopology, dims: tuple[int, ...],
                            cost: LinkCostModel | None = None,
                            wrap: tuple[bool, ...] | None = None) -> float:
@@ -54,6 +57,10 @@ def predict_allreduce_gbps(topo: ChipTopology, dims: tuple[int, ...],
 
     ``wrap`` marks which axes of the *box* have wraparound links; by default
     an axis wraps iff the box spans the host topology's full wrapped extent.
+
+    Memoized on its (hashable, frozen) arguments: the box search asks for
+    the same handful of (topology, shape) scores tens of thousands of times
+    per fleet-scale scheduling cycle.
     """
     cost = cost or LinkCostModel.for_generation(topo.generation.name)
     if wrap is None:
@@ -130,17 +137,35 @@ def _internal_degree(topo: ChipTopology, chips: frozenset[Coord], c: Coord) -> i
     return sum(1 for n in topo.neighbors(c) if n in chips)
 
 
+@lru_cache(maxsize=16384)
+def _host_count(topo: ChipTopology, chips: frozenset[Coord]) -> int:
+    """Distinct hosts a chip set touches — the DCN attachment width the
+    multislice scorer reads per candidate split (memoized: the composition
+    search re-asks for the same sets)."""
+    return len({topo.host_of(c) for c in chips})
+
+
 def score_chip_set(topo: ChipTopology, chips: frozenset[Coord] | set[Coord],
                    cost: LinkCostModel | None = None) -> float:
     """Score an arbitrary chip set within one ICI domain: predicted all-reduce
     GB/s (higher is better).  A single chip scores 0.0 — no collective runs,
     and k=1 placement is decided by the anti-fragmentation policy instead
-    (the analog of Gaia's Singular scheduler, Gaia PDF Alg. 3)."""
+    (the analog of Gaia's Singular scheduler, Gaia PDF Alg. 3).
+
+    Memoized (a pure function of frozen arguments): the blob fallback and
+    the multislice composition search re-score the same candidate sets many
+    times per scheduling cycle."""
     chips = frozenset(chips)
     cost = cost or LinkCostModel.for_generation(topo.generation.name)
-    n = len(chips)
-    if n == 0:
+    if len(chips) == 0:
         raise ValueError("empty chip set")
+    return _score_chip_set_cached(topo, chips, cost)
+
+
+@lru_cache(maxsize=16384)
+def _score_chip_set_cached(topo: ChipTopology, chips: frozenset[Coord],
+                           cost: LinkCostModel) -> float:
+    n = len(chips)
     if n == 1:
         return 0.0
 
@@ -189,7 +214,7 @@ def predict_multidomain_allreduce_gbps(
         return score_chip_set(topo, chips, cost)
     d = len(domains)
     per_chip_dcn = min(
-        cost.dcn_host_gbps * len({t.host_of(c) for c in chips}) / len(chips)
+        cost.dcn_host_gbps * _host_count(t, chips) / len(chips)
         for t, chips in domains
         if chips
     )
