@@ -454,6 +454,11 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0) -> dict:
         "chips": report["trace"]["chips"],
         "arrivals": arrivals,
         "virtual_horizon_s": report["virtual_horizon_s"],
+        # Wall-clock throughput of the replay itself — the standing figure
+        # perf PRs move (the A/B deltas below are what POLICY PRs move).
+        "wall_s": report["throughput"]["wall_s"],
+        "events": report["throughput"]["events"],
+        "events_per_s": report["throughput"]["events_per_s"],
         "ab_deltas": deltas,
     }
     for name in ("ici", "naive"):

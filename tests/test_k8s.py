@@ -91,3 +91,105 @@ def test_concurrent_patches_are_serialized():
     assert not errs
     anns = api.get("pods", "p0", "default")["metadata"]["annotations"]
     assert len(anns) == 200
+
+
+# ---- copy-free reads: get_nocopy / handles / the mutation guard -------------
+
+
+def test_get_nocopy_returns_stored_object():
+    api = FakeApiServer()
+    api.create("pods", make_pod("p0", chips=2))
+    a = api.get_nocopy("pods", "p0", "default")
+    b = api.get_nocopy("pods", "p0", "default")
+    assert a is b  # no copy: the stored dict itself
+    with pytest.raises(NotFound):
+        api.get_nocopy("pods", "nope", "default")
+    # A server-side patch is visible through the same reference (stored
+    # dicts are mutated in place) — part of the documented contract.
+    api.patch_annotations("pods", "p0", {"k": "v"}, "default")
+    assert a["metadata"]["annotations"]["k"] == "v"
+
+
+def test_object_handle_survives_patch_and_recreate():
+    """The handle is keyed, not identity-bound: it tracks the object
+    through in-place patches AND through a delete/recreate cycle (the sim's
+    requeued-job case), raising NotFound only while the object is gone."""
+    api = FakeApiServer()
+    api.create("pods", make_pod("p0", chips=1))
+    h = api.handle("pods", "p0", "default")
+    assert h.fetch()["metadata"]["name"] == "p0"
+    api.patch_annotations("pods", "p0", {"a": "1"}, "default")
+    assert h.fetch()["metadata"]["annotations"]["a"] == "1"
+    api.delete("pods", "p0", "default")
+    with pytest.raises(NotFound):
+        h.fetch()
+    api.create("pods", make_pod("p0", chips=1))
+    fresh = h.fetch()
+    assert fresh["metadata"].get("annotations", {}).get("a") is None
+    assert fresh is api.get_nocopy("pods", "p0", "default")
+
+
+def test_nocopy_guard_catches_caller_mutation():
+    """Satellite: the debug-mode digest guard must catch a get_nocopy
+    caller breaking the read-only contract — content changed while the
+    resourceVersion did not move (the server's own writes always bump)."""
+    api = FakeApiServer()
+    api.nocopy_guard = True
+    api.create("pods", make_pod("p0", chips=1))
+    pod = api.get_nocopy("pods", "p0", "default")
+    # Legitimate traffic never trips it: repeat reads, server writes.
+    api.get_nocopy("pods", "p0", "default")
+    api.patch_annotations("pods", "p0", {"ok": "1"}, "default")
+    api.verify_nocopy_digests()
+    pod = api.get_nocopy("pods", "p0", "default")
+    pod["spec"]["illegal"] = True  # the contract violation
+    with pytest.raises(RuntimeError, match="nocopy contract violation"):
+        api.get_nocopy("pods", "p0", "default")
+
+
+def test_nocopy_guard_checks_before_server_writes():
+    """A violation must also surface at the next server-side write to the
+    object (and via verify_nocopy_digests), not only at the next read —
+    otherwise a mutate-then-patch sequence would launder the mutation into
+    a legitimate-looking version bump."""
+    api = FakeApiServer()
+    api.nocopy_guard = True
+    api.create("pods", make_pod("p0", chips=1))
+    api.get_nocopy("pods", "p0", "default")["status"]["phase"] = "Hacked"
+    with pytest.raises(RuntimeError, match="nocopy contract violation"):
+        api.verify_nocopy_digests()
+    with pytest.raises(RuntimeError, match="nocopy contract violation"):
+        api.patch_annotations("pods", "p0", {"k": "v"}, "default")
+
+
+def test_create_echo_optout_copy_count(monkeypatch):
+    """Satellite: create() historically deep-copied twice per object on
+    top of the watch-log emit copy; echo=False must skip exactly the echo
+    deepcopy and return a metadata-only stub."""
+    import copy as copymod
+
+    real = copymod.deepcopy
+    calls = {"n": 0}
+
+    def counting(x, memo=None, _nil=[]):
+        calls["n"] += 1
+        return real(x, memo)
+
+    monkeypatch.setattr(copymod, "deepcopy", counting)
+    api = FakeApiServer()
+    calls["n"] = 0
+    echoed = api.create("pods", make_pod("p0", chips=1))
+    with_echo = calls["n"]
+    calls["n"] = 0
+    stub = api.create("pods", make_pod("p1", chips=1), echo=False)
+    without_echo = calls["n"]
+    assert without_echo == with_echo - 1  # exactly the echo copy gone
+    assert without_echo == 2  # store copy + watch-log emit copy remain
+    # The stub still answers the questions a creator has.
+    assert stub["metadata"]["name"] == "p1"
+    assert stub["metadata"]["namespace"] == "default"
+    assert stub["metadata"]["resourceVersion"] == \
+        api.get("pods", "p1", "default")["metadata"]["resourceVersion"]
+    # The full echo stays an independent deep copy.
+    echoed["spec"]["mutated"] = True
+    assert "mutated" not in api.get("pods", "p0", "default")["spec"]

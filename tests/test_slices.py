@@ -284,3 +284,64 @@ def test_free_cache_tracks_mutations():
     b.mark_used([(0, 0, 0)])
     assert (0, 0, 0) in a.free and (0, 0, 0) not in b.free, \
         "clone must not share occupancy with its source"
+
+
+def test_incremental_largest_free_box_matches_scan_oracle():
+    """Satellite: the incremental largest-free-box index (witness box +
+    rank-bounded rescan) must equal the windowed-cumsum oracle after EVERY
+    step of randomized mark/release sequences, on wrapped, partially
+    wrapped, and open toruses (seam-crossing boxes included)."""
+    import random
+
+    cases = [
+        ("v5p", (4, 4, 4), None),                    # fully wrapped torus
+        ("v5p", (2, 2, 4), None),                    # partially wrapped
+        ("v5p", (4, 4, 4), (False, False, False)),   # open box
+        ("v5e", (8, 4), (True, False)),              # mixed-wrap 2D
+    ]
+    for gen, dims, wrap in cases:
+        topo = ChipTopology.build(gen, dims, wrap)
+        alloc = Allocator(topo)
+        rng = random.Random(42)
+        for step in range(200):
+            free, used = list(alloc.free), list(alloc.used)
+            if used and (not free or rng.random() < 0.45):
+                alloc.release(rng.sample(
+                    used, rng.randrange(1, min(6, len(used)) + 1)))
+            else:
+                alloc.mark_used(rng.sample(
+                    free, rng.randrange(1, min(6, len(free)) + 1)))
+            got = alloc.largest_free_box()
+            want = alloc.largest_free_box_scan()
+            assert got == want, (gen, dims, wrap, step, got, want)
+
+
+def test_largest_free_box_seam_crossing_incremental():
+    """A free region that only forms a box ACROSS the wrap seam: both the
+    incremental index and the oracle must see the 4x4 box spanning
+    x in {6,7,0,1}."""
+    topo = ChipTopology.build("v5e", (8, 4), (True, False))
+    alloc = Allocator(topo)
+    alloc.mark_used([c for c in topo.chips if 2 <= c[0] <= 5])
+    got = alloc.largest_free_box()
+    assert got == alloc.largest_free_box_scan()
+    assert got is not None and got[0] == 16  # the seam-crossing 4x4
+    # Releasing one strip grows the box incrementally (release path).
+    alloc.release([c for c in topo.chips if c[0] == 2])
+    got = alloc.largest_free_box()
+    assert got == alloc.largest_free_box_scan()
+    assert got[0] == 20
+
+
+def test_largest_free_box_incremental_survives_clone():
+    """clone() shares the index snapshot; diverging the clone's occupancy
+    must not corrupt either side's metric."""
+    topo = ChipTopology.build("v5p", (2, 2, 4))
+    a = Allocator(topo)
+    a.mark_used(list(topo.chips)[:4])
+    assert a.largest_free_box() == a.largest_free_box_scan()
+    b = a.clone()
+    b.mark_used(list(b.free)[:3])
+    assert b.largest_free_box() == b.largest_free_box_scan()
+    a.release(list(a.used)[:2])
+    assert a.largest_free_box() == a.largest_free_box_scan()

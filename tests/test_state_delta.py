@@ -299,8 +299,8 @@ def _ici_run(force_full_rebuild: bool):
     stream = []
     place = engine.policy.place
 
-    def recording_place(job, nodes):
-        out = place(job, nodes)
+    def recording_place(job, nodes, handles=None):
+        out = place(job, nodes, handles=handles)
         stream.append((job.name, json.dumps(out, sort_keys=True, default=str)))
         return out
 

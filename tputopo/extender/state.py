@@ -565,14 +565,18 @@ class ClusterState:
 
     def fragmentation_report(self) -> dict:
         """Observability: per-domain free/used and largest free box — the
-        analog of Gaia's fragment-node bookkeeping (PDF §III.B)."""
+        analog of Gaia's fragment-node bookkeeping (PDF §III.B).  Served
+        per /state hit: counts are popcounts and largest_free_box runs off
+        the allocator's incremental index (clones share it, so a derived
+        state inherits the last computed witness), not a fresh windowed
+        scan per request."""
         out = {}
         for sid, dom in self.domains.items():
             largest = dom.allocator.largest_free_box()
             out[sid] = {
                 "topology": dom.topology.describe(),
-                "free_chips": len(dom.allocator.free),
-                "used_chips": len(dom.allocator.used),
+                "free_chips": dom.allocator.free_count,
+                "used_chips": dom.allocator.used_count,
                 "largest_free_box": list(largest[1]) if largest else None,
                 "expired_assumptions": len(dom.expired),
                 "conflicting_assignments": [

@@ -15,6 +15,8 @@ SURVEY.md §3.3 note).
 from __future__ import annotations
 
 import copy
+import hashlib
+import json
 import threading
 import time
 from typing import Any, Callable, Iterable
@@ -58,6 +60,43 @@ def matches_labels(obj: dict, sel: dict[str, str]) -> bool:
 _WATCH_WINDOW = 2048  # retained events; older watch rvs get Gone (410)
 
 
+def _digest(obj: dict) -> str:
+    """Content digest for the nocopy mutation guard (order-insensitive)."""
+    return hashlib.sha256(
+        json.dumps(obj, sort_keys=True, default=repr).encode()).hexdigest()
+
+
+class ObjectHandle:
+    """A stable, copy-free reference to one stored object.
+
+    Keyed by (kind, namespace, name), never by dict identity: the handle
+    survives annotation patches (the server mutates the stored dict in
+    place) AND delete/recreate cycles (a fresh dict under the same key —
+    e.g. a requeued sim job's recreated pods).  :meth:`fetch` is the
+    handle-based variant of :meth:`FakeApiServer.get_nocopy` and carries
+    the same contract: single-threaded readers only, NEVER mutate the
+    result.  The sim engine holds one per gang member so its confirm /
+    reset-path reads stop paying a deepcopy per pod per event."""
+
+    __slots__ = ("_api", "kind", "name", "namespace")
+
+    def __init__(self, api: "FakeApiServer", kind: str, name: str,
+                 namespace: str | None = None) -> None:
+        self._api = api
+        self.kind = kind
+        self.name = name
+        self.namespace = namespace
+
+    def fetch(self) -> dict:
+        """The current stored object (no copy); raises NotFound when the
+        object does not exist right now."""
+        return self._api.get_nocopy(self.kind, self.name, self.namespace)
+
+    def __repr__(self) -> str:  # observability only
+        return (f"ObjectHandle({self.kind}, "
+                f"{self.namespace or ''}/{self.name})")
+
+
 class FakeApiServer:
     def __init__(self) -> None:
         self._lock = threading.RLock()
@@ -72,6 +111,49 @@ class FakeApiServer:
         # "kind": ..., "rv": int, "object": deepcopy-at-emit}.
         self._watch_log: list[dict] = []
         self._watch_cond = threading.Condition(self._lock)
+        # Nocopy mutation guard (debug mode, off by default): when enabled,
+        # every nocopy read records (resourceVersion, content digest); a
+        # later read or server write that finds the content changed at an
+        # UNCHANGED resourceVersion can only mean a nocopy caller broke the
+        # read-only contract — the server's own writes always bump the rv.
+        self.nocopy_guard = False
+        self._nocopy_digests: dict[tuple[str, str, str], tuple[str, str]] = {}
+
+    # ---- nocopy mutation guard --------------------------------------------
+
+    def _guard_key(self, kind: str, obj: dict) -> tuple[str, str, str]:
+        md = obj["metadata"]
+        return (kind, md.get("namespace") or "", md["name"])
+
+    def _guard_check(self, kind: str, obj: dict) -> None:
+        """Verify a stored object against its recorded nocopy digest.
+        Called before every server-side mutation and on every nocopy read
+        (guard mode only) — the moment an illegal caller mutation becomes
+        detectable."""
+        rec = self._nocopy_digests.get(self._guard_key(kind, obj))
+        if rec is None:
+            return
+        rv = obj["metadata"].get("resourceVersion")
+        if rec[0] == rv and rec[1] != _digest(obj):
+            raise RuntimeError(
+                f"nocopy contract violation: {kind} "
+                f"{obj['metadata'].get('namespace')}/{obj['metadata']['name']}"
+                f" changed content at unmoved resourceVersion {rv} — a "
+                "get_nocopy/list_nocopy caller mutated a stored object")
+
+    def _guard_record(self, kind: str, obj: dict) -> None:
+        self._nocopy_digests[self._guard_key(kind, obj)] = (
+            obj["metadata"].get("resourceVersion"), _digest(obj))
+
+    def verify_nocopy_digests(self) -> None:
+        """Check every object a nocopy reader has seen (guard mode): any
+        content drift at an unmoved resourceVersion raises.  Tests call
+        this after driving a whole flow through the nocopy read paths."""
+        with self._lock:
+            for (kind, ns, name), _ in list(self._nocopy_digests.items()):
+                obj = self._store(kind).get((ns, name))
+                if obj is not None:
+                    self._guard_check(kind, obj)
 
     # ---- helpers ----------------------------------------------------------
 
@@ -90,7 +172,16 @@ class FakeApiServer:
 
     # ---- CRUD -------------------------------------------------------------
 
-    def create(self, kind: str, obj: dict) -> dict:
+    def create(self, kind: str, obj: dict, *, echo: bool = True) -> dict:
+        """Store a deep copy of ``obj`` (callers keep ownership of their
+        input) and return the created object.
+
+        ``echo=True`` (default, the K8s REST shape) returns an independent
+        deep copy the caller may mutate freely — historically a SECOND full
+        deepcopy per create on top of the store copy.  Callers that only
+        need the identity/version of what they just created pass
+        ``echo=False`` and get a metadata-only stub ({name, namespace,
+        resourceVersion}) built without copying the object at all."""
         with self._lock:
             md = obj["metadata"]
             k = _key(md.get("namespace"), md["name"])
@@ -101,7 +192,13 @@ class FakeApiServer:
             self._bump(copy_)
             store[k] = copy_
             self._emit("ADDED", kind, copy_)
-            return copy.deepcopy(copy_)
+            if echo:
+                return copy.deepcopy(copy_)
+            return {"metadata": {
+                "name": md["name"],
+                "namespace": md.get("namespace"),
+                "resourceVersion": copy_["metadata"]["resourceVersion"],
+            }}
 
     def create_many(self, kind: str, objs: Iterable[dict]) -> int:
         """Bulk staging: create ``objs`` under ONE lock acquisition and
@@ -137,6 +234,35 @@ class FakeApiServer:
             except KeyError:
                 raise NotFound(f"{kind} {namespace}/{name}") from None
 
+    def get_nocopy(self, kind: str, name: str,
+                   namespace: str | None = None) -> dict:
+        """Get WITHOUT deepcopying the stored object.
+
+        Same contract as :meth:`list_nocopy`: strictly for single-threaded
+        read-only consumers (the sim engine's confirm path and policy
+        place() re-fetched every member pod per event, and the deepcopy
+        chain behind :meth:`get` was ~30% of sim wall).  Callers MUST NOT
+        mutate the returned dict; concurrent writers make the view racy
+        (annotation patches mutate stored dicts in place).  The threaded
+        extender stack keeps using :meth:`get`."""
+        with self._lock:
+            try:
+                obj = self._store(kind)[_key(namespace, name)]
+            except KeyError:
+                raise NotFound(f"{kind} {namespace}/{name}") from None
+            if self.nocopy_guard:
+                self._guard_check(kind, obj)
+                self._guard_record(kind, obj)
+            return obj
+
+    def handle(self, kind: str, name: str,
+               namespace: str | None = None) -> ObjectHandle:
+        """A key-stable :class:`ObjectHandle` for repeated nocopy reads of
+        one object (the handle-based ``get_nocopy`` variant).  The object
+        need not exist yet — :meth:`ObjectHandle.fetch` resolves the key
+        at read time."""
+        return ObjectHandle(self, kind, name, namespace)
+
     def list(self, kind: str, selector: Callable[[dict], bool] | None = None,
              label_selector: dict[str, str] | None = None) -> list[dict]:
         with self._lock:
@@ -161,6 +287,10 @@ class FakeApiServer:
         :meth:`list`."""
         with self._lock:
             out = list(self._store(kind).values())
+            if self.nocopy_guard:
+                for o in out:
+                    self._guard_check(kind, o)
+                    self._guard_record(kind, o)
         if selector:
             out = [o for o in out if selector(o)]
         return sorted(out, key=lambda o: (o["metadata"].get("namespace", ""),
@@ -219,6 +349,9 @@ class FakeApiServer:
                 obj = self._store(kind).pop(_key(namespace, name))
             except KeyError:
                 raise NotFound(f"{kind} {namespace}/{name}") from None
+            if self.nocopy_guard:
+                self._guard_check(kind, obj)
+                self._nocopy_digests.pop(self._guard_key(kind, obj), None)
             # _bump (not a bare rv increment): the event's object must carry
             # the delete's own resourceVersion — the REST watch leg derives
             # its progress from object metadata, and a stale rv there makes
@@ -241,6 +374,8 @@ class FakeApiServer:
                 obj = self._store(kind)[_key(namespace, name)]
             except KeyError:
                 raise NotFound(f"{kind} {namespace}/{name}") from None
+            if self.nocopy_guard:
+                self._guard_check(kind, obj)
             if expect_version is not None and \
                     obj["metadata"].get("resourceVersion") != expect_version:
                 raise Conflict(
@@ -266,6 +401,8 @@ class FakeApiServer:
                 obj = self._store(kind)[_key(namespace, name)]
             except KeyError:
                 raise NotFound(f"{kind} {namespace}/{name}") from None
+            if self.nocopy_guard:
+                self._guard_check(kind, obj)
             labels = obj["metadata"].setdefault("labels", {})
             for k, v in patch.items():
                 if v is None:
@@ -284,6 +421,8 @@ class FakeApiServer:
                 pod = self._store("pods")[_key(namespace, name)]
             except KeyError:
                 raise NotFound(f"pod {namespace}/{name}") from None
+            if self.nocopy_guard:
+                self._guard_check("pods", pod)
             if pod["spec"].get("nodeName"):
                 raise Conflict(f"pod {name} already bound to {pod['spec']['nodeName']}")
             pod["spec"]["nodeName"] = node_name
@@ -300,4 +439,4 @@ class FakeApiServer:
 
     def add_nodes(self, nodes: Iterable[dict]) -> None:
         for n in nodes:
-            self.create("nodes", n)
+            self.create("nodes", n, echo=False)  # nobody reads the echo

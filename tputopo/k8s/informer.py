@@ -300,3 +300,19 @@ class Informer:
             except KeyError:
                 pass
         raise NotFound(f"{kind} {namespace}/{name} (informer cache)")
+
+    def get_nocopy(self, kind: str, name: str,
+                   namespace: str | None = None) -> dict:
+        """Get WITHOUT deepcopying the mirrored object — the same
+        single-threaded/read-only contract as ``list(copy=False)`` and
+        :meth:`FakeApiServer.get_nocopy`.  Mirror entries are replaced
+        wholesale (never mutated in place), so the returned dict is a
+        consistent snapshot of the object at its resourceVersion; callers
+        MUST NOT mutate it.  The threaded extender verbs keep using
+        :meth:`get`."""
+        with self._lock:
+            try:
+                return self._store[kind][(namespace or "", name)]
+            except KeyError:
+                pass
+        raise NotFound(f"{kind} {namespace}/{name} (informer cache)")

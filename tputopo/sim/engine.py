@@ -20,6 +20,7 @@ A/B across policies and assembles the report.
 from __future__ import annotations
 
 import heapq
+import time
 
 from tputopo.deviceplugin.reporter import node_object_for_probe
 from tputopo.discovery.shim import _probe_python, _to_host_probe
@@ -54,9 +55,12 @@ class VirtualClock:
 class _CopyFreeApi:
     """Read-optimized facade over the sim's FakeApiServer: ``list`` honors
     the ``copy=False`` hint ClusterState/_gang_members already send (via
-    :meth:`FakeApiServer.list_nocopy`), writes delegate untouched.  Only
-    valid because the engine is strictly single-threaded — see
-    list_nocopy's contract."""
+    :meth:`FakeApiServer.list_nocopy`) and ``get`` serves the stored
+    object via :meth:`FakeApiServer.get_nocopy` — the scheduler/policy/GC
+    stack only ever READS the pods it fetches, and the per-call deepcopy
+    chain behind ``get`` was ~30% of sim wall clock.  Writes delegate
+    untouched.  Only valid because the engine is strictly single-threaded
+    — see the nocopy contract on FakeApiServer."""
 
     def __init__(self, api: FakeApiServer) -> None:
         self._api = api
@@ -70,12 +74,15 @@ class _CopyFreeApi:
             return self._api.list_nocopy(kind, selector)
         return self._api.list(kind, selector, label_selector)
 
+    def get(self, kind, name, namespace=None):
+        return self._api.get_nocopy(kind, name, namespace)
+
 
 class _JobRun:
     """Mutable per-job lifecycle state (the trace JobSpec stays frozen)."""
 
     __slots__ = ("spec", "enqueued_t", "incarnation", "chips_held",
-                 "failed_epoch")
+                 "failed_epoch", "handles")
 
     def __init__(self, spec: JobSpec, enqueued_t: float) -> None:
         self.spec = spec
@@ -83,6 +90,9 @@ class _JobRun:
         self.incarnation = 0
         self.chips_held: list[tuple[str, tuple]] = []  # (slice_id, chip)
         self.failed_epoch = -1  # capacity epoch of the last failed attempt
+        # Copy-free pod handles, one per member: key-stable, so they
+        # survive the delete/recreate of a requeued incarnation.
+        self.handles: list = []
 
 
 def stage_nodes(cfg: TraceConfig) -> tuple[FakeApiServer, list[dict], dict]:
@@ -170,6 +180,7 @@ class SimEngine:
         self._seq = 0
         self._gc_pending = False
         self.horizon_s = 0.0
+        self.events_processed = 0  # heap pops — the throughput denominator
 
     # ---- event plumbing ----------------------------------------------------
 
@@ -198,11 +209,23 @@ class SimEngine:
         """Report over ``horizon_s`` (>= this run's own horizon): the
         occupancy step functions are extended at their final values so
         the integrals cover the full window."""
-        if horizon_s > self.clock.t:
-            self.clock.t = horizon_s
-            self._sample_occupancy()
-        return self.metrics.report(max(horizon_s, self.horizon_s),
-                                   self.policy.counters())
+        return finalize_run_state(self.run_state(), horizon_s)
+
+    def run_state(self) -> "RunState":
+        """This finished run, reduced to the picklable facts finalize
+        needs — what a ``run_trace(jobs=N)`` worker process ships back
+        instead of the engine (whose API server holds thread primitives).
+        Call after :meth:`run_events`."""
+        return RunState(
+            policy_name=self.policy.name,
+            horizon_s=self.horizon_s,
+            end_t=self.clock.t,
+            metrics=self.metrics,
+            placed_chips=self.placed_chips,
+            frag=[self._frag_cache[sid] for sid in sorted(self._frag_cache)],
+            counters=self.policy.counters(),
+            events_processed=self.events_processed,
+        )
 
     def run_events(self) -> None:
         for job in self.trace.jobs:
@@ -215,6 +238,7 @@ class SimEngine:
         self._sample_occupancy()  # t=0 anchor for the time-weighted means
         while self._heap:
             t, kind, _, payload = heapq.heappop(self._heap)
+            self.events_processed += 1
             self.clock.t = max(self.clock.t, t)
             self.horizon_s = max(self.horizon_s, self.clock.t)
             if kind == self._ARRIVAL:
@@ -261,6 +285,8 @@ class SimEngine:
     def _on_arrival(self, spec: JobSpec) -> None:
         self.metrics.counts["arrived"] += 1
         run = _JobRun(spec, self.clock.t)
+        run.handles = [self.api.handle("pods", f"{spec.name}-{m}", "default")
+                       for m in range(spec.replicas)]
         self.jobs[spec.name] = run
         pods = pods_for_job(spec)
         self.api.create_many("pods", pods)
@@ -328,7 +354,7 @@ class SimEngine:
         if name not in self.failed_nodes:
             return
         self.failed_nodes.discard(name)
-        self.api.create("nodes", self._node_obj_by_name[name])
+        self.api.create("nodes", self._node_obj_by_name[name], echo=False)
         self.policy.invalidate()
         self._twin_release(self.domain_of_node[name],
                            self._blocked.pop(name, []))
@@ -410,7 +436,8 @@ class SimEngine:
             if (failures >= self.max_backfill_failures
                     or run.failed_epoch == self.capacity_epoch):
                 continue
-            decisions = self.policy.place(run.spec, alive)
+            decisions = self.policy.place(run.spec, alive,
+                                          handles=run.handles)
             if decisions is None:
                 if run.spec.replicas > 1:
                     self._reset_if_partially_bound(run)
@@ -427,11 +454,13 @@ class SimEngine:
     def _reset_if_partially_bound(self, run: _JobRun) -> None:
         """Defensive: a policy returning None must leave no member bound;
         if one slipped through (released-then-aborted gang), recreate the
-        job's pods so the next attempt starts clean."""
+        job's pods so the next attempt starts clean.  Reads go through the
+        per-job nocopy handles — this check runs once per failed gang
+        attempt and used to deepcopy every member pod each time."""
         bound = False
-        for m in range(run.spec.replicas):
+        for h in run.handles:
             try:
-                pod = self.api.get("pods", f"{run.spec.name}-{m}", "default")
+                pod = h.fetch()
             except NotFound:
                 bound = True  # missing pod also warrants a rebuild
                 break
@@ -539,34 +568,101 @@ class SimEngine:
         self._frag_dirty.add(sid)
 
     def _sample_occupancy(self) -> None:
-        # largest_free_box is the costly part (a windowed scan per domain);
-        # cache it per domain until that domain's twin occupancy changes —
-        # most events touch one domain but sample all of them.
+        # largest_free_box maintains its own incremental index (witness box
+        # + rank-bounded rescan); the per-domain dirty set still skips the
+        # untouched domains entirely — most events touch one domain but
+        # sample all of them.
         for sid in self._frag_dirty:
             twin = self.twin[sid]
             largest = twin.largest_free_box()
-            self._frag_cache[sid] = (len(twin.free),
+            self._frag_cache[sid] = (twin.free_count,
                                      largest[0] if largest else 0)
         self._frag_dirty.clear()
         frag = [self._frag_cache[sid] for sid in sorted(self._frag_cache)]
         self.metrics.occupancy(self.clock.t, self.placed_chips, frag)
 
 
+class RunState:
+    """One policy run's finalizable facts (see SimEngine.run_state)."""
+
+    __slots__ = ("policy_name", "horizon_s", "end_t", "metrics",
+                 "placed_chips", "frag", "counters", "events_processed")
+
+    def __init__(self, *, policy_name, horizon_s, end_t, metrics,
+                 placed_chips, frag, counters, events_processed) -> None:
+        self.policy_name = policy_name
+        self.horizon_s = horizon_s
+        self.end_t = end_t
+        self.metrics = metrics
+        self.placed_chips = placed_chips
+        self.frag = frag
+        self.counters = counters
+        self.events_processed = events_processed
+
+
+def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
+    """Build one policy's report over ``horizon_s`` (>= the run's own
+    horizon), extending the occupancy step functions at their final values
+    so the time-weighted integrals cover the shared window.  The ONE
+    finalization path — sequential and process-parallel run_trace both go
+    through it, which is what keeps their reports byte-identical."""
+    if horizon_s > rs.end_t:
+        rs.metrics.occupancy(horizon_s, rs.placed_chips, rs.frag)
+    return rs.metrics.report(max(horizon_s, rs.horizon_s), rs.counters)
+
+
+def _run_policy_worker(args) -> RunState:
+    """One (trace config, policy) replay — the run_trace(jobs=N) work
+    unit.  Regenerates the trace from the config (deterministic per seed,
+    pinned by tests) so nothing heavyweight crosses the process boundary
+    in either direction."""
+    cfg, name, assume_ttl_s, gc_period_s = args
+    engine = SimEngine(generate_trace(cfg), name,
+                       assume_ttl_s=assume_ttl_s, gc_period_s=gc_period_s)
+    engine.run_events()
+    return engine.run_state()
+
+
 def run_trace(cfg: TraceConfig, policy_names: list[str], *,
-              assume_ttl_s: float = 60.0, gc_period_s: float = 30.0) -> dict:
+              assume_ttl_s: float = 60.0, gc_period_s: float = 30.0,
+              jobs: int = 1) -> dict:
     """Replay one deterministic trace under each policy and build the
-    A/B report.  Every policy sees the identical event stream."""
-    trace = generate_trace(cfg)
-    engines: list[tuple[str, SimEngine]] = []
-    for name in policy_names:
-        engine = SimEngine(trace, name, assume_ttl_s=assume_ttl_s,
-                           gc_period_s=gc_period_s)
-        engine.run_events()
-        engines.append((name, engine))
+    A/B report.  Every policy sees the identical event stream.
+
+    ``jobs > 1`` replays the policies in parallel worker PROCESSES (each
+    engine run is independent until the shared-horizon finalization) — the
+    report stays byte-identical to the sequential run because every run is
+    deterministic per (seed, config, policy) and finalization is the same
+    code path; only the ``throughput`` wall-clock block (telemetry,
+    excluded from the determinism contract) differs."""
+    t0 = time.perf_counter()
+    work = [(cfg, name, assume_ttl_s, gc_period_s) for name in policy_names]
+    if jobs > 1 and len(work) > 1:
+        import multiprocessing as mp
+
+        # Platform-default start method on purpose: Linux forks (fast, no
+        # re-import), macOS spawns (fork there crashes in ObjC/Accelerate —
+        # the reason CPython switched its default).  Workers are
+        # self-contained either way, so the report bytes do not depend on
+        # the method.
+        with mp.get_context().Pool(min(jobs, len(work))) as pool:
+            states = pool.map(_run_policy_worker, work)
+    else:
+        states = [_run_policy_worker(w) for w in work]
     # All policies report over the SAME horizon (the slowest run's end),
     # so time-weighted means in the A/B deltas share one denominator.
-    horizon = max(e.horizon_s for _, e in engines)
-    policies = {name: e.finalize(horizon) for name, e in engines}
+    horizon = max(rs.horizon_s for rs in states)
+    policies = {rs.policy_name: finalize_run_state(rs, horizon)
+                for rs in states}
+    wall_s = time.perf_counter() - t0
+    events = sum(rs.events_processed for rs in states)
     return build_report(cfg.describe(), horizon, policies,
                         engine_params={"assume_ttl_s": assume_ttl_s,
-                                       "gc_period_s": gc_period_s})
+                                       "gc_period_s": gc_period_s},
+                        throughput={
+                            "events": events,  # deterministic
+                            "wall_s": round(wall_s, 3),
+                            "events_per_s": round(events / wall_s, 1)
+                            if wall_s > 0 else 0.0,
+                            "jobs": min(jobs, len(work)) if jobs > 1 else 1,
+                        })

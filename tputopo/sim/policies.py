@@ -55,6 +55,11 @@ class PlacementPolicy:
     dicts — ``{"pod", "node", "slice", "chips", "predicted_gbps",
     "contiguous"}`` — or None when the job does not fit *right now*
     (the engine re-queues it).  A None MUST leave no member bound.
+
+    ``handles`` (optional) are the engine's per-member nocopy pod handles
+    (:meth:`FakeApiServer.handle`, one per replica in member order): a
+    policy that needs the member pod objects reads them copy-free instead
+    of paying a deepcopy per member per attempt.
     """
 
     name = "abstract"
@@ -64,7 +69,8 @@ class PlacementPolicy:
         self.clock = clock
         self.assume_ttl_s = assume_ttl_s
 
-    def place(self, job: JobSpec, node_names: list[str]) -> list[dict] | None:
+    def place(self, job: JobSpec, node_names: list[str],
+              handles: list | None = None) -> list[dict] | None:
         raise NotImplementedError
 
     def invalidate(self, events=None) -> None:
@@ -106,11 +112,16 @@ class IciAwarePolicy(PlacementPolicy):
         else:
             self.sched.invalidate_cached_state()
 
-    def place(self, job: JobSpec, node_names: list[str]) -> list[dict] | None:
+    def place(self, job: JobSpec, node_names: list[str],
+              handles: list | None = None) -> list[dict] | None:
         decisions = []
         for m in range(job.replicas):
             pod_name = f"{job.name}-{m}"
-            pod = self.api.get("pods", pod_name, "default")
+            # Copy-free member read: the engine's key-stable handle when
+            # given, else the facade's get (itself nocopy in the sim).
+            # sort() only READS the pod — the nocopy contract holds.
+            pod = (handles[m].fetch() if handles is not None
+                   else self.api.get("pods", pod_name, "default"))
             scores = self.sched.sort(pod, node_names)
             # scores is empty when every node is failed (alive == []).
             best = (max(scores, key=lambda s: (s["Score"], s["Host"]))
@@ -178,7 +189,8 @@ class BaselinePolicy(PlacementPolicy):
         # of keeping their decision stream bit-stable across PRs.
         self._cached_state = None
 
-    def place(self, job: JobSpec, node_names: list[str]) -> list[dict] | None:
+    def place(self, job: JobSpec, node_names: list[str],
+              handles: list | None = None) -> list[dict] | None:
         self._counters["plans"] += 1
         state = self._cached_state
         if state is None:

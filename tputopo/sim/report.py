@@ -1,11 +1,12 @@
 """Metrics collection and the sim's JSON report.
 
 Everything reported is a function of *virtual* time and the deterministic
-event stream — no wall-clock numbers leak in, so a fixed (seed, config)
-reproduces the report byte-for-byte (tests/test_sim.py pins this), and
-every future perf/policy PR can diff reports instead of re-arguing
-methodology.  Quantiles use the ceil-based rank convention shared with
-the extender's exported Metrics and bench.py's pct().
+event stream — wall-clock numbers live ONLY in the ``throughput`` block
+below — so a fixed (seed, config) reproduces everything else
+byte-for-byte (tests/test_sim.py pins this), and every future perf/policy
+PR can diff reports instead of re-arguing methodology.  Quantiles use the
+ceil-based rank convention shared with the extender's exported Metrics
+and bench.py's pct().
 
 Schema (``tputopo.sim/v1``)::
 
@@ -27,8 +28,16 @@ Schema (``tputopo.sim/v1``)::
           "scheduler": {<deterministic policy counters>}
         }, ...
       },
-      "ab": {"policies": [...], "deltas": {<metric>: a_minus_b}}
+      "ab": {"policies": [...], "deltas": {<metric>: a_minus_b}},
+      "throughput": {"events", "wall_s", "events_per_s", "jobs"}
     }
+
+The ``throughput`` block is the ONE exception to byte-determinism:
+``events`` (total engine heap pops) and ``jobs`` are deterministic, but
+``wall_s``/``events_per_s`` are wall-clock telemetry — the standing
+figure every perf PR moves.  Determinism comparisons (tests, report
+diffs across machines) strip the block; everything else in the report
+remains byte-identical per (seed, config).
 """
 
 from __future__ import annotations
@@ -185,8 +194,9 @@ def ab_deltas(policies: dict[str, dict]) -> dict:
 
 def build_report(trace_desc: dict, horizon_s: float,
                  policies: dict[str, dict],
-                 engine_params: dict | None = None) -> dict:
-    return {
+                 engine_params: dict | None = None,
+                 throughput: dict | None = None) -> dict:
+    out = {
         "schema": SCHEMA,
         "trace": trace_desc,
         # Engine knobs that change results but are not part of the trace
@@ -198,3 +208,8 @@ def build_report(trace_desc: dict, horizon_s: float,
         "policies": policies,
         "ab": ab_deltas(policies),
     }
+    if throughput is not None:
+        # Wall-clock telemetry (see module docstring): the only block
+        # excluded from the byte-determinism contract.
+        out["throughput"] = dict(throughput)
+    return out

@@ -159,8 +159,90 @@ def _geometry(topo: ChipTopology) -> dict:
             "index": {c: i for i, c in enumerate(topo.chips)},
             "boxes": {},
             "within": {},
+            "lfb_masks": {},
         }
     return geo
+
+
+# ---- largest-free-box index geometry ----------------------------------------
+#
+# The fragmentation metric (largest_free_box) needs, per candidate dims
+# tuple, ONLY the box occupancy masks — never the chip tuples _boxes_for
+# materializes.  Masks are built axis-separably (a box mask is the AND of
+# one coordinate-slab mask per axis), so materializing every dims of a
+# 256-chip torus costs ~10^5 int ops, not ~10^6 tuple builds, and the
+# whole table is a few MB of ints.  Cached per topology in _GEO_CACHE.
+
+
+def _axis_value_masks(topo: ChipTopology) -> list[list[int]]:
+    """Per axis, per coordinate value: the mask of chips at that value."""
+    geo = _geometry(topo)
+    vm = geo.get("lfb_val_masks")
+    if vm is None:
+        idx = geo["index"]
+        vm = [[0] * d for d in topo.dims]
+        for c, i in idx.items():
+            b = 1 << i
+            for ax, v in enumerate(c):
+                vm[ax][v] |= b
+        geo["lfb_val_masks"] = vm
+    return vm
+
+
+def _lfb_box_masks(topo: ChipTopology, dims: tuple[int, ...]) -> list[int]:
+    """Box occupancy masks for every valid origin of ``dims`` (same origin
+    vocabulary as :func:`_origins`, seam-crossing boxes included on wrapped
+    axes), masks only — the largest-free-box scan's working set."""
+    geo = _geometry(topo)
+    masks = geo["lfb_masks"].get(dims)
+    if masks is None:
+        vm = _axis_value_masks(topo)
+        slabs: list[dict[int, int]] = []
+        for ax, d in enumerate(dims):
+            td = topo.dims[ax]
+            per_start: dict[int, int] = {}
+            starts = (range(td) if topo.wrap[ax] and d < td
+                      else range(td - d + 1))
+            for s in starts:
+                m = 0
+                for j in range(d):
+                    m |= vm[ax][(s + j) % td]
+                per_start[s] = m
+            slabs.append(per_start)
+        masks = []
+        for o in _origins(topo, dims):
+            m = slabs[0][o[0]]
+            for ax in range(1, len(dims)):
+                m &= slabs[ax][o[ax]]
+            masks.append(m)
+        geo["lfb_masks"][dims] = masks
+    return masks
+
+
+# Global scan order for the largest-free-box search: every dims candidate
+# fitting the torus, largest volume first, ties broken by the SAME
+# preference the allocator places with (enumerate_shapes: best predicted
+# bandwidth, then the generation's standard vocabulary, then compactness).
+# Hoisted out of the per-call path — the former implementation rebuilt the
+# enumerate_shapes preference map on every metric hit.
+_LFB_ORDER_CACHE: dict[tuple, tuple[tuple, dict]] = {}
+
+
+def _lfb_order(topo: ChipTopology, cost: LinkCostModel
+               ) -> tuple[tuple, dict]:
+    """(ordered, rank): ``ordered`` is a tuple of (dims, volume) in scan
+    order; ``rank`` maps dims -> position (the tie-break map the windowed
+    oracle also uses)."""
+    key = (_topo_key(topo), cost)
+    got = _LFB_ORDER_CACHE.get(key)
+    if got is None:
+        ordered = []
+        for vol in range(topo.num_chips, 0, -1):
+            for s in enumerate_shapes(topo, vol, cost):
+                ordered.append((s.dims, vol))
+        rank = {dims: r for r, (dims, _) in enumerate(ordered)}
+        got = _LFB_ORDER_CACHE[key] = (tuple(ordered), rank)
+    return got
 
 
 def _chip_masks(topo: ChipTopology) -> tuple[list[int], list[int]]:
@@ -310,6 +392,14 @@ class Allocator:
         self._used_mask = 0
         self._free_cache: frozenset[Coord] | None = None
         self._used_cache: frozenset[Coord] | None = None
+        # Incremental largest-free-box index (see largest_free_box): the
+        # used_mask the cached answer was computed against, the answer, a
+        # witness box mask proving it, and its rank in the global scan
+        # order.  All immutable values — clone() shares them for free.
+        self._lfb_snap: int | None = None
+        self._lfb: tuple[int, tuple[int, ...]] | None = None
+        self._lfb_witness = 0
+        self._lfb_rank = 0
 
     def clone(self) -> "Allocator":
         """O(1) occupancy snapshot (copies the occupancy integer, shares the
@@ -325,6 +415,14 @@ class Allocator:
         a._used_mask = self._used_mask
         a._free_cache = self._free_cache
         a._used_cache = self._used_cache
+        # Index snapshot read FIRST (the writer publishes it last): a clone
+        # racing a recompute can only inherit a stale-snap/fresh-answer mix,
+        # which the snap mismatch forces it to recompute — never the
+        # reverse pairing, which would cache a wrong answer as current.
+        a._lfb_snap = self._lfb_snap
+        a._lfb = self._lfb
+        a._lfb_witness = self._lfb_witness
+        a._lfb_rank = self._lfb_rank
         return a
 
     @property
@@ -335,6 +433,15 @@ class Allocator:
     @property
     def used_mask(self) -> int:
         return self._used_mask
+
+    @property
+    def free_count(self) -> int:
+        """Number of free chips (a popcount — no coord-set build)."""
+        return self.free_mask.bit_count()
+
+    @property
+    def used_count(self) -> int:
+        return self._used_mask.bit_count()
 
     def chips_of_mask(self, mask: int) -> list[Coord]:
         return mask_chips(self.topo, mask)
@@ -576,12 +683,79 @@ class Allocator:
     def largest_free_box(self) -> tuple[int, tuple[int, ...]] | None:
         """(volume, dims) of the largest free axis-aligned box — the
         fragmentation health metric (analog of Gaia's fragment-node count,
-        Gaia PDF §III.B).
+        Gaia PDF §III.B), maintained INCREMENTALLY under mark_used/release
+        deltas.
 
-        Cost is bounded: one sliding-window sum per candidate dims tuple
-        (prod(topo.dims) tuples, each O(grid) via cumsum) instead of the
-        former volume-descending rescan of every shape x origin, which did
-        unbounded work on large toruses (/state served this per hit)."""
+        The index is (last used_mask, answer, witness box mask, scan rank).
+        Monotonicity does the work: marking chips can only shrink the
+        metric, so if no marked chip lands inside the witness box the
+        cached answer still stands (everything ranked better was already
+        infeasible); releasing chips can only grow it, so only dims ranked
+        BETTER than the cached answer need rescanning, and if none became
+        feasible the cached answer (whose witness a release cannot kill)
+        stands.  Rescans walk the global (volume desc, placement-preference)
+        order over precomputed per-dims box masks (:func:`_lfb_box_masks`)
+        and stop at the first feasible box — one int AND per candidate.
+        A conflicting delta (witness killed, or chips moved both ways)
+        degrades to the scan from the appropriate rank; the windowed-cumsum
+        oracle survives as :meth:`largest_free_box_scan` for differential
+        tests and bulk one-shot queries.
+
+        Cache-write ordering: ``_lfb_snap`` is published LAST (and read
+        first by :meth:`clone`).  Occupancy never changes under concurrent
+        readers (binds are serialized; /state scrapes are read-only), so
+        concurrent recomputations produce identical values — but a reader
+        or clone observing a half-written index must see a snap MISMATCH
+        and recompute, never a fresh snap paired with a stale answer."""
+        used = self._used_mask
+        if used == self._full_mask:  # no free chips at all
+            self._lfb, self._lfb_witness = None, 0
+            self._lfb_snap = used
+            return None
+        snap = self._lfb_snap
+        if snap == used:
+            return self._lfb
+        order, _rank_of = _lfb_order(self.topo, self.cost)
+        witness_alive = (snap is not None and self._lfb is not None
+                         and self._lfb_witness & used == 0)
+        released = (snap & ~used) if snap is not None else -1
+        if witness_alive and released == 0:
+            # Pure marks, none inside the witness: nothing ranked better
+            # was feasible before and marks cannot make it so.
+            self._lfb_snap = used
+            return self._lfb
+        if witness_alive:
+            # Chips were released: only a better-ranked dims can newly win;
+            # the cached answer is the floor (its witness is still free).
+            lo, hi, fallback = 0, self._lfb_rank, self._lfb
+        elif snap is not None and released == 0 and self._lfb is not None:
+            # Pure marks killed the witness: better ranks stay infeasible,
+            # so resume the scan at the old answer's rank.
+            lo, hi, fallback = self._lfb_rank, len(order), None
+        else:
+            lo, hi, fallback = 0, len(order), None  # first call / conflict
+        for r in range(lo, hi):
+            dims, vol = order[r]
+            for mask in _lfb_box_masks(self.topo, dims):
+                if mask & used == 0:
+                    self._lfb = (vol, dims)
+                    self._lfb_witness = mask
+                    self._lfb_rank = r
+                    self._lfb_snap = used  # publish last (see docstring)
+                    return self._lfb
+        if fallback is None:
+            # Unreachable while any chip is free (the all-ones dims is
+            # always in the order and feasible at a free chip) — defensive.
+            self._lfb, self._lfb_witness = None, 0
+        self._lfb_snap = used
+        return fallback
+
+    def largest_free_box_scan(self) -> tuple[int, tuple[int, ...]] | None:
+        """Windowed-cumsum reference implementation of
+        :meth:`largest_free_box` — one sliding-window sum per candidate
+        dims tuple, O(grid) each via numpy.  Kept as the differential-test
+        oracle for the incremental index and as the bulk fallback for
+        one-shot queries with no cached state worth maintaining."""
         import numpy as np
 
         free = self.free
@@ -629,9 +803,9 @@ class Allocator:
             return None
         best_k = max(math.prod(d) for d in feasible)
         # Among max-volume shapes, keep enumerate_shapes' preference order
-        # (best predicted bandwidth, then standard vocabulary, then compact).
-        order = {s.dims: i for i, s in
-                 enumerate(enumerate_shapes(topo, best_k, self.cost))}
+        # (best predicted bandwidth, then standard vocabulary, then compact)
+        # — via the hoisted global rank map, not a per-call rebuild.
+        _, rank_of = _lfb_order(topo, self.cost)
         winners = [d for d in feasible if math.prod(d) == best_k]
-        winners.sort(key=lambda d: order.get(d, len(order)))
+        winners.sort(key=lambda d: rank_of.get(d, len(rank_of)))
         return best_k, winners[0]
