@@ -402,6 +402,11 @@ def bench_scale(n_domains: int = 4, spec: str = "v5p:8x8x4",
         "state_delta_applied": c.get("state_delta_applied", 0),
         "state_full_rebuilds": c.get("state_full_rebuilds", 0),
         "state_delta_fallbacks": c.get("state_delta_fallbacks", 0),
+        # Per-reason fallback attribution (node_churn / journal_gap /
+        # conflict / overlap / other): a fallback spike names its cause.
+        "state_delta_fallback_reasons": {
+            k[len("state_delta_fallback_"):]: v for k, v in sorted(c.items())
+            if k.startswith("state_delta_fallback_")},
         "score_memo_carried": c.get("score_memo_carried", 0),
         "gang_plan_reuse_hits": c.get("gang_plan_reuse_hits", 0),
         "multislice_gang_size": multi_gang,
@@ -444,7 +449,14 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0) -> dict:
     from tputopo.sim.trace import TraceConfig
 
     cfg = TraceConfig(seed=seed, nodes=nodes, arrivals=arrivals)
-    report = run_trace(cfg, ["ici", "naive"])
+    # Two replays on purpose: the UNTRACED one supplies the standing
+    # wall-clock figures (flight_trace=False is the documented perf-figure
+    # configuration — comparable across PRs and with `--no-trace` CLI
+    # runs), the traced one supplies the per-phase breakdown.  Their
+    # deterministic report bodies are identical, so the A/B deltas can
+    # come from either.
+    report = run_trace(cfg, ["ici", "naive"], flight_trace=False)
+    traced = run_trace(cfg, ["ici", "naive"])
     deltas = report["ab"]["deltas"]["ici-vs-naive"]
     if not any(v != 0 for v in deltas.values()):
         raise SystemExit("bench sim: zero A/B delta on every axis — the "
@@ -459,6 +471,12 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0) -> dict:
         "wall_s": report["throughput"]["wall_s"],
         "events": report["throughput"]["events"],
         "events_per_s": report["throughput"]["events_per_s"],
+        # Flight-recorder phase breakdown from the TRACED replay (wall-ms
+        # per verb/phase, telemetry; its own wall recorded alongside):
+        # WHERE the time goes — a perf PR reads the bottleneck phase from
+        # here before reaching for --profile.
+        "traced_wall_s": traced["throughput"]["wall_s"],
+        "phase_wall_ms": traced.get("phase_wall", {}).get("ici", {}),
         "ab_deltas": deltas,
     }
     for name in ("ici", "naive"):

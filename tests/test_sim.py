@@ -34,9 +34,11 @@ def test_trace_geometry():
 
 def _canon(report: dict) -> str:
     """Report bytes under the determinism contract: everything except the
-    wall-clock ``throughput`` block (the one documented exception)."""
+    wall-clock ``throughput`` and ``phase_wall`` blocks (the two
+    documented exceptions)."""
     report = dict(report)
     report.pop("throughput", None)
+    report.pop("phase_wall", None)
     return json.dumps(report, sort_keys=True)
 
 

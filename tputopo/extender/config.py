@@ -47,6 +47,18 @@ class ExtenderConfig:
     # un-appliable event.  Off = every mirror change forces a rebuild
     # (the conservative mode the differential test replays against).
     state_delta: bool = True
+    # Flight recorder (tputopo.obs): sort/bind open a trace with nested
+    # phase spans and attach a per-decision explain record, served by
+    # GET /debug/traces.  The enabled path costs ~a span per phase and a
+    # per-node dict on the traced verb only; disabling swaps in the
+    # shared no-op NullTracer (branch-cheap — no allocations on the hot
+    # path).  trace_capacity bounds the ring buffer of retained traces.
+    trace_enabled: bool = True
+    trace_capacity: int = 256
+    # Recent bind-decision records retained for /state (was a hardcoded
+    # 200): long-horizon incident forensics can raise it, memory-tight
+    # deployments can shrink it.
+    decisions_retention: int = 200
     # Per-generation LinkCostModel field overrides, e.g.
     # {"v5p": {"ici_link_gbps": 95.0, "dcn_host_gbps": 42.0}} — the explicit,
     # measured replacement for the reference's TODO weight table.

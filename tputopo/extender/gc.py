@@ -26,10 +26,15 @@ from tputopo.extender.state import ClusterState
 
 class AssumptionGC:
     def __init__(self, api_server: FakeApiServer, assume_ttl_s: float = 60.0,
-                 clock=time.time) -> None:
+                 clock=time.time, metrics=None) -> None:
         self.api = api_server
         self.assume_ttl_s = assume_ttl_s
         self.clock = clock
+        # Optional extender Metrics: sweeps were invisible to /metrics
+        # scrapers (a wedged or slow GC could strand reservations silently)
+        # — when wired, each pass records gc_sweeps/gc_assumptions_released
+        # counters and a "gc" latency series, exported like every verb.
+        self.metrics = metrics
         self.released: list[str] = []  # pod names released, for observability
         # Gangs with confirmed members whose unconfirmed members expired —
         # they hold chips but can never complete; a job controller must act.
@@ -38,6 +43,7 @@ class AssumptionGC:
     def sweep(self) -> list[str]:
         """One pass: clear assignments for expired assumptions (and their
         whole gangs).  Returns the pod names released this pass."""
+        t0 = time.perf_counter()
         state = ClusterState(self.api, assume_ttl_s=self.assume_ttl_s,
                              clock=self.clock).sync()
         victims: dict[tuple[str, str], None] = {}
@@ -74,4 +80,9 @@ class AssumptionGC:
                 continue  # pod deleted meanwhile — already released
         self.released.extend(released)
         del self.released[:-500]
+        if self.metrics is not None:
+            self.metrics.inc("gc_sweeps")
+            self.metrics.inc("gc_assumptions_released", len(released))
+            self.metrics.observe_ms("gc",
+                                    (time.perf_counter() - t0) * 1e3)
         return released

@@ -8,11 +8,16 @@ pod plus candidate nodes and returns a host-priority list; POST
 sort receives node *names*; topology comes from the extender's own cluster
 state, never from a node round-trip.
 
-Extras beyond the reference (SURVEY.md §5.1/§5.5 prescriptions): /healthz,
-Prometheus-format /metrics with per-verb latency, and /state exposing the
-fragmentation report and recent decision records.  Fail-closed posture
-(ignorable=false, design.md:109): errors return non-2xx with a reason, so
-scheduling of managed pods fails loudly rather than silently degrading.
+Extras beyond the reference (SURVEY.md §5.1/§5.5 prescriptions): /healthz;
+/metrics in real Prometheus exposition format (``# HELP``/``# TYPE``,
+cumulative ``_bucket``/``_sum``/``_count`` histograms with fixed buckets,
+the windowed p50/p95 gauges, informer/buffer depth gauges, ``build_info``);
+/state exposing the fragmentation report, recent decision records, counters
+and informer health; and /debug/traces serving the flight recorder's recent
+verb traces (phase spans + explain records, ``?n=`` bounds the count).
+Fail-closed posture (ignorable=false, design.md:109): errors return non-2xx
+with a reason, so scheduling of managed pods fails loudly rather than
+silently degrading.
 
 Stdlib http.server only — this image has no Flask/grpcio, and a scheduler
 extender needs nothing more.
@@ -21,9 +26,12 @@ extender needs nothing more.
 from __future__ import annotations
 
 import json
+import math
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+import tputopo
 from tputopo.extender.config import ExtenderConfig
 from tputopo.extender.scheduler import BindError, ExtenderScheduler
 
@@ -81,31 +89,70 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(503, {"error": f"{type(e).__name__}: {e}"})
 
     def do_GET(self) -> None:
+        url = urllib.parse.urlsplit(self.path)
         try:
-            if self.path == "/healthz":
+            if url.path == "/healthz":
                 self._send_text(200, "ok\n")
-            elif self.path == "/metrics":
+            elif url.path == "/metrics":
                 self._send_text(200, self._render_metrics())
-            elif self.path == "/state":
-                # Serve from the informer mirror exactly like the verbs do
-                # (nodeCacheCapable posture, design.md:102): a monitoring
-                # scraper polling /state must cost zero API LISTs in steady
-                # state, not an authoritative full-cluster sync per hit.
-                sched = self.scheduler
-                reader = (sched.informer if sched.informer is not None
-                          and sched.informer.synced else None)
-                state = sched._state(allow_cache=True, reader=reader)
-                self._send_json(200, {
-                    "fragmentation": state.fragmentation_report(),
-                    "decisions": self.scheduler.decisions[-20:],
-                })
-            elif self.path == "/policy":
+            elif url.path == "/state":
+                self._handle_state()
+            elif url.path == "/debug/traces":
+                self._handle_traces(url.query)
+            elif url.path == "/policy":
                 self._send_json(200, self.config.policy_json())
             else:
                 self._send_json(404, {"error": f"unknown path {self.path}"})
         except Exception as e:
             self.scheduler.metrics.inc("api_errors")
             self._send_json(503, {"error": f"{type(e).__name__}: {e}"})
+
+    def _handle_state(self) -> None:
+        # Serve from the informer mirror exactly like the verbs do
+        # (nodeCacheCapable posture, design.md:102): a monitoring
+        # scraper polling /state must cost zero API LISTs in steady
+        # state, not an authoritative full-cluster sync per hit.
+        sched = self.scheduler
+        reader = (sched.informer if sched.informer is not None
+                  and sched.informer.synced else None)
+        state = sched._state(allow_cache=True, reader=reader)
+        out = {
+            "fragmentation": state.fragmentation_report(),
+            "decisions": sched.decisions[-20:],
+            "decisions_buffer": {
+                "len": len(sched.decisions),
+                "retention": self.config.decisions_retention,
+            },
+            "counters": dict(sched.metrics.counters),
+            "traces": {"enabled": sched.tracer.enabled,
+                       "recorded": sched.tracer.recorded},
+            "unmirrored_binds": len(sched._unmirrored_binds),
+        }
+        if sched.informer is not None:
+            out["informer"] = {
+                "synced": sched.informer.synced,
+                "journal_len": sched.informer.journal_len,
+                **dict(sched.informer.metrics),
+            }
+        self._send_json(200, out)
+
+    def _handle_traces(self, query: str) -> None:
+        """GET /debug/traces?n=K — the flight recorder's K most recent
+        verb traces (default 20), oldest first: nested phase spans with
+        wall-ms and deterministic counters, plus the per-decision explain
+        record (per-node score breakdown / structured rejections)."""
+        try:
+            n = int(urllib.parse.parse_qs(query).get("n", ["20"])[0])
+        except (ValueError, TypeError):
+            self.scheduler.metrics.inc("bad_requests")
+            self._send_json(400, {"error": f"bad n in query {query!r}"})
+            return
+        tracer = self.scheduler.tracer
+        self._send_json(200, {
+            "enabled": tracer.enabled,
+            "recorded": tracer.recorded,
+            "traces": tracer.traces(n),
+        })
 
     def _handle_sort(self) -> None:
         req = self._read_json()
@@ -131,18 +178,77 @@ class _Handler(BaseHTTPRequestHandler):
             # requeues the pod; with ignorable=false nothing silently binds.
             self._send_json(200, {"Error": str(e)})
 
+    _PREFIX = "tputopo_extender"
+
     def _render_metrics(self) -> str:
+        """Prometheus exposition (text format 0.0.4): every sample family
+        carries its ``# HELP``/``# TYPE`` pair; per-verb latency is
+        exported BOTH as a cumulative fixed-bucket histogram (monotone
+        ``_bucket`` series + ``_sum``/``_count`` — what rate()/apdex math
+        needs) and as the windowed p50/p95 gauges (what a human reads and
+        the scale bench gates on); plus informer/buffer depth gauges and
+        ``build_info``."""
         m = self.scheduler.metrics
+        px = self._PREFIX
         lines = []
+
+        def family(name: str, mtype: str, help_text: str) -> None:
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {mtype}")
+
         for name, v in sorted(m.counters.items()):
-            lines.append(f"tputopo_extender_{name}_total {v}")
+            family(f"{px}_{name}_total", "counter",
+                   f"Cumulative count of {name.replace('_', ' ')}.")
+            lines.append(f"{px}_{name}_total {v}")
         for verb in sorted(m.latencies_ms):
+            hist = m.histogram(verb)
+            if hist is not None:
+                buckets, total_ms, count = hist
+                hname = f"{px}_{verb}_latency_ms"
+                family(hname, "histogram",
+                       f"Latency of the {verb} verb in milliseconds "
+                       "(cumulative fixed buckets).")
+                for bound, cum in buckets:
+                    le = "+Inf" if math.isinf(bound) else f"{bound:g}"
+                    lines.append(f'{hname}_bucket{{le="{le}"}} {cum}')
+                lines.append(f"{hname}_sum {total_ms:.3f}")
+                lines.append(f"{hname}_count {count}")
             qs = m.quantiles_ms(verb, (0.5, 0.95))
             if qs is not None:
                 # Tail latency is what a scheduling SLO is written against
                 # (the scale bench gates on p95 for the same reason).
-                lines.append(f"tputopo_extender_{verb}_latency_p50_ms {qs[0]:.3f}")
-                lines.append(f"tputopo_extender_{verb}_latency_p95_ms {qs[1]:.3f}")
+                # Rolling-window statistics, hence gauges, not summaries.
+                for q, val in zip(("p50", "p95"), qs):
+                    gname = f"{px}_{verb}_latency_{q}_ms"
+                    family(gname, "gauge",
+                           f"Rolling-window {q} latency of the {verb} "
+                           "verb in milliseconds.")
+                    lines.append(f"{gname} {val:.3f}")
+
+        sched = self.scheduler
+        family(f"{px}_decisions_buffer_len", "gauge",
+               "Bind-decision records currently retained for /state.")
+        lines.append(f"{px}_decisions_buffer_len {len(sched.decisions)}")
+        family(f"{px}_traces_recorded_total", "counter",
+               "Verb traces recorded by the flight recorder.")
+        lines.append(f"{px}_traces_recorded_total {sched.tracer.recorded}")
+        if sched.informer is not None:
+            family(f"{px}_informer_synced", "gauge",
+                   "1 when every informer kind has listed and is watching.")
+            lines.append(
+                f"{px}_informer_synced {int(sched.informer.synced)}")
+            family(f"{px}_informer_journal_len", "gauge",
+                   "Depth of the informer's bounded delta journal.")
+            lines.append(
+                f"{px}_informer_journal_len {sched.informer.journal_len}")
+            for name, v in sorted(sched.informer.metrics.items()):
+                family(f"{px}_informer_{name}_total", "counter",
+                       f"Informer {name.replace('_', ' ')}.")
+                lines.append(f"{px}_informer_{name}_total {v}")
+        family(f"{px}_build_info", "gauge",
+               "Build metadata; the value is always 1.")
+        lines.append(
+            f'{px}_build_info{{version="{tputopo.__version__}"}} 1')
         return "\n".join(lines) + "\n"
 
 
@@ -214,7 +320,10 @@ def main() -> None:  # pragma: no cover - thin CLI wrapper
 
     from tputopo.extender.gc import AssumptionGC
 
-    gc = AssumptionGC(api_server, assume_ttl_s=config.assume_ttl_s)
+    # Shares the scheduler's Metrics so sweeps are scrapeable via /metrics
+    # (gc_sweeps/gc_assumptions_released counters + "gc" latency series).
+    gc = AssumptionGC(api_server, assume_ttl_s=config.assume_ttl_s,
+                      metrics=scheduler.metrics)
     stop = threading.Event()
 
     def gc_loop() -> None:

@@ -39,7 +39,14 @@ class PodAssignment:
 class _DeltaUnappliable(Exception):
     """An event the copy-on-write delta machinery cannot fold exactly
     (node-topology change, overlapping claim, conflicted base state) —
-    the caller falls back to a full sync()."""
+    the caller falls back to a full sync().  ``code`` is the structured
+    fallback reason the scheduler's split counters attribute the rebuild
+    to (``node_churn`` / ``overlap`` / ``conflict`` / ``other``; the
+    ``journal_gap`` reason is raised by the informer side, not here)."""
+
+    def __init__(self, detail: str, code: str = "other") -> None:
+        super().__init__(detail)
+        self.code = code
 
 
 class _PodRec:
@@ -340,7 +347,8 @@ class ClusterState:
         state) and the caller must fall back to a full sync()."""
         return self.with_events([(kind, event.get("type"), event["object"])])
 
-    def with_events(self, events) -> "ClusterState | None":
+    def with_events(self, events,
+                    reasons: list[str] | None = None) -> "ClusterState | None":
         """Fold a sequence of ``(kind, event_type, object)`` watch events
         into a copy-on-write clone — the generalization of the bind-only
         delta to the full informer event vocabulary: pod ADDED/MODIFIED/
@@ -350,11 +358,18 @@ class ClusterState:
         answer there).  Expiry is still judged at this state's original
         sync time — the caller's staleness bound (the scheduler's
         _INFORMER_STATE_MAX_AGE_S) governs when a real re-sync re-judges
-        the TTL clock."""
+        the TTL clock.
+
+        ``reasons``, when given, receives the structured fallback reason
+        code on a None return (``node_churn`` / ``overlap`` / ``conflict``
+        / ``other``) — what the scheduler's per-reason fallback counters
+        attribute the forced rebuild to."""
         if self.conflicts:
             # A conflicted base state's occupancy attribution is
             # order-dependent (first claimant wins); removing or adding
             # claims can reshuffle it in ways only a full re-sort sees.
+            if reasons is not None:
+                reasons.append("conflict")
             return None
         new = self._cow()
         try:
@@ -367,7 +382,9 @@ class ClusterState:
                     new._apply_node_event(etype, obj)
                 else:
                     raise _DeltaUnappliable(f"unknown kind {kind!r}")
-        except _DeltaUnappliable:
+        except _DeltaUnappliable as e:
+            if reasons is not None:
+                reasons.append(e.code)
             return None
         return new
 
@@ -468,7 +485,8 @@ class ClusterState:
             # Overlap, out-of-slice chip, or duplicate within the group —
             # sync() files these as conflicts with order-dependent
             # attribution; only a full re-sort reproduces that.
-            raise _DeltaUnappliable("chips not cleanly free") from None
+            raise _DeltaUnappliable("chips not cleanly free",
+                                     code="overlap") from None
         dom.assignments.append(pa)
         self._pod_index[key] = _PodRec(pa, dom.slice_id, "active",
                                        tuple(pa.chips))
@@ -482,24 +500,25 @@ class ClusterState:
             if not known and (ko.ANN_TOPOLOGY not in anns
                               or ko.ANN_SLICE_ID not in anns):
                 return  # a non-TPU node joining/leaving changes nothing derived
-            raise _DeltaUnappliable("node set changed")
+            raise _DeltaUnappliable("node set changed", code="node_churn")
         # MODIFIED: appliable iff the node's topology-shaped annotations are
         # untouched and only the unhealthy-chip report moved.
         if ko.ANN_TOPOLOGY not in anns or ko.ANN_SLICE_ID not in anns:
             if known:
-                raise _DeltaUnappliable("node stopped being a TPU node")
+                raise _DeltaUnappliable("node stopped being a TPU node",
+                                        code="node_churn")
             return
         if not known:
-            raise _DeltaUnappliable("node became a TPU node")
+            raise _DeltaUnappliable("node became a TPU node", code="node_churn")
         dom = self._dom_by_node[name]
         if (anns[ko.ANN_SLICE_ID] != dom.slice_id
                 or parse_topology(anns[ko.ANN_TOPOLOGY]) != dom.topology):
-            raise _DeltaUnappliable("node topology changed")
+            raise _DeltaUnappliable("node topology changed", code="node_churn")
         if dom.host_by_node.get(name) != _host_coord_of(anns):
-            raise _DeltaUnappliable("host coordinate changed")
+            raise _DeltaUnappliable("host coordinate changed", code="node_churn")
         chips = list(_parse_chips_ann(anns.get(ko.ANN_CHIPS, "[]")))
         if chips != dom.chips_by_node.get(name):
-            raise _DeltaUnappliable("node chip list changed")
+            raise _DeltaUnappliable("node chip list changed", code="node_churn")
         node_unhealthy = _node_unhealthy_of(anns, dom.topology.chip_set)
         if node_unhealthy == self._unhealthy_by_node.get(name, frozenset()):
             return  # labels or other metadata — no derived impact

@@ -109,6 +109,15 @@ class Informer:
     def synced(self) -> bool:
         return all(ev.is_set() for ev in self._synced.values())
 
+    @property
+    def journal_len(self) -> int:
+        """Current depth of the bounded delta journal — a /metrics gauge:
+        pinned at the maxlen under sustained churn, it predicts
+        journal-gap fallbacks (consumers whose token fell off the window
+        pay a full rebuild)."""
+        with self._lock:
+            return len(self._journal)
+
     def version(self) -> tuple[str, ...]:
         """Cache-coherence token: changes iff the mirror's CONTENT changed
         (install of a new/newer object, a removing delete, a relist, a

@@ -1,0 +1,11 @@
+"""tputopo.obs — scheduler flight recorder.
+
+Phase-span tracing (:class:`Tracer` / :class:`Span`), per-decision
+explain records, and the no-op :class:`NullTracer` the hot path runs
+with by default.  See :mod:`tputopo.obs.tracer` for the design notes.
+"""
+
+from tputopo.obs.tracer import (NULL_TRACER, NullTracer, Span, Trace,
+                                Tracer)
+
+__all__ = ["Tracer", "Span", "Trace", "NullTracer", "NULL_TRACER"]

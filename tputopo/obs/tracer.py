@@ -1,0 +1,269 @@
+"""Flight recorder: phase-span tracing and decision explain records.
+
+The scheduler's verbs (``sort``/``bind``) are multi-stage pipelines —
+state build/fold, generation gate, score loop, gang composition search,
+CAS patch, delta publish — and until this module the only observable
+output was flat counters and one p50/p95 gauge per verb.  A
+:class:`Tracer` records, per verb invocation, a tree of timed phase
+spans with deterministic counters plus an optional **explain record**
+(the per-node score breakdown and structured rejection reasons the
+verbs attach), into a bounded ring buffer served by ``/debug/traces``.
+
+Two design constraints shape the API:
+
+- **The disabled path is branch-cheap.**  The default scheduler tracer
+  is the :data:`NULL_TRACER` singleton; its spans are one shared no-op
+  object, so a hot loop pays attribute lookups and no-op calls only —
+  no dict, no list, no clock read.  Explain assembly is additionally
+  gated on ``span.enabled`` so the disabled path never allocates.
+- **Wall clock is telemetry, never truth.**  Span durations come from a
+  wall clock (``perf_counter``); everything else a trace carries — its
+  timestamp, phase counts, span counters, the explain record — comes
+  from the caller's (possibly *virtual*) clock and deterministic control
+  flow.  That split is what lets the simulator run with tracing on and
+  still pin explain records and phase counts byte-for-byte across runs,
+  quarantining wall-ms in the report's documented non-deterministic
+  blocks (``throughput`` / ``phase_wall``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+
+class Span:
+    """One timed phase of a verb.  Use as a context manager; nest via
+    :meth:`child`.  ``counters`` hold deterministic integers (items
+    scored, memo hits) — never wall-clock values."""
+
+    __slots__ = ("tracer", "name", "wall_ms", "counters", "children", "_t0")
+
+    enabled = True
+
+    def __init__(self, tracer: "Tracer", name: str) -> None:
+        self.tracer = tracer
+        self.name = name
+        self.wall_ms = 0.0
+        self.counters: dict[str, int] = {}
+        self.children: list[Span] = []
+        self._t0 = 0.0
+
+    def child(self, name: str) -> "Span":
+        s = Span(self.tracer, name)
+        self.children.append(s)
+        return s
+
+    # Alias: a verb's direct children are its phases.
+    phase = child
+
+    def count(self, name: str, by: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + by
+
+    def __enter__(self) -> "Span":
+        self._t0 = self.tracer.wall()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.wall_ms = (self.tracer.wall() - self._t0) * 1e3
+        return False
+
+    def to_dict(self) -> dict:
+        d: dict = {"name": self.name, "wall_ms": round(self.wall_ms, 3)}
+        if self.counters:
+            d["counters"] = dict(self.counters)
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+
+class Trace(Span):
+    """A verb invocation's root span.  Exiting the context records the
+    finished trace into the tracer's ring buffer (including on error —
+    a failed bind's trace carries the failure reason)."""
+
+    __slots__ = ("verb", "attrs", "t", "explain_record", "error")
+
+    def __init__(self, tracer: "Tracer", verb: str, attrs: dict) -> None:
+        super().__init__(tracer, verb)
+        self.verb = verb
+        self.attrs = attrs
+        self.t = tracer.clock()  # caller clock: virtual in the sim
+        self.explain_record: dict | None = None
+        self.error: str | None = None
+
+    def explain(self, record: dict) -> None:
+        self.explain_record = record
+
+    def fail(self, reason: str) -> None:
+        self.error = reason
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        super().__exit__(exc_type, exc, tb)
+        if exc_type is not None and self.error is None:
+            self.error = f"{exc_type.__name__}: {exc}"
+        self.tracer.record(self)
+        return False  # never swallow the verb's exception
+
+    def to_dict(self) -> dict:
+        d = {"verb": self.verb, "t": round(self.t, 6),
+             "wall_ms": round(self.wall_ms, 3)}
+        if self.attrs:
+            d.update(self.attrs)
+        if self.counters:
+            d["counters"] = dict(self.counters)
+        d["phases"] = [c.to_dict() for c in self.children]
+        if self.explain_record is not None:
+            d["explain"] = self.explain_record
+        if self.error is not None:
+            d["error"] = self.error
+        return d
+
+
+class Tracer:
+    """Records verb traces into a bounded ring buffer and aggregates
+    per-phase totals (deterministic counts; wall-ms kept separately).
+
+    ``clock`` stamps trace timestamps — inject the sim's virtual clock
+    for deterministic explain records; ``wall`` times span durations
+    (telemetry).  Thread-safe: the extender's HTTP server runs verbs
+    concurrently, so recording and reading take an internal lock."""
+
+    enabled = True
+
+    def __init__(self, capacity: int = 256, clock=time.time,
+                 wall=time.perf_counter) -> None:
+        self.clock = clock
+        self.wall = wall
+        self._buf: deque[dict] = deque(maxlen=max(1, capacity))
+        self._lock = threading.Lock()
+        self.recorded = 0  # total traces ever recorded (gauge-able)
+        # Aggregates keyed "verb" / "verb/phase" / "verb/phase/child":
+        # counts + summed span counters are deterministic (the sim report's
+        # ``phases`` block); wall-ms is telemetry (the ``phase_wall`` block).
+        self.phase_counts: dict[str, int] = {}
+        self.phase_counters: dict[str, dict[str, int]] = {}
+        self.phase_wall_ms: dict[str, float] = {}
+        self.last: dict | None = None  # most recent trace (as a dict)
+
+    def start(self, verb: str, **attrs) -> Trace:
+        return Trace(self, verb, attrs)
+
+    def record(self, trace: Trace) -> None:
+        d = trace.to_dict()
+        with self._lock:
+            self._buf.append(d)
+            self.last = d
+            self.recorded += 1
+            self._aggregate(trace.verb, trace)
+            for child in trace.children:
+                self._aggregate_tree(trace.verb, child)
+
+    def _aggregate(self, key: str, span: Span) -> None:
+        self.phase_counts[key] = self.phase_counts.get(key, 0) + 1
+        self.phase_wall_ms[key] = (self.phase_wall_ms.get(key, 0.0)
+                                   + span.wall_ms)
+        if span.counters:
+            agg = self.phase_counters.setdefault(key, {})
+            for name, v in span.counters.items():
+                agg[name] = agg.get(name, 0) + v
+
+    def _aggregate_tree(self, prefix: str, span: Span) -> None:
+        key = f"{prefix}/{span.name}"
+        self._aggregate(key, span)
+        for child in span.children:
+            self._aggregate_tree(key, child)
+
+    def traces(self, n: int = 20) -> list[dict]:
+        """The ``n`` most recent traces, oldest first (n <= 0: none —
+        NOT the whole buffer, which ``buf[-0:]`` would mean)."""
+        if n <= 0:
+            return []
+        with self._lock:
+            buf = list(self._buf)
+        return buf[-n:]
+
+    @property
+    def last_explain(self) -> dict | None:
+        last = self.last
+        return last.get("explain") if last is not None else None
+
+    def phases_snapshot(self) -> dict:
+        """Deterministic per-phase aggregate: ``{key: {"count": n,
+        "counters": {...}}}`` — the sim report's ``phases`` block."""
+        with self._lock:
+            out = {}
+            for key in sorted(self.phase_counts):
+                entry: dict = {"count": self.phase_counts[key]}
+                counters = self.phase_counters.get(key)
+                if counters:
+                    entry["counters"] = dict(sorted(counters.items()))
+                out[key] = entry
+            return out
+
+    def phase_wall_snapshot(self) -> dict:
+        """Wall-ms per phase key (telemetry; excluded from determinism)."""
+        with self._lock:
+            return {k: round(v, 3)
+                    for k, v in sorted(self.phase_wall_ms.items())}
+
+
+class _NullSpan:
+    """Shared no-op span: every method returns self or does nothing, so
+    the disabled hot path costs attribute lookups only."""
+
+    __slots__ = ()
+
+    enabled = False
+
+    def child(self, name: str) -> "_NullSpan":
+        return self
+
+    phase = child
+
+    def count(self, name: str, by: int = 1) -> None:
+        pass
+
+    def explain(self, record: dict) -> None:
+        pass
+
+    def fail(self, reason: str) -> None:
+        pass
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class NullTracer:
+    """Tracing disabled: ``start`` hands back one shared no-op span and
+    nothing is ever recorded.  Read surface matches :class:`Tracer` so
+    consumers (the /debug endpoint, the sim report) need no branches."""
+
+    enabled = False
+    recorded = 0
+    last = None
+    last_explain = None
+
+    def start(self, verb: str, **attrs) -> _NullSpan:
+        return _NULL_SPAN
+
+    def traces(self, n: int = 20) -> list[dict]:
+        return []
+
+    def phases_snapshot(self) -> dict:
+        return {}
+
+    def phase_wall_snapshot(self) -> dict:
+        return {}
+
+
+#: Shared disabled tracer — the default for every scheduler not
+#: explicitly wired for tracing.
+NULL_TRACER = NullTracer()

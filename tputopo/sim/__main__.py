@@ -56,6 +56,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "report is byte-identical to --jobs 1 modulo the "
                         "wall-clock throughput block)")
     p.add_argument("--out", default=None, help="also write the report here")
+    p.add_argument("--no-trace", action="store_true",
+                   help="disable the flight recorder (NullTracer hot "
+                        "path): drops the phases/phase_wall blocks and "
+                        "first-divergence explain records — the "
+                        "perf-figure configuration")
+    p.add_argument("--trace-out", default=None, metavar="TRACES.JSONL",
+                   help="dump every policy's decision log with explain "
+                        "records as JSON lines (one decision per line; "
+                        "requires tracing enabled for the explains)")
     p.add_argument("--profile", action="store_true",
                    help="run under cProfile and emit the top-25 "
                         "cumulative-time entries to stderr (the report on "
@@ -83,6 +92,7 @@ def main(argv: list[str] | None = None) -> int:
         duration_mean_s=args.duration_mean, ghost_prob=args.ghost_prob,
         node_failures=args.node_failures,
     )
+    flight_trace = not args.no_trace
     t0 = time.perf_counter()
     if args.profile:
         # Profiling output is telemetry like the wall clock: stderr only,
@@ -95,16 +105,35 @@ def main(argv: list[str] | None = None) -> int:
         prof.enable()
         # Profiling forces sequential replay: cProfile only sees this
         # process, and worker-process time would vanish from the stats.
-        report = run_trace(cfg, policies, assume_ttl_s=args.assume_ttl,
-                           gc_period_s=args.gc_period)
+        report, states = run_trace(cfg, policies,
+                                   assume_ttl_s=args.assume_ttl,
+                                   gc_period_s=args.gc_period,
+                                   flight_trace=flight_trace,
+                                   return_states=True)
         prof.disable()
         buf = io.StringIO()
         pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(25)
         print(buf.getvalue(), file=sys.stderr)
     else:
-        report = run_trace(cfg, policies, assume_ttl_s=args.assume_ttl,
-                           gc_period_s=args.gc_period, jobs=args.jobs)
+        report, states = run_trace(cfg, policies,
+                                   assume_ttl_s=args.assume_ttl,
+                                   gc_period_s=args.gc_period,
+                                   jobs=args.jobs,
+                                   flight_trace=flight_trace,
+                                   return_states=True)
     wall_s = time.perf_counter() - t0
+    if args.trace_out:
+        # One JSON line per committed decision, every policy: the full
+        # decision-log entry (job, virtual time, member placements) plus
+        # the explain record when tracing was on — deterministic bytes
+        # per (seed, config), so traces.jsonl files diff across PRs
+        # exactly like reports do.
+        with open(args.trace_out, "w") as f:
+            for rs in states:
+                for i, entry in enumerate(rs.decision_log):
+                    f.write(json.dumps(
+                        {"policy": rs.policy_name, "index": i, **entry},
+                        sort_keys=True) + "\n")
     text = json.dumps(report, indent=2, sort_keys=True)
     print(text)
     if args.out:

@@ -25,6 +25,8 @@ import time
 from tputopo.deviceplugin.reporter import node_object_for_probe
 from tputopo.discovery.shim import _probe_python, _to_host_probe
 from tputopo.extender.gc import AssumptionGC
+from tputopo.obs import NULL_TRACER
+from tputopo.obs import Tracer as ObsTracer
 from tputopo.extender.state import ClusterState
 from tputopo.k8s import objects as ko
 from tputopo.k8s.fakeapi import FakeApiServer, NotFound
@@ -129,7 +131,8 @@ class SimEngine:
 
     def __init__(self, trace: Trace, policy_name: str, *,
                  assume_ttl_s: float = 60.0, gc_period_s: float = 30.0,
-                 max_backfill_failures: int = 8) -> None:
+                 max_backfill_failures: int = 8,
+                 flight_trace: bool = True) -> None:
         self.trace = trace
         self.cfg = trace.config
         self.clock = VirtualClock(0.0)
@@ -138,8 +141,22 @@ class SimEngine:
                                   for n in self._node_objects}
         self.node_names = sorted(self._node_obj_by_name)
         read_api = _CopyFreeApi(self.api)
+        # Flight recorder (tputopo.obs), on by default: a virtual-clock
+        # tracer, so trace timestamps and explain records are
+        # deterministic per (seed, config) — only span wall-ms is host
+        # telemetry (quarantined in the report's phase_wall block).
+        # ``flight_trace=False`` swaps in the shared no-op NullTracer:
+        # the perf-figure configuration (the PR-3 wall baseline the
+        # slow-tier smoke test guards).
+        self.tracer = (ObsTracer(capacity=64, clock=self.clock)
+                       if flight_trace else NULL_TRACER)
         self.policy = get_policy(policy_name, read_api, self.clock,
-                                 assume_ttl_s)
+                                 assume_ttl_s, tracer=self.tracer)
+        # Chronological log of committed placements: (job, t, members)
+        # always (cheap, deterministic — what the A/B first-divergence
+        # finder compares); the policy's explain record attached when
+        # tracing is on.
+        self.decision_log: list[dict] = []
         self.gc = AssumptionGC(read_api, assume_ttl_s=assume_ttl_s,
                                clock=self.clock)
         self.assume_ttl_s = assume_ttl_s
@@ -225,6 +242,12 @@ class SimEngine:
             frag=[self._frag_cache[sid] for sid in sorted(self._frag_cache)],
             counters=self.policy.counters(),
             events_processed=self.events_processed,
+            # Flight-recorder aggregates: phase counts/counters are
+            # deterministic (report body); phase wall-ms is telemetry
+            # (the phase_wall exception block).
+            phases=self.tracer.phases_snapshot(),
+            phase_wall_ms=self.tracer.phase_wall_snapshot(),
+            decision_log=self.decision_log,
         )
 
     def run_events(self) -> None:
@@ -490,6 +513,18 @@ class SimEngine:
                 chips_by_dom.setdefault(sid, set()).add(tuple(chip))
             self._twin_mark(sid, [tuple(c) for c in d["chips"]])
             self.placed_chips += len(d["chips"])
+        entry = {
+            "job": spec.name, "t": round(now, 6),
+            "members": [{"pod": d["pod"], "node": d["node"],
+                         "slice": d["slice"],
+                         "chips": [list(map(int, c)) for c in d["chips"]]}
+                        for d in decisions],
+        }
+        if self.tracer.enabled:
+            explain = self.policy.explain_last()
+            if explain is not None:
+                entry["explain"] = explain
+        self.decision_log.append(entry)
         if spec.total_chips > 1:
             # Job-level achieved collective bandwidth over the UNION of
             # the job's chips (the quantity a DP/TP job actually syncs
@@ -586,10 +621,13 @@ class RunState:
     """One policy run's finalizable facts (see SimEngine.run_state)."""
 
     __slots__ = ("policy_name", "horizon_s", "end_t", "metrics",
-                 "placed_chips", "frag", "counters", "events_processed")
+                 "placed_chips", "frag", "counters", "events_processed",
+                 "phases", "phase_wall_ms", "decision_log")
 
     def __init__(self, *, policy_name, horizon_s, end_t, metrics,
-                 placed_chips, frag, counters, events_processed) -> None:
+                 placed_chips, frag, counters, events_processed,
+                 phases=None, phase_wall_ms=None,
+                 decision_log=None) -> None:
         self.policy_name = policy_name
         self.horizon_s = horizon_s
         self.end_t = end_t
@@ -598,6 +636,9 @@ class RunState:
         self.frag = frag
         self.counters = counters
         self.events_processed = events_processed
+        self.phases = phases or {}
+        self.phase_wall_ms = phase_wall_ms or {}
+        self.decision_log = decision_log or []
 
 
 def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
@@ -608,7 +649,40 @@ def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
     through it, which is what keeps their reports byte-identical."""
     if horizon_s > rs.end_t:
         rs.metrics.occupancy(horizon_s, rs.placed_chips, rs.frag)
-    return rs.metrics.report(max(horizon_s, rs.horizon_s), rs.counters)
+    out = rs.metrics.report(max(horizon_s, rs.horizon_s), rs.counters)
+    # Flight-recorder phase counts: deterministic (span counts + summed
+    # span counters per "verb/phase" key) — part of the report body and
+    # the byte-determinism contract; wall-ms stays OUT of this block
+    # (see run_trace's phase_wall).
+    out["phases"] = rs.phases
+    return out
+
+
+def first_divergence(ref: RunState, other: RunState) -> dict | None:
+    """The first decision where two policies' chronological placement
+    streams differ — (job, virtual time, member placements) — with both
+    policies' explain records attached.  None when the streams are
+    identical.  This is the question every A/B delta ultimately reduces
+    to ("WHICH decision went differently, and why"), answered from the
+    report instead of a by-hand replay diff."""
+
+    def key(e: dict) -> tuple:
+        return (e["job"], e["t"],
+                tuple((m["pod"], m["node"], m["slice"],
+                       tuple(map(tuple, m["chips"]))) for m in e["members"]))
+
+    for i, (ea, eb) in enumerate(zip(ref.decision_log, other.decision_log)):
+        if key(ea) != key(eb):
+            return {"index": i, ref.policy_name: ea, other.policy_name: eb}
+    la, lb = len(ref.decision_log), len(other.decision_log)
+    if la != lb:
+        # Identical prefix, different lengths: the divergence is the first
+        # decision only one policy made (the other side reports null).
+        i = min(la, lb)
+        return {"index": i,
+                ref.policy_name: ref.decision_log[i] if i < la else None,
+                other.policy_name: other.decision_log[i] if i < lb else None}
+    return None
 
 
 def _run_policy_worker(args) -> RunState:
@@ -616,16 +690,18 @@ def _run_policy_worker(args) -> RunState:
     unit.  Regenerates the trace from the config (deterministic per seed,
     pinned by tests) so nothing heavyweight crosses the process boundary
     in either direction."""
-    cfg, name, assume_ttl_s, gc_period_s = args
+    cfg, name, assume_ttl_s, gc_period_s, flight_trace = args
     engine = SimEngine(generate_trace(cfg), name,
-                       assume_ttl_s=assume_ttl_s, gc_period_s=gc_period_s)
+                       assume_ttl_s=assume_ttl_s, gc_period_s=gc_period_s,
+                       flight_trace=flight_trace)
     engine.run_events()
     return engine.run_state()
 
 
 def run_trace(cfg: TraceConfig, policy_names: list[str], *,
               assume_ttl_s: float = 60.0, gc_period_s: float = 30.0,
-              jobs: int = 1) -> dict:
+              jobs: int = 1, flight_trace: bool = True,
+              return_states: bool = False):
     """Replay one deterministic trace under each policy and build the
     A/B report.  Every policy sees the identical event stream.
 
@@ -633,10 +709,18 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
     engine run is independent until the shared-horizon finalization) — the
     report stays byte-identical to the sequential run because every run is
     deterministic per (seed, config, policy) and finalization is the same
-    code path; only the ``throughput`` wall-clock block (telemetry,
-    excluded from the determinism contract) differs."""
+    code path; only the wall-clock blocks (``throughput``/``phase_wall``,
+    telemetry excluded from the determinism contract) differ.
+
+    ``flight_trace`` (default on) runs every engine with a virtual-clock
+    flight recorder: the report gains per-policy ``phases`` counts, the
+    ``phase_wall`` telemetry block, and explain records on the A/B
+    ``first_divergence`` entry.  Off = the NullTracer hot path (the
+    perf-figure configuration).  ``return_states=True`` additionally
+    returns the per-policy RunStates (the CLI's --trace-out consumer)."""
     t0 = time.perf_counter()
-    work = [(cfg, name, assume_ttl_s, gc_period_s) for name in policy_names]
+    work = [(cfg, name, assume_ttl_s, gc_period_s, flight_trace)
+            for name in policy_names]
     if jobs > 1 and len(work) > 1:
         import multiprocessing as mp
 
@@ -654,15 +738,31 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
     horizon = max(rs.horizon_s for rs in states)
     policies = {rs.policy_name: finalize_run_state(rs, horizon)
                 for rs in states}
+    # First divergence vs the reference policy (states[0]): deterministic
+    # — decision logs are virtual-time facts — so it lives in the report
+    # body (the ab block), explain records included when tracing was on.
+    divergence = {
+        f"{states[0].policy_name}-vs-{rs.policy_name}":
+            first_divergence(states[0], rs)
+        for rs in states[1:]
+    }
     wall_s = time.perf_counter() - t0
     events = sum(rs.events_processed for rs in states)
-    return build_report(cfg.describe(), horizon, policies,
-                        engine_params={"assume_ttl_s": assume_ttl_s,
-                                       "gc_period_s": gc_period_s},
-                        throughput={
-                            "events": events,  # deterministic
-                            "wall_s": round(wall_s, 3),
-                            "events_per_s": round(events / wall_s, 1)
-                            if wall_s > 0 else 0.0,
-                            "jobs": min(jobs, len(work)) if jobs > 1 else 1,
-                        })
+    report = build_report(
+        cfg.describe(), horizon, policies,
+        engine_params={"assume_ttl_s": assume_ttl_s,
+                       "gc_period_s": gc_period_s},
+        throughput={
+            "events": events,  # deterministic
+            "wall_s": round(wall_s, 3),
+            "events_per_s": round(events / wall_s, 1)
+            if wall_s > 0 else 0.0,
+            "jobs": min(jobs, len(work)) if jobs > 1 else 1,
+        },
+        first_divergence=divergence,
+        # Wall-ms per flight-recorder phase, per policy — telemetry like
+        # throughput (the second documented determinism exception).
+        phase_wall={rs.policy_name: rs.phase_wall_ms for rs in states})
+    if return_states:
+        return report, states
+    return report

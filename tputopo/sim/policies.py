@@ -60,18 +60,31 @@ class PlacementPolicy:
     (:meth:`FakeApiServer.handle`, one per replica in member order): a
     policy that needs the member pod objects reads them copy-free instead
     of paying a deepcopy per member per attempt.
+
+    ``tracer`` (optional, a :class:`tputopo.obs.Tracer`) turns on the
+    flight recorder: after a successful ``place`` the policy exposes a
+    deterministic explain record via :meth:`explain_last` — what the
+    engine's decision log and the report's first-divergence finder attach.
     """
 
     name = "abstract"
 
-    def __init__(self, api: FakeApiServer, clock, assume_ttl_s: float) -> None:
+    def __init__(self, api: FakeApiServer, clock, assume_ttl_s: float,
+                 tracer=None) -> None:
         self.api = api
         self.clock = clock
         self.assume_ttl_s = assume_ttl_s
+        self.tracer = tracer
+        self._trace_on = tracer is not None and tracer.enabled
 
     def place(self, job: JobSpec, node_names: list[str],
               handles: list | None = None) -> list[dict] | None:
         raise NotImplementedError
+
+    def explain_last(self) -> dict | None:
+        """Explain record of the most recent successful ``place`` (None
+        when tracing is off or nothing was placed yet)."""
+        return None
 
     def invalidate(self, events=None) -> None:
         """The engine mutated cluster state outside this policy's own
@@ -92,8 +105,8 @@ class IciAwarePolicy(PlacementPolicy):
 
     name = "ici"
 
-    def __init__(self, api, clock, assume_ttl_s) -> None:
-        super().__init__(api, clock, assume_ttl_s)
+    def __init__(self, api, clock, assume_ttl_s, tracer=None) -> None:
+        super().__init__(api, clock, assume_ttl_s, tracer=tracer)
         # Informer-less assume-cache mode: the engine is the sole writer
         # and calls invalidate() on every out-of-band mutation, so a
         # scheduling wake pays ONE cluster sync and each bind publishes
@@ -101,10 +114,17 @@ class IciAwarePolicy(PlacementPolicy):
         # is effectively "until invalidated" — virtual time can jump
         # hours between wakes and the invalidation discipline, not the
         # wall TTL, is what keeps the view coherent.
+        #
+        # The engine's tracer (virtual clock — deterministic explain
+        # timestamps) is handed straight to the scheduler; when tracing
+        # is off the scheduler runs with the shared no-op NullTracer.
+        from tputopo.obs import NULL_TRACER
+
         self.sched = ExtenderScheduler(
             api, ExtenderConfig(assume_ttl_s=assume_ttl_s,
                                 state_cache_s=1e12, bind_from_cache=True),
-            clock=clock)
+            clock=clock, tracer=tracer if tracer is not None else NULL_TRACER)
+        self._last_explain: dict | None = None
 
     def invalidate(self, events=None) -> None:
         if events is not None:
@@ -115,6 +135,7 @@ class IciAwarePolicy(PlacementPolicy):
     def place(self, job: JobSpec, node_names: list[str],
               handles: list | None = None) -> list[dict] | None:
         decisions = []
+        sort_explain = None
         for m in range(job.replicas):
             pod_name = f"{job.name}-{m}"
             # Copy-free member read: the engine's key-stable handle when
@@ -123,6 +144,10 @@ class IciAwarePolicy(PlacementPolicy):
             pod = (handles[m].fetch() if handles is not None
                    else self.api.get("pods", pod_name, "default"))
             scores = self.sched.sort(pod, node_names)
+            if self._trace_on and m == 0:
+                # Member 0's sort carries the full per-node breakdown the
+                # whole gang's plan was decided from.
+                sort_explain = self.tracer.last_explain
             # scores is empty when every node is failed (alive == []).
             best = (max(scores, key=lambda s: (s["Score"], s["Host"]))
                     if scores else None)
@@ -151,7 +176,16 @@ class IciAwarePolicy(PlacementPolicy):
                 "predicted_gbps": d["predicted_allreduce_gbps"],
                 "contiguous": d["contiguous"],
             })
+        if self._trace_on:
+            # The job-level explain: member 0's sort (why each node won or
+            # lost) + the final bind (the committed plan and gang stats).
+            self._last_explain = {"policy": self.name,
+                                  "sort": sort_explain,
+                                  "bind": self.tracer.last_explain}
         return decisions
+
+    def explain_last(self) -> dict | None:
+        return self._last_explain
 
     def counters(self) -> dict:
         c = self.sched.metrics.counters
@@ -164,7 +198,13 @@ class IciAwarePolicy(PlacementPolicy):
                 # rebuild-avoidance rate is reported, not inferred.
                 "state_delta_applied", "state_full_rebuilds",
                 "state_delta_fallbacks")
-        return {k: c[k] for k in keep if k in c}
+        out = {k: c[k] for k in keep if k in c}
+        # The per-reason fallback split (state_delta_fallback_node_churn /
+        # _journal_gap / _conflict / _overlap / _other): reported so a
+        # rebuild storm is attributable from the report alone.
+        out.update({k: v for k, v in c.items()
+                    if k.startswith("state_delta_fallback_")})
+        return out
 
 
 class BaselinePolicy(PlacementPolicy):
@@ -172,21 +212,29 @@ class BaselinePolicy(PlacementPolicy):
     committed through the same annotation handshake as the extender."""
 
     def __init__(self, api, clock, assume_ttl_s, picker_name: str,
-                 picker: Callable) -> None:
-        super().__init__(api, clock, assume_ttl_s)
+                 picker: Callable, tracer=None) -> None:
+        super().__init__(api, clock, assume_ttl_s, tracer=tracer)
         self.name = picker_name
         self.picker = picker
-        self._counters = {"plans": 0, "infeasible": 0, "binds": 0}
+        # invalidate_drops: every one is a full O(cluster) re-sync on the
+        # next place() — the counter that attributes the ROADMAP's
+        # "BaselinePolicy.invalidate full drops" sim-wall item from the
+        # report instead of a profiler run.
+        self._counters = {"plans": 0, "infeasible": 0, "binds": 0,
+                          "invalidate_drops": 0}
         # Same assume-cache discipline as the ici policy: one sync per
         # engine wake; this policy's own binds are reflected by the
         # mark_used calls during planning, and the engine invalidates on
         # every external mutation.
         self._cached_state: ClusterState | None = None
+        self._last_explain: dict | None = None
 
     def invalidate(self, events=None) -> None:
         # Count-only baselines keep the conservative drop regardless of
         # event detail — their plans are cheap relative to the A/B value
         # of keeping their decision stream bit-stable across PRs.
+        if self._cached_state is not None:
+            self._counters["invalidate_drops"] += 1
         self._cached_state = None
 
     def place(self, job: JobSpec, node_names: list[str],
@@ -203,19 +251,34 @@ class BaselinePolicy(PlacementPolicy):
         # An infeasible plan must roll its partial marks back: the state
         # is cached across place() calls now.
         plan: list[tuple[str, tuple]] = []
-        for _ in range(job.replicas):
+        # Traced: member 0's first-fit walk, mirroring the ici policy's
+        # per-node sort breakdown — which nodes the count-only rule
+        # skipped and why, and where it stopped.
+        walk: list[dict] | None = [] if self._trace_on else None
+        for member in range(job.replicas):
             placed = None
             for node in node_names:
                 dom = state.domain_of_node(node)
                 if dom is None:
+                    if walk is not None and member == 0:
+                        walk.append({"node": node,
+                                     "rejected": "not_a_tpu_node"})
                     continue
                 free_here = frozenset(state.free_chips_on_node(node))
                 if len(free_here) < job.chips:
+                    if walk is not None and member == 0:
+                        walk.append({"node": node,
+                                     "rejected": "insufficient_free_chips"})
                     continue
                 picked = self.picker(dom.topology, free_here, job.chips)
                 if picked is not None:
                     placed = (node, tuple(picked), dom)
+                    if walk is not None and member == 0:
+                        walk.append({"node": node, "picked": len(picked)})
                     break
+                if walk is not None and member == 0:
+                    walk.append({"node": node,
+                                 "rejected": "picker_found_no_set"})
             if placed is None:
                 self._counters["infeasible"] += 1
                 for node, picked in plan:
@@ -253,7 +316,17 @@ class BaselinePolicy(PlacementPolicy):
                                or _box_of(dom.topology, frozenset(picked))
                                is not None),
             })
+        if walk is not None:
+            self._last_explain = {
+                "policy": self.name,
+                "first_fit_walk": walk,
+                "plan": [{"pod": d["pod"], "node": d["node"],
+                          "slice": d["slice"]} for d in decisions],
+            }
         return decisions
+
+    def explain_last(self) -> dict | None:
+        return self._last_explain
 
     def counters(self) -> dict:
         return dict(self._counters)
@@ -267,11 +340,13 @@ def available_policies() -> list[str]:
     return ["ici"] + sorted(BASELINE_PICKERS)
 
 
-def get_policy(name: str, api, clock, assume_ttl_s: float) -> PlacementPolicy:
+def get_policy(name: str, api, clock, assume_ttl_s: float,
+               tracer=None) -> PlacementPolicy:
     if name == "ici":
-        return IciAwarePolicy(api, clock, assume_ttl_s)
+        return IciAwarePolicy(api, clock, assume_ttl_s, tracer=tracer)
     picker = BASELINE_PICKERS.get(name)
     if picker is not None:
-        return BaselinePolicy(api, clock, assume_ttl_s, name, picker)
+        return BaselinePolicy(api, clock, assume_ttl_s, name, picker,
+                              tracer=tracer)
     raise KeyError(f"unknown policy {name!r}; available: "
                    f"{available_policies()}")

@@ -1,17 +1,17 @@
 """Metrics collection and the sim's JSON report.
 
 Everything reported is a function of *virtual* time and the deterministic
-event stream — wall-clock numbers live ONLY in the ``throughput`` block
-below — so a fixed (seed, config) reproduces everything else
-byte-for-byte (tests/test_sim.py pins this), and every future perf/policy
-PR can diff reports instead of re-arguing methodology.  Quantiles use the
-ceil-based rank convention shared with the extender's exported Metrics
-and bench.py's pct().
+event stream — wall-clock numbers live ONLY in the ``throughput`` and
+``phase_wall`` blocks below — so a fixed (seed, config) reproduces
+everything else byte-for-byte (tests/test_sim.py pins this), and every
+future perf/policy PR can diff reports instead of re-arguing methodology.
+Quantiles use the ceil-based rank convention shared with the extender's
+exported Metrics and bench.py's pct().
 
-Schema (``tputopo.sim/v1``)::
+Schema (``tputopo.sim/v2``)::
 
     {
-      "schema": "tputopo.sim/v1",
+      "schema": "tputopo.sim/v2",
       "trace": {<TraceConfig> + n_domains/hosts_per_domain/chips},
       "virtual_horizon_s": <end of simulation, virtual seconds>,
       "policies": {
@@ -25,26 +25,36 @@ Schema (``tputopo.sim/v1``)::
                            "multi_chip_placements", "contiguous_frac"},
           "preemptions": {"node_failures", "pods_evicted", "jobs_requeued"},
           "gc": {"sweeps", "assumptions_released"},
-          "scheduler": {<deterministic policy counters>}
+          "scheduler": {<deterministic policy counters>},
+          "phases": {"<verb>/<phase>": {"count", "counters"?}, ...}
         }, ...
       },
-      "ab": {"policies": [...], "deltas": {<metric>: a_minus_b}},
-      "throughput": {"events", "wall_s", "events_per_s", "jobs"}
+      "ab": {"policies": [...], "deltas": {<metric>: a_minus_b},
+             "first_divergence": {"a-vs-b": {"index", "<a>": <decision +
+                                  explain>, "<b>": ...} | null}},
+      "throughput": {"events", "wall_s", "events_per_s", "jobs"},
+      "phase_wall": {"<policy>": {"<verb>/<phase>": wall_ms, ...}}
     }
 
-The ``throughput`` block is the ONE exception to byte-determinism:
-``events`` (total engine heap pops) and ``jobs`` are deterministic, but
-``wall_s``/``events_per_s`` are wall-clock telemetry — the standing
-figure every perf PR moves.  Determinism comparisons (tests, report
-diffs across machines) strip the block; everything else in the report
-remains byte-identical per (seed, config).
+``phases`` (flight-recorder span counts and summed span counters, per
+"verb/phase" key) and ``ab.first_divergence`` (the first decision where
+two policies' placement streams differ, both explain records attached)
+are deterministic virtual-time facts and part of the byte-determinism
+contract.
+
+The ``throughput`` and ``phase_wall`` blocks are the TWO exceptions to
+byte-determinism: ``throughput.events``/``jobs`` are deterministic, but
+``wall_s``/``events_per_s`` and every ``phase_wall`` value are wall-clock
+telemetry — the standing figures perf PRs move.  Determinism comparisons
+(tests, report diffs across machines) strip both blocks; everything else
+in the report remains byte-identical per (seed, config).
 """
 
 from __future__ import annotations
 
 from tputopo.extender.scheduler import quantile
 
-SCHEMA = "tputopo.sim/v1"
+SCHEMA = "tputopo.sim/v2"
 
 
 def _r(x: float, nd: int = 6) -> float:
@@ -195,7 +205,9 @@ def ab_deltas(policies: dict[str, dict]) -> dict:
 def build_report(trace_desc: dict, horizon_s: float,
                  policies: dict[str, dict],
                  engine_params: dict | None = None,
-                 throughput: dict | None = None) -> dict:
+                 throughput: dict | None = None,
+                 first_divergence: dict | None = None,
+                 phase_wall: dict | None = None) -> dict:
     out = {
         "schema": SCHEMA,
         "trace": trace_desc,
@@ -208,8 +220,17 @@ def build_report(trace_desc: dict, horizon_s: float,
         "policies": policies,
         "ab": ab_deltas(policies),
     }
+    if first_divergence is not None:
+        # Deterministic: the first decision where each comparator's
+        # placement stream departs from the reference's, explain records
+        # attached (tputopo.sim.engine.first_divergence).
+        out["ab"]["first_divergence"] = first_divergence
     if throughput is not None:
-        # Wall-clock telemetry (see module docstring): the only block
-        # excluded from the byte-determinism contract.
+        # Wall-clock telemetry (see module docstring): excluded from the
+        # byte-determinism contract.
         out["throughput"] = dict(throughput)
+    if phase_wall is not None:
+        # Wall-ms per flight-recorder phase per policy — the second
+        # documented determinism exception (see module docstring).
+        out["phase_wall"] = dict(phase_wall)
     return out
