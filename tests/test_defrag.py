@@ -1,0 +1,476 @@
+"""tputopo.defrag: planner pressure/plan/budget semantics, controller
+guards (hysteresis, cooldown, in-flight cap), the /debug/defrag dry-run
+surface, and the sim-integrated eviction -> requeue -> re-place chain
+(deterministic, byte-stable)."""
+
+import json
+
+import pytest
+
+from tests.cluster import build_cluster
+from tputopo.defrag import DefragController, pending_demand, plan_migration
+from tputopo.defrag.planner import placeable_free_box, pressure_report
+from tputopo.extender.state import ClusterState
+from tputopo.k8s import objects as ko
+from tputopo.k8s.fakeapi import FakeApiServer
+from tputopo.sim.engine import SimEngine, finalize_run_state, run_trace
+from tputopo.sim.report import SCHEMA, SCHEMA_DEFRAG
+from tputopo.sim.trace import JobSpec, Trace, TraceConfig
+
+CLOCK = lambda: 1000.0  # noqa: E731 — staged occupancy stamps this time
+
+
+def occupy(api, name, node, chips, gang=None, assigned=True):
+    """Stage one pod holding ``chips`` on ``node`` through the same
+    annotation handshake the extender stamps."""
+    labels = {}
+    if gang is not None:
+        labels["tpu.dev/gang-id"] = gang[0]
+        labels["tpu.dev/gang-size"] = str(gang[1])
+    api.create("pods", ko.make_pod(name, chips=len(chips), labels=labels))
+    anns = {
+        ko.ANN_GROUP: ko.coords_to_ann(chips),
+        ko.ANN_ASSUME_TIME: "1000.0",
+        ko.ANN_ASSIGNED: "true" if assigned else "false",
+    }
+    if gang is not None:
+        anns[ko.ANN_GANG_ID] = gang[0]
+    api.patch_annotations("pods", name, anns, "default")
+    api.bind_pod(name, node, "default")
+
+
+def synced_state(api):
+    return ClusterState(api, clock=CLOCK).sync()
+
+
+@pytest.fixture()
+def cluster():
+    """One v5p:2x2x4 domain over 4 hosts (4 chips per host)."""
+    api, _ = build_cluster()
+    state = synced_state(api)
+    dom = next(iter(state.domains.values()))
+    # node name per host, in host-coordinate order
+    nodes = [dom.node_by_host[h] for h in sorted(dom.node_by_host)]
+    chips = {n: list(dom.chips_by_node[n]) for n in nodes}
+    return api, nodes, chips
+
+
+# ---- planner ----------------------------------------------------------------
+
+
+def test_no_plan_when_demand_placeable(cluster):
+    api, nodes, chips = cluster
+    state = synced_state(api)
+    # Empty cluster: every demand places as-is — the do-nothing fallback.
+    assert plan_migration(state, [(2, 4), (1, 4)]) is None
+    assert placeable_free_box(next(iter(state.domains.values())), (2, 4))
+
+
+def test_plan_restores_host_aligned_gang_box(cluster):
+    api, nodes, chips = cluster
+    # Checkerboard: hosts 0 and 2 fully held, 1 and 3 free — 8 free chips
+    # but no ADJACENT host pair for a 2x4 gang.
+    occupy(api, "quad-a-0", nodes[0], chips[nodes[0]])
+    occupy(api, "quad-c-0", nodes[2], chips[nodes[2]])
+    state = synced_state(api)
+    dom = next(iter(state.domains.values()))
+    assert not placeable_free_box(dom, (2, 4))
+    plan = plan_migration(state, [(2, 4)])
+    assert plan is not None
+    # Cheapest repair: one quad moves (never a gang-for-gang swap).
+    assert len(plan.victims) == 1
+    assert plan.chips_moved == 4
+    assert plan.victims[0].key in ("default/quad-a-0", "default/quad-c-0")
+    # The restored box is host-aligned: exactly two whole hosts.
+    box = set(plan.box_chips)
+    assert len(box) == 8
+    covering = [n for n in nodes if set(chips[n]) <= box]
+    assert len(covering) == 2
+    # Victim's host is inside the box (that is what eviction restores).
+    victim_pod = plan.victims[0].pods[0]
+    victim_node = api.get("pods", victim_pod, "default")["spec"]["nodeName"]
+    assert victim_node in covering
+
+
+def test_plan_respects_budget_and_net_gain(cluster):
+    api, nodes, chips = cluster
+    occupy(api, "quad-a-0", nodes[0], chips[nodes[0]])
+    occupy(api, "quad-c-0", nodes[2], chips[nodes[2]])
+    state = synced_state(api)
+    # Budget below the cheapest candidate (4 chips): do nothing.
+    assert plan_migration(state, [(2, 4)], max_chips_moved=3) is None
+    # Net-gain rule: restoring a 4-chip host that a 4-chip job occupies
+    # moves as many chips as it gains — refused regardless of the
+    # configured ceiling.
+    occupy(api, "quad-b-0", nodes[1], chips[nodes[1]])
+    occupy(api, "quad-d-0", nodes[3], chips[nodes[3]])
+    full = synced_state(api)
+    assert plan_migration(full, [(1, 4)], max_chips_moved=64) is None
+
+
+def test_plan_single_pod_box_stays_within_one_host(cluster):
+    api, nodes, chips = cluster
+    # Every host half-held by a 2-chip pod: 8 free chips, no host with 4
+    # free — a 4-chip single pod is pressured.
+    for i, n in enumerate(nodes):
+        occupy(api, f"pair-{i}-0", n, chips[n][:2])
+    state = synced_state(api)
+    dom = next(iter(state.domains.values()))
+    assert not placeable_free_box(dom, (1, 4))
+    plan = plan_migration(state, [(1, 4)])
+    assert plan is not None
+    assert plan.chips_moved == 2 and len(plan.victims) == 1
+    # The restored box is one whole host.
+    box = set(plan.box_chips)
+    assert any(set(chips[n]) == box for n in nodes)
+
+
+def test_gang_victims_are_atomic(cluster):
+    api, nodes, chips = cluster
+    # Hosts 2-3 fully held by long solos; a 2-member gang holds 2 chips
+    # on EACH of hosts 0-1.  A 4-chip single pod is pressured (4 free
+    # chips, no full host).  Clearing host 0 touches 2 gang chips but —
+    # gangs being atomic — costs the gang's full 4 chips: that equals
+    # the box volume, so the net-gain rule refuses every plan.
+    occupy(api, "gang-0", nodes[0], chips[nodes[0]][:2], gang=("gang", 2))
+    occupy(api, "gang-1", nodes[1], chips[nodes[1]][:2], gang=("gang", 2))
+    occupy(api, "quad-c-0", nodes[2], chips[nodes[2]])
+    occupy(api, "quad-d-0", nodes[3], chips[nodes[3]])
+    state = synced_state(api)
+    assert not placeable_free_box(next(iter(state.domains.values())), (1, 4))
+    assert plan_migration(state, [(1, 4)], max_chips_moved=64) is None
+    # Contrast: the same occupancy as two INDEPENDENT 2-chip pods is
+    # plannable — clearing one host moves only that pod's 2 chips.
+    api2, _ = build_cluster()
+    occupy(api2, "solo-a-0", nodes[0], chips[nodes[0]][:2])
+    occupy(api2, "solo-b-0", nodes[1], chips[nodes[1]][:2])
+    occupy(api2, "quad-c-0", nodes[2], chips[nodes[2]])
+    occupy(api2, "quad-d-0", nodes[3], chips[nodes[3]])
+    plan = plan_migration(synced_state(api2), [(1, 4)], max_chips_moved=64)
+    assert plan is not None
+    assert plan.chips_moved == 2 and len(plan.victims) == 1
+
+
+def test_plan_never_targets_absent_node_silicon(cluster):
+    """A failed/deleted node's chips read as free in ClusterState (no
+    pod holds them) but can never host a pod — a plan restoring a box
+    there would evict nothing and fix nothing.  Regression: observed as
+    zero-victim 'executed' plans on node-failure traces."""
+    api, nodes, chips = cluster
+    # Hosts 0 and 2 held; node 3 is GONE (failed).  Only hosts 1+3
+    # could ever pair for free — but 3 is absent, so the lone true
+    # repair is evicting host 0 or 2 to pair with host 1.
+    occupy(api, "quad-a-0", nodes[0], chips[nodes[0]])
+    occupy(api, "quad-c-0", nodes[2], chips[nodes[2]])
+    api.delete("nodes", nodes[3])
+    state = synced_state(api)
+    plan = plan_migration(state, [(2, 4)])
+    assert plan is not None
+    assert len(plan.victims) == 1  # never a zero-victim plan
+    box = set(plan.box_chips)
+    assert not box & set(chips[nodes[3]])  # absent silicon untouched
+    assert set(chips[nodes[1]]) <= box  # the present free host is used
+
+
+def test_pending_demand_shapes(cluster):
+    api, nodes, chips = cluster
+    api.create("pods", ko.make_pod("lone", chips=4))
+    api.create("pods", ko.make_pod(
+        "g-0", chips=4, labels={"tpu.dev/gang-id": "g",
+                                "tpu.dev/gang-size": "2"}))
+    api.create("pods", ko.make_pod(
+        "g-1", chips=4, labels={"tpu.dev/gang-id": "g",
+                                "tpu.dev/gang-size": "2"}))
+    api.create("pods", ko.make_pod(
+        "ms-0", chips=4, labels={"tpu.dev/gang-id": "ms",
+                                 "tpu.dev/gang-size": "4",
+                                 "tpu.dev/allow-multislice": "true"}))
+    occupy(api, "bound-0", nodes[0], chips[nodes[0]])  # bound: not demand
+    # Partially-bound gang: 3 of 4 members already placed — the
+    # scheduler only extends it by ONE host, so the demand is (1, 2),
+    # never the declared size (a 4-host box would over-evict).
+    for m in range(4):
+        api.create("pods", ko.make_pod(
+            f"pb-{m}", chips=2, labels={"tpu.dev/gang-id": "pb",
+                                        "tpu.dev/gang-size": "4"}))
+    for m in range(3):
+        api.bind_pod(f"pb-{m}", nodes[m], "default")
+    demands = pending_demand(api.list("pods"))
+    # Gang counted once at its REMAINING size, multislice excluded,
+    # bound pod excluded, largest total first.
+    assert demands == [(2, 4), (1, 4), (1, 2)]
+
+
+def test_pressure_report_shape(cluster):
+    api, nodes, chips = cluster
+    occupy(api, "quad-a-0", nodes[0], chips[nodes[0]])
+    occupy(api, "quad-c-0", nodes[2], chips[nodes[2]])
+    state = synced_state(api)
+    rep = pressure_report(state, [(2, 4)])
+    (dom_rep,) = rep["domains"].values()
+    assert dom_rep["free_chips"] == 8
+    assert rep["demand_placeable"] == {"2x4": False}
+
+
+# ---- controller -------------------------------------------------------------
+
+
+def checkerboard(api, nodes, chips):
+    occupy(api, "quad-a-0", nodes[0], chips[nodes[0]])
+    occupy(api, "quad-c-0", nodes[2], chips[nodes[2]])
+
+
+def make_controller(api, **kw):
+    kw.setdefault("clock", CLOCK)
+    kw.setdefault("assume_ttl_s", 60.0)
+    return DefragController(api, **kw)
+
+
+def test_controller_hysteresis_then_execute_and_verify(cluster):
+    api, nodes, chips = cluster
+    checkerboard(api, nodes, chips)
+    ctl = make_controller(api, hysteresis=2, cooldown_s=0.0)
+    demands = [(2, 4)]
+    rec1 = ctl.run_cycle(demands=demands)
+    assert (rec1["action"], rec1["reason"]) == ("aborted", "hysteresis")
+    assert rec1["plan"] is not None  # the plan exists, the guard held it
+    rec2 = ctl.run_cycle(demands=demands)
+    assert rec2["action"] == "executed"
+    assert rec2["restored"] is True  # victim pods deleted -> box free
+    assert ctl.counters["plans_executed"] == 1
+    assert ctl.counters["boxes_restored"] == 1
+    assert ctl.counters["jobs_evicted"] == 1
+    assert ctl.counters["chips_moved"] == 4
+    # The demand really places now.
+    state = synced_state(api)
+    assert placeable_free_box(next(iter(state.domains.values())), (2, 4))
+
+
+def test_controller_cooldown_blocks_back_to_back_plans(cluster):
+    api, nodes, chips = cluster
+    checkerboard(api, nodes, chips)
+    evicted = []
+    ctl = make_controller(api, hysteresis=1, cooldown_s=1e9,
+                          evict=lambda v: evicted.append(v.key))
+    demands = [(2, 4)]
+    rec1 = ctl.run_cycle(demands=demands)
+    assert rec1["action"] == "executed"
+    assert len(evicted) == 1
+    # No-op evict hook left the cluster pressured; the cooldown holds.
+    rec2 = ctl.run_cycle(demands=demands)
+    assert (rec2["action"], rec2["reason"]) == ("aborted", "cooldown")
+    assert ctl.counters["aborted_cooldown"] == 1
+    # The no-op eviction also means verify must have failed loudly.
+    assert rec1["restored"] is False
+    assert ctl.counters["verify_failed"] == 1
+
+
+def test_controller_inflight_cap(cluster):
+    api, nodes, chips = cluster
+    ctl = make_controller(api, max_concurrent=1)
+    # Seed an in-flight migration whose pod is still Pending.
+    api.create("pods", ko.make_pod("mig-0", chips=4))
+    ctl._inflight["default/mig"] = ("default", ("mig-0",), 1000.0)
+    assert ctl._refresh_inflight() == 1
+    # Re-bound (migration landed): the slot frees up.
+    api.bind_pod("mig-0", nodes[0], "default")
+    assert ctl._refresh_inflight() == 0
+    # A MISSING pod (deleted, not yet recreated by the job controller)
+    # stays in flight — the production gap between eviction and
+    # recreation must not bypass the max-concurrent gate ...
+    ctl._inflight["default/mig1"] = ("default", ("mig-1",), 1000.0)
+    assert ctl._refresh_inflight() == 1
+    # ... but an entry older than the TTL is abandoned (the job never
+    # came back) so it cannot hold the slot forever.
+    ttl = max(ctl._INFLIGHT_TTL_FLOOR_S, ctl.cooldown_s)
+    ctl._inflight["default/mig1"] = ("default", ("mig-1",),
+                                     1000.0 - ttl - 1.0)
+    assert ctl._refresh_inflight() == 0
+
+
+def test_controller_noop_outcomes(cluster):
+    api, nodes, chips = cluster
+    ctl = make_controller(api)
+    assert ctl.run_cycle(demands=[])["reason"] == "no_demand"
+    # Placeable demand: no pressure, streak resets.
+    rec = ctl.run_cycle(demands=[(2, 4)])
+    assert (rec["action"], rec["reason"]) == ("noop", "no_pressure")
+    assert ctl._pressure_streak == 0
+    assert ctl.counters["cycles"] == 2
+
+
+# ---- extender surface -------------------------------------------------------
+
+
+def test_debug_defrag_endpoint():
+    import urllib.request
+
+    from tputopo.extender import (ExtenderConfig, ExtenderHTTPServer,
+                                  ExtenderScheduler)
+
+    api, _ = build_cluster()
+    config = ExtenderConfig()
+    sched = ExtenderScheduler(api, config, clock=CLOCK)
+    srv = ExtenderHTTPServer(sched, config, port=0).start()
+    try:
+        host, port = srv.address
+
+        def get(path):
+            with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                        timeout=5) as resp:
+                return resp.status, json.loads(resp.read())
+
+        status, out = get("/debug/defrag")
+        assert status == 200
+        assert out["dry_run"] is True and out["plan"] is None
+        assert out["enabled"] is False
+
+        # Stage checkerboard occupancy + a pending gang: the dry-run
+        # plan appears, and nothing is evicted by serving it.
+        state = synced_state(api)
+        dom = next(iter(state.domains.values()))
+        nodes = [dom.node_by_host[h] for h in sorted(dom.node_by_host)]
+        occupy(api, "quad-a-0", nodes[0], list(dom.chips_by_node[nodes[0]]))
+        occupy(api, "quad-c-0", nodes[2], list(dom.chips_by_node[nodes[2]]))
+        for m in range(2):
+            api.create("pods", ko.make_pod(
+                f"g-{m}", chips=4, labels={"tpu.dev/gang-id": "g",
+                                           "tpu.dev/gang-size": "2"}))
+        status, out = get("/debug/defrag")
+        assert status == 200
+        assert out["demands"] == [{"replicas": 2, "chips_per_member": 4}]
+        assert out["plan"] is not None
+        assert out["plan"]["jobs_evicted"] == 1
+        assert out["plan"]["chips_moved"] == 4
+        assert out["pressure"]["demand_placeable"] == {"2x4": False}
+        # Dry run: the victims still hold their chips.
+        assert api.get("pods", "quad-a-0", "default")["spec"]["nodeName"]
+        assert api.get("pods", "quad-c-0", "default")["spec"]["nodeName"]
+
+        # ?target=K overrides the demand derivation; a target larger
+        # than one host becomes a whole-hosts (gang-shaped) box.
+        status, out = get("/debug/defrag?target=4")
+        assert status == 200
+        assert out["demands"] == [{"replicas": 1, "chips_per_member": 4}]
+        status, out = get("/debug/defrag?target=8")
+        assert status == 200
+        assert out["demands"] == [{"replicas": 2, "chips_per_member": 4}]
+        assert out["plan"] is not None  # same checkerboard pressure
+    finally:
+        srv.stop()
+
+
+# ---- sim integration: the eviction -> requeue -> re-place chain -------------
+
+
+def _fragmented_trace() -> Trace:
+    """Four quads fill the 4-host domain; the two short-lived ones
+    complete leaving a checkerboard (hosts 1 and 3 free), then a 2x4
+    gang arrives needing an adjacent host pair — placeable only after a
+    defrag eviction."""
+    cfg = TraceConfig(seed=0, nodes=4, spec="v5p:2x2x4", arrivals=5,
+                      node_failures=0, ghost_prob=0.0)
+    jobs = (
+        JobSpec("job-00000", 0.0, 4, 1, 5000.0),
+        JobSpec("job-00001", 1.0, 4, 1, 40.0),
+        JobSpec("job-00002", 2.0, 4, 1, 5000.0),
+        JobSpec("job-00003", 3.0, 4, 1, 40.0),
+        JobSpec("job-00004", 60.0, 4, 2, 500.0),
+    )
+    return Trace(config=cfg, jobs=jobs)
+
+
+DEFRAG_TEST_KNOBS = {"period_s": 30.0, "hysteresis": 1, "cooldown_s": 0.0,
+                     "max_moves": 1}
+
+
+def _run_chain():
+    engine = SimEngine(_fragmented_trace(), "ici",
+                       defrag=DEFRAG_TEST_KNOBS)
+    engine.run_events()
+    rs = engine.run_state()
+    report = finalize_run_state(rs, rs.horizon_s)
+    return engine, rs, report
+
+
+def test_defrag_chain_evict_requeue_replace():
+    """Satellite: the full chain the controller relies on — a forced
+    fragmented state, one defrag cycle, the requeued gang lands in the
+    restored box, the evicted quad re-places, and the report is
+    byte-stable across two runs."""
+    engine, rs, report = _run_chain()
+    d = report["defrag"]
+    assert d["plans_executed"] == 1
+    assert d["boxes_restored"] == 1 and d["verify_failed"] == 0
+    assert d["jobs_evicted"] == 1 and d["chips_moved"] == 4
+
+    # The gang placed — and exactly into the restored box.
+    plan = engine.defrag.last_plan
+    assert plan is not None
+    box = {tuple(c) for c in plan.box_chips}
+    gang_entries = [e for e in rs.decision_log if e["job"] == "job-00004"]
+    assert len(gang_entries) == 1
+    gang_chips = {tuple(c) for m in gang_entries[0]["members"]
+                  for c in m["chips"]}
+    assert gang_chips == box
+    assert all(m["slice"] == plan.slice_id
+               for m in gang_entries[0]["members"])
+
+    # The evicted quad was requeued and re-placed (two placements).
+    victim_job = plan.victims[0].pods[0].rsplit("-", 1)[0]
+    victim_entries = [e for e in rs.decision_log if e["job"] == victim_job]
+    assert len(victim_entries) == 2
+
+    # Everything ran to completion; the ledger cross-check held.
+    assert report["jobs"]["unplaced_at_end"] == 0
+    assert report["jobs"]["scheduled"] == 6  # 5 jobs + 1 re-place
+    assert engine.placed_chips == len(engine.ledger)
+
+    # Byte-stable: an identical second run reproduces report AND
+    # decision log exactly (phase wall-ms is telemetry, not compared).
+    engine2, rs2, report2 = _run_chain()
+    assert json.dumps(report, sort_keys=True) == \
+        json.dumps(report2, sort_keys=True)
+    assert json.dumps(rs.decision_log, sort_keys=True) == \
+        json.dumps(rs2.decision_log, sort_keys=True)
+
+    # The defrag trace was recorded with its phases.
+    assert any(k.startswith("defrag") for k in report["phases"])
+
+
+def test_run_trace_defrag_schema_and_block():
+    """--defrag bumps the schema to v3 and adds the per-policy defrag
+    block; off keeps the v2 shape with no defrag key at all."""
+    cfg = TraceConfig(seed=0, nodes=8, spec="v5p:2x2x4", arrivals=30,
+                      node_failures=0)
+    off = run_trace(cfg, ["ici"])
+    assert off["schema"] == SCHEMA
+    assert "defrag" not in off["policies"]["ici"]
+    assert "defrag" not in off["engine"]
+    on_a = run_trace(cfg, ["ici"], defrag={"hysteresis": 1})
+    on_b = run_trace(cfg, ["ici"], defrag={"hysteresis": 1})
+    assert on_a["schema"] == SCHEMA_DEFRAG
+    assert on_a["policies"]["ici"]["defrag"]["cycles"] > 0
+    assert on_a["engine"]["defrag"]["hysteresis"] == 1
+
+    def canon(r):
+        r = dict(r)
+        r.pop("throughput", None)
+        r.pop("phase_wall", None)
+        return json.dumps(r, sort_keys=True)
+
+    assert canon(on_a) == canon(on_b)
+
+
+def test_defrag_engine_ledger_stays_consistent():
+    """Defrag evictions run through the same requeue path as node
+    failures: drive a churny trace (failures + ghosts + defrag) and let
+    the engine's double-booking cross-check prove chip accounting."""
+    from tputopo.sim.trace import generate_trace
+
+    cfg = TraceConfig(seed=3, nodes=8, spec="v5p:2x2x4", arrivals=40,
+                      ghost_prob=0.2, node_failures=3, repair_mean_s=60.0)
+    engine = SimEngine(generate_trace(cfg), "ici",
+                       defrag={"hysteresis": 1, "cooldown_s": 60.0})
+    engine.run()
+    assert engine.placed_chips == len(engine.ledger)

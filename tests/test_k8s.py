@@ -165,7 +165,9 @@ def test_nocopy_guard_checks_before_server_writes():
 def test_create_echo_optout_copy_count(monkeypatch):
     """Satellite: create() historically deep-copied twice per object on
     top of the watch-log emit copy; echo=False must skip exactly the echo
-    deepcopy and return a metadata-only stub."""
+    deepcopy and return a metadata-only stub.  With no watch consumer
+    attached the emit copy is lazy too — a watcher-less create(echo=False)
+    costs exactly the ONE store copy."""
     import copy as copymod
 
     real = copymod.deepcopy
@@ -184,7 +186,7 @@ def test_create_echo_optout_copy_count(monkeypatch):
     stub = api.create("pods", make_pod("p1", chips=1), echo=False)
     without_echo = calls["n"]
     assert without_echo == with_echo - 1  # exactly the echo copy gone
-    assert without_echo == 2  # store copy + watch-log emit copy remain
+    assert without_echo == 1  # store copy only: no watcher, no emit copy
     # The stub still answers the questions a creator has.
     assert stub["metadata"]["name"] == "p1"
     assert stub["metadata"]["namespace"] == "default"
@@ -193,3 +195,50 @@ def test_create_echo_optout_copy_count(monkeypatch):
     # The full echo stays an independent deep copy.
     echoed["spec"]["mutated"] = True
     assert "mutated" not in api.get("pods", "p0", "default")["spec"]
+
+
+def test_watch_log_copy_is_lazy_until_attach(monkeypatch):
+    """Satellite (ROADMAP sim bottleneck 2): _emit's deepcopy-into-
+    watch-log must not run while no watch consumer has ever attached
+    (the sim has no watchers — the emit copy was ~10% of sim wall);
+    attaching via list_with_version/watch turns logging back on, and a
+    watcher asking for an rv that predates the attach gets Gone (the
+    relist path), never silently missing events."""
+    import copy as copymod
+
+    from tputopo.k8s.fakeapi import Gone
+
+    real = copymod.deepcopy
+    calls = {"n": 0}
+
+    def counting(x, memo=None, _nil=[]):
+        calls["n"] += 1
+        return real(x, memo)
+
+    monkeypatch.setattr(copymod, "deepcopy", counting)
+    api = FakeApiServer()
+    api.create("pods", make_pod("p0", chips=1), echo=False)
+    calls["n"] = 0
+    api.patch_annotations("pods", "p0", {"a": "1"}, "default")
+    patch_copies_unwatched = calls["n"]
+    # patch_annotations returns a deepcopy of the object (1); the emit
+    # copy must be gone.
+    assert patch_copies_unwatched == 1
+    assert api._watch_log == []  # nothing retained for nobody
+
+    # A watcher from an rv predating the attach: Gone -> relist, the
+    # same recovery as a scrolled retention window.
+    with pytest.raises(Gone):
+        list(api.watch("pods", "1", timeout_s=0.05))
+
+    # Attach via the informer's sync point: events after the returned rv
+    # are logged (with their emit copy) and delivered.
+    _, rv = api.list_with_version("pods")
+    calls["n"] = 0
+    api.patch_annotations("pods", "p0", {"a": "2"}, "default")
+    assert calls["n"] == patch_copies_unwatched + 1  # emit copy is back
+    events = list(api.watch("pods", rv, timeout_s=0.05))
+    assert [e["type"] for e in events if e["type"] != "BOOKMARK"] \
+        == ["MODIFIED"]
+    anns = events[0]["object"]["metadata"]["annotations"]
+    assert anns["a"] == "2"

@@ -1,0 +1,41 @@
+"""Defragmentation & migration planning (``tputopo.defrag``).
+
+Topology-aware *placement* preserves contiguous high-bandwidth slices —
+but under churny gang arrivals nothing repairs fragmentation once it
+accrues: small jobs outlive their neighbors and strand free chips in
+shapes no pending gang can use.  This package closes the loop with a
+Kubernetes-descheduler-style rescheduling subsystem:
+
+- :mod:`tputopo.defrag.planner` detects **fragmentation pressure**
+  (enough free chips for the pending demand, but no *placeable* free
+  box) and searches, mask-native over the precomputed box vocabulary,
+  for the cheapest bounded set of running jobs to evict so a target
+  contiguous box is restored — with a hard budget and a do-nothing
+  fallback.
+- :mod:`tputopo.defrag.controller` executes plans through the existing
+  eviction/requeue path (delete the victim pods; the gang requeues and
+  re-places), guarded by hysteresis, a cooldown, and a max-concurrent-
+  migrations cap, emitting ``defrag`` flight-recorder traces and
+  Prometheus counters.
+
+The extender serves dry-run plans at ``GET /debug/defrag``; the
+simulator runs periodic defrag cycles under ``--defrag`` and reports a
+per-policy ``defrag`` block so the standing A/B harness quantifies the
+queue-wait / fragmentation / bandwidth deltas deterministically.
+"""
+
+from tputopo.defrag.controller import DefragController
+from tputopo.defrag.planner import (MigrationPlan, Victim, dedupe_demands,
+                                    pending_demand, placeable_free_box,
+                                    plan_migration, pressure_report)
+
+__all__ = [
+    "DefragController",
+    "MigrationPlan",
+    "Victim",
+    "dedupe_demands",
+    "pending_demand",
+    "placeable_free_box",
+    "plan_migration",
+    "pressure_report",
+]

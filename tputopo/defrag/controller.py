@@ -1,0 +1,250 @@
+"""Defragmentation controller: the rescheduling loop around the planner.
+
+One :meth:`DefragController.run_cycle` per period (the extender's defrag
+thread, or the simulator's periodic ``defrag`` event): detect pressure,
+plan, and — when every guard passes — execute the plan through the
+existing eviction/requeue path: delete the victim pods (the job
+controller / sim engine recreates them Pending, and the gang re-places
+through the normal scheduling path), then verify the target box actually
+came free.
+
+Guards, in gate order (each abort is counted and attributed):
+
+- **hysteresis**: pressure must persist for ``hysteresis`` consecutive
+  cycles before any plan executes — one transient spike of arrivals must
+  not evict running jobs.
+- **cooldown**: at least ``cooldown_s`` (caller-clock seconds) between
+  executed plans — the evicted gangs need time to re-place before the
+  next migration makes churn compound.
+- **max-concurrent**: no new plan while ``max_concurrent`` earlier
+  migrations are still in flight (an evicted job's pods exist but are
+  not yet re-bound).
+
+Observability: every cycle opens a ``defrag`` flight-recorder trace with
+``plan`` / ``evict`` / ``verify`` phase spans and an explain record (the
+plan, or the structured abort reason); executed work increments the
+Prometheus counters ``defrag_plans_considered`` / ``defrag_plans_executed``
+/ ``defrag_plans_aborted`` / ``defrag_chips_moved`` when an extender
+:class:`~tputopo.extender.scheduler.Metrics` is wired, plus the
+controller's own deterministic counter dict (the sim report's ``defrag``
+block).
+"""
+
+from __future__ import annotations
+
+import time
+
+from tputopo.defrag.planner import (MigrationPlan, dedupe_demands,
+                                    list_pods_nocopy, pending_demand,
+                                    plan_migration, target_demands)
+from tputopo.extender.state import ClusterState
+from tputopo.k8s.fakeapi import NotFound
+from tputopo.obs import NULL_TRACER
+
+
+class DefragController:
+    """Owns the defrag policy knobs and the cycle state machine.
+
+    ``evict`` is the eviction hook: called once per victim with the
+    :class:`~tputopo.defrag.planner.Victim`; the default deletes the
+    victim's pods through the API server (the production path — the job
+    controller recreates them).  The simulator injects its own hook so
+    eviction flows through the engine's requeue bookkeeping.
+
+    ``state_factory`` builds the authoritative
+    :class:`~tputopo.extender.state.ClusterState` for planning and
+    verification; the default syncs from ``api``.
+    """
+
+    #: Deterministic per-run counters (the sim report's ``defrag`` block).
+    COUNTER_KEYS = ("cycles", "no_demand", "no_pressure", "plans_considered",
+                    "plans_executed", "plans_aborted", "aborted_hysteresis",
+                    "aborted_cooldown", "aborted_concurrent",
+                    "aborted_no_plan", "jobs_evicted", "chips_moved",
+                    "boxes_restored", "verify_failed")
+
+    def __init__(self, api, *, clock=time.time, tracer=None, metrics=None,
+                 assume_ttl_s: float = 60.0, cost_for_generation=None,
+                 target_chips: int = 0, max_moves: int = 2,
+                 max_chips_moved: int = 64, cooldown_s: float = 300.0,
+                 hysteresis: int = 2, max_concurrent: int = 1,
+                 evict=None, state_factory=None) -> None:
+        self.api = api
+        self.clock = clock
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.metrics = metrics
+        self.target_chips = target_chips
+        self.max_moves = max_moves
+        self.max_chips_moved = max_chips_moved
+        self.cooldown_s = cooldown_s
+        self.hysteresis = max(1, hysteresis)
+        self.max_concurrent = max_concurrent
+        self._evict = evict if evict is not None else self._evict_via_api
+        self._state_factory = state_factory or (lambda: ClusterState(
+            api, assume_ttl_s=assume_ttl_s, clock=clock,
+            cost_for_generation=cost_for_generation).sync())
+        self.counters = {k: 0 for k in self.COUNTER_KEYS}
+        self._pressure_streak = 0
+        self._last_exec_t: float | None = None
+        # In-flight migrations: victim key -> (namespace, pod names,
+        # evicted-at).  A migration is done once every pod is re-bound;
+        # see _refresh_inflight for the missing-pod and TTL rules.
+        self._inflight: dict[str, tuple[str, tuple[str, ...], float]] = {}
+        self.last_plan: MigrationPlan | None = None  # observability
+
+    # ---- helpers -----------------------------------------------------------
+
+    def _count(self, key: str, by: int = 1) -> None:
+        self.counters[key] += by
+        if self.metrics is not None:
+            self.metrics.inc(f"defrag_{key}", by)
+
+    def _evict_via_api(self, victim) -> None:
+        for pod in victim.pods:
+            try:
+                self.api.delete("pods", pod, victim.namespace)
+            except NotFound:
+                continue  # completed/deleted meanwhile — nothing to move
+
+    def demands(self, state: ClusterState) -> list[tuple[int, int]]:
+        """The demand shapes this cycle plans for: the configured fixed
+        target when set (a within-host or whole-hosts box of
+        ``target_chips``, per domain geometry), else the pending pods'
+        shapes."""
+        if self.target_chips > 0:
+            return target_demands(state, self.target_chips)
+        return pending_demand(list_pods_nocopy(state.api))
+
+    #: In-flight entries older than this many cooldown periods (min. the
+    #: assume TTL) are abandoned: a victim whose pods never reappeared
+    #: (job cancelled, controller gone) must not hold a migration slot
+    #: forever.
+    _INFLIGHT_TTL_FLOOR_S = 60.0
+
+    def _refresh_inflight(self) -> int:
+        """Drop finished migrations; return the count still in flight.
+
+        A victim is DONE only when every pod of it is re-BOUND.  A
+        missing pod is indeterminate, not done: in the production path
+        eviction deletes the pod and the job controller recreates it a
+        beat later — observing that gap as completion would let
+        back-to-back cycles bypass the max-concurrent gate entirely.
+        Entries are abandoned (dropped) only after a TTL, covering jobs
+        that genuinely never come back."""
+        now = self.clock()
+        ttl = max(self._INFLIGHT_TTL_FLOOR_S, self.cooldown_s)
+        done = []
+        for key, (ns, pods, evicted_t) in sorted(self._inflight.items()):
+            unbound = False
+            for pod in pods:
+                try:
+                    obj = self.api.get("pods", pod, ns)
+                except NotFound:
+                    unbound = True  # deleted or not yet recreated
+                    break
+                if not obj.get("spec", {}).get("nodeName"):
+                    unbound = True  # recreated, still Pending
+                    break
+            if not unbound or now - evicted_t > ttl:
+                done.append(key)
+        for key in done:
+            del self._inflight[key]
+        return len(self._inflight)
+
+    # ---- the cycle ---------------------------------------------------------
+
+    def run_cycle(self, state: ClusterState | None = None,
+                  demands: list[tuple[int, int]] | None = None) -> dict:
+        """One defrag cycle.  Returns a deterministic record:
+        ``{"action": "noop"|"aborted"|"executed", "reason": ...,
+        "plan": <plan dict>|None, "restored": bool|None}``."""
+        self._count("cycles")
+        tr = self.tracer.start("defrag")
+        with tr:
+            return self._cycle_spanned(tr, state, demands)
+
+    def _cycle_spanned(self, tr, state, demands) -> dict:
+        with tr.phase("plan") as sp:
+            if state is None:
+                state = self._state_factory()
+            if demands is None:
+                demands = self.demands(state)
+            demands = dedupe_demands(d for d in demands
+                                     if d[0] >= 1 and d[1] >= 1
+                                     and d[0] * d[1] > 1)
+            sp.count("demand_shapes", len(demands))
+            if not demands:
+                self._pressure_streak = 0
+                self._count("no_demand")
+                return self._done(tr, "noop", "no_demand")
+            # Planning doubles as the pressure test: a plan search that
+            # finds every demand placeable (or no domain pressured) is
+            # the "no pressure" outcome; the plan itself is only ACTED on
+            # once the guards pass.  ``pressured`` collects the shapes
+            # the one scan found pressured — no second pass to classify
+            # a None return.
+            self._count("plans_considered")
+            pressured: list = []
+            plan = plan_migration(state, demands, max_moves=self.max_moves,
+                                  max_chips_moved=self.max_chips_moved,
+                                  pressured_out=pressured)
+            self.last_plan = plan
+            if plan is None:
+                if not pressured:
+                    self._pressure_streak = 0
+                    self._count("no_pressure")
+                    return self._done(tr, "noop", "no_pressure")
+                self._pressure_streak += 1
+                self._count("plans_aborted")
+                self._count("aborted_no_plan")
+                return self._done(tr, "aborted", "no_plan_within_budget")
+            self._pressure_streak += 1
+            sp.count("victims", len(plan.victims))
+            if self._pressure_streak < self.hysteresis:
+                self._count("plans_aborted")
+                self._count("aborted_hysteresis")
+                return self._done(tr, "aborted", "hysteresis", plan)
+            now = self.clock()
+            if (self._last_exec_t is not None
+                    and now - self._last_exec_t < self.cooldown_s):
+                self._count("plans_aborted")
+                self._count("aborted_cooldown")
+                return self._done(tr, "aborted", "cooldown", plan)
+            if self._refresh_inflight() >= self.max_concurrent:
+                self._count("plans_aborted")
+                self._count("aborted_concurrent")
+                return self._done(tr, "aborted", "max_concurrent", plan)
+
+        with tr.phase("evict") as sp:
+            for victim in plan.victims:
+                self._evict(victim)
+                self._inflight[victim.key] = (victim.namespace, victim.pods,
+                                              self.clock())
+            sp.count("jobs", len(plan.victims))
+            sp.count("chips", plan.chips_moved)
+            self._count("plans_executed")
+            self._count("jobs_evicted", len(plan.victims))
+            self._count("chips_moved", plan.chips_moved)
+            self._last_exec_t = self.clock()
+            self._pressure_streak = 0
+
+        with tr.phase("verify") as sp:
+            after = self._state_factory()
+            dom = after.domains.get(plan.slice_id)
+            restored = (dom is not None
+                        and plan.box_mask & dom.allocator.used_mask == 0)
+            sp.count("restored" if restored else "failed")
+            self._count("boxes_restored" if restored else "verify_failed")
+        return self._done(tr, "executed",
+                          "restored" if restored else "box_not_free",
+                          plan, restored)
+
+    def _done(self, tr, action: str, reason: str,
+              plan: MigrationPlan | None = None,
+              restored: bool | None = None) -> dict:
+        record = {"action": action, "reason": reason,
+                  "plan": plan.describe() if plan is not None else None,
+                  "restored": restored}
+        if tr.enabled:
+            tr.explain({"verb": "defrag", **record})
+        return record

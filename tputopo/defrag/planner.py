@@ -1,0 +1,454 @@
+"""Migration planner: fragmentation pressure detection + bounded-cost
+eviction plans that restore a contiguous free box.
+
+Pressure is *demand-relative*: a domain is fragmented when the pending
+(or typical) gang shapes cannot place — no free box of the right shape
+exists — while the domain holds enough free chips that compaction would
+fit them.  The planner then searches the precomputed box vocabulary
+(:func:`tputopo.topology.slices._boxes_for` masks — the same geometry
+the allocator places with) for a target box whose occupants form the
+*cheapest* evictable set: fewest chips moved, fewest jobs touched, best
+restored bandwidth as the tiebreak, under a hard budget
+(``max_moves`` jobs / ``max_chips_moved`` chips).  No candidate within
+budget means **do nothing** — a plan is always optional.
+
+Placeability is host-aware, not just chip-contiguous: a pod's chips
+must live on one node, so a single-pod demand needs a box inside ONE
+host, and a gang of ``r`` members needs a HOST-ALIGNED box (a union of
+whole hosts — the host-grid box the gang planner binds into).  A
+restored box that crosses host boundaries the wrong way would look free
+and still place nothing; the planner never proposes one.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tputopo.extender.scheduler import (LABEL_ALLOW_MULTISLICE, _gang_of,
+                                        _host_grid)
+from tputopo.extender.state import ClusterState, SliceDomain
+from tputopo.k8s import objects as ko
+from tputopo.topology.model import ChipTopology, Coord
+from tputopo.topology.slices import (Allocator, _boxes_for, _chip_masks,
+                                     _topo_key, chips_mask, enumerate_shapes)
+
+
+@dataclass(frozen=True)
+class Victim:
+    """One running job (a whole gang, or a lone pod) the plan evicts.
+    Gangs are atomic — evicting one member evicts them all — so the
+    victim's cost counts every chip the job holds, in every domain."""
+
+    key: str                       # "namespace/gang-id" or "namespace/pod"
+    namespace: str
+    gang_id: str | None
+    pods: tuple[str, ...]          # member pod names, sorted
+    chips_held: int                # total chips freed by evicting this job
+
+    def describe(self) -> dict:
+        return {"key": self.key, "namespace": self.namespace,
+                "gang": self.gang_id, "pods": list(self.pods),
+                "chips_held": self.chips_held}
+
+
+@dataclass(frozen=True)
+class MigrationPlan:
+    """The cheapest within-budget eviction set restoring one target box."""
+
+    slice_id: str
+    demand: tuple[int, int]        # (replicas, chips_per_member) served
+    target_dims: tuple[int, ...]
+    box_chips: tuple[Coord, ...]
+    box_mask: int
+    victims: tuple[Victim, ...]
+    chips_moved: int               # total chips the evicted jobs held
+    chips_to_clear: int            # occupied chips inside the target box
+    predicted_gbps: float          # bandwidth of the restored box
+
+    def describe(self) -> dict:
+        """JSON-safe plan record (the /debug/defrag and explain shape)."""
+        return {
+            "slice": self.slice_id,
+            "demand": {"replicas": self.demand[0],
+                       "chips_per_member": self.demand[1]},
+            "target_dims": list(self.target_dims),
+            "box_chips": [list(c) for c in self.box_chips],
+            "victims": [v.describe() for v in self.victims],
+            "jobs_evicted": len(self.victims),
+            "chips_moved": self.chips_moved,
+            "chips_to_clear": self.chips_to_clear,
+            "predicted_gbps": round(self.predicted_gbps, 3),
+        }
+
+
+# ---- demand -----------------------------------------------------------------
+
+
+def dedupe_demands(pairs) -> list[tuple[int, int]]:
+    """Distinct (replicas, chips_per_member) demand shapes, largest total
+    first (restoring the biggest box serves every smaller shape too)."""
+    return sorted(set(pairs), key=lambda rk: (-(rk[0] * rk[1]), -rk[0]))
+
+
+def list_pods_nocopy(api) -> list[dict]:
+    """Read-only pod listing, copy-free where the reader supports the
+    hint (informer mirror / fake API nocopy) — the shared shim for every
+    defrag consumer (controller demand derivation, /debug/defrag)."""
+    try:
+        return api.list("pods", copy=False)
+    except TypeError:  # reader without a copy kwarg (fake/REST client)
+        return api.list("pods")
+
+
+def pending_demand(pods) -> list[tuple[int, int]]:
+    """Demand shapes of the Pending (unbound) pods: per gang, the
+    REMAINING members still waiting to place (the scheduler extends a
+    partially-bound gang — it never re-places the bound members, so a
+    gang with 3 of 4 bound demands a 1-host box, not 4); ``(1, k)`` per
+    lone pod.  Multislice-labeled gangs are excluded — they can split
+    across domains, so no single contiguous box gates them.  Malformed
+    gang labels are skipped (a hand-written pod must not wedge the
+    planner)."""
+    out: set[tuple[int, int]] = set()
+    # (namespace, gang_id) -> [declared size, k, bound members seen]
+    gangs: dict[tuple[str, str], list] = {}
+    multislice: set[tuple[str, str]] = set()
+    for p in pods:
+        k = ko.pod_requested_chips(p)
+        if k <= 0:
+            continue
+        md = p.get("metadata", {})
+        meta = {**md.get("annotations", {}), **md.get("labels", {})}
+        try:
+            gang = _gang_of(p)
+        except ValueError:
+            continue
+        bound = bool(p.get("spec", {}).get("nodeName"))
+        if gang is None:
+            if not bound and meta.get(LABEL_ALLOW_MULTISLICE) != "true":
+                out.add((1, k))
+            continue
+        ns, gid, size = gang
+        rec = gangs.setdefault((ns, gid), [size, k, 0])
+        if bound:
+            rec[2] += 1
+        if meta.get(LABEL_ALLOW_MULTISLICE) == "true":
+            multislice.add((ns, gid))
+    for key, (size, k, bound) in gangs.items():
+        if key in multislice:
+            continue
+        remaining = size - bound
+        if remaining >= 1:
+            out.add((remaining, k))
+    return dedupe_demands(out)
+
+
+def target_demands(state: ClusterState, chips: int) -> list[tuple[int, int]]:
+    """Translate an explicit chip-volume target (``defrag_target_chips``,
+    ``/debug/defrag?target=K``) into demand shapes: a within-host box
+    where a host can hold it, else a gang of whole hosts — per domain,
+    since chips-per-host varies across generations."""
+    out: set[tuple[int, int]] = set()
+    for dom in state.domains.values():
+        cph = _chips_per_host(dom.topology)
+        if chips <= cph:
+            out.add((1, chips))
+        else:
+            out.add((-(-chips // cph), cph))
+    return dedupe_demands(out)
+
+
+# ---- placeable-box geometry -------------------------------------------------
+#
+# Cached per (topology value, dims, mode) like the allocator's own box
+# tables: "chip" keeps only boxes inside ONE host (single-pod demand),
+# "host" keeps only host-aligned boxes (gang demand — a union of whole
+# hosts, i.e. a host-grid box).
+
+_USABLE_CACHE: dict[tuple, list[tuple[tuple[Coord, ...], int, int]]] = {}
+
+
+def _usable_boxes(topo: ChipTopology, dims: tuple[int, ...],
+                  mode: str) -> list[tuple[tuple[Coord, ...], int, int]]:
+    """[(chips, box_mask, neighbor_mask)] of the placeable boxes."""
+    key = (_topo_key(topo), dims, mode)
+    got = _USABLE_CACHE.get(key)
+    if got is None:
+        _, host_mask = _chip_masks(topo)
+        got = []
+        for _o, chips, mask, nbr in _boxes_for(topo, dims):
+            if mode == "chip":
+                i = (mask & -mask).bit_length() - 1
+                if mask & ~host_mask[i]:
+                    continue  # straddles hosts — one pod cannot hold it
+            else:  # "host": every touched host fully inside the box
+                union = 0
+                m = mask
+                while m:
+                    b = m & -m
+                    union |= host_mask[b.bit_length() - 1]
+                    m &= ~union
+                if union != mask:
+                    continue
+            got.append((chips, mask, nbr))
+        _USABLE_CACHE[key] = got
+    return got
+
+
+def _chips_per_host(topo: ChipTopology) -> int:
+    return topo.num_chips // max(1, topo.num_hosts)
+
+
+def _demand_box(dom: SliceDomain,
+                demand: tuple[int, int]) -> tuple[int, str] | None:
+    """(box volume, mode) a demand needs in ``dom``, or None when the
+    domain can never host it (too many replicas / chips per host)."""
+    replicas, k = demand
+    topo = dom.topology
+    cph = _chips_per_host(topo)
+    if k > cph or k < 1 or replicas < 1:
+        return None
+    if replicas == 1:
+        return k, "chip"
+    if replicas > topo.num_hosts:
+        return None
+    # A gang box is replicas WHOLE hosts: members take k <= cph chips
+    # each, but the restored region must align to host boundaries.
+    return replicas * cph, "host"
+
+
+def placeable_free_box(dom: SliceDomain, demand: tuple[int, int]) -> bool:
+    """True when ``demand`` can place in ``dom`` RIGHT NOW — judged with
+    the placer's OWN search (per-host ``Allocator.find`` with its blob
+    fallback; the gang planner's host-grid search for multi-replica
+    demands), never a stricter geometric shortcut: pressure declared for
+    a demand the scheduler could already place would evict running jobs
+    for nothing.  The *restored-box target* stays box-shaped and
+    host-aligned (that is the defrag goal); only this gate is
+    placer-exact."""
+    replicas, k = demand
+    topo = dom.topology
+    if (k < 1 or replicas < 1 or k > _chips_per_host(topo)
+            or replicas > topo.num_hosts):
+        return False
+    alloc = dom.allocator
+    free_mask = alloc.free_mask
+    hosts: set[Coord] = set()
+    for host in sorted(dom.node_by_host):
+        node = dom.node_by_host[host]
+        node_mask = dom.node_masks.get(node, 0)
+        node_free = node_mask & free_mask
+        if node_free.bit_count() < k:
+            continue
+        if alloc.find(k, free_mask=node_free,
+                      within_mask=node_mask) is not None:
+            if replicas == 1:
+                return True
+            hosts.add(host)
+    if replicas == 1 or len(hosts) < replicas:
+        return False
+    # The gang planner's own host-grid search (scheduler._plan_gang):
+    # prefer-a-box with connected-blob fallback over the feasible hosts.
+    hb = topo.generation.host_bounds
+    grid_dims = tuple(max(1, d // b) for d, b in zip(topo.dims, hb))
+    host_grid = _host_grid(topo.generation, grid_dims, topo.wrap)
+    host_alloc = Allocator(host_grid, alloc.cost)
+    host_alloc.mark_used([h for h in host_grid.chips if h not in hosts])
+    return host_alloc.find(replicas) is not None
+
+
+# ---- pressure + planning ----------------------------------------------------
+
+
+class _VictimRec:
+    """Internal victim accumulator: per-domain chip masks + identity."""
+
+    __slots__ = ("key", "namespace", "gang_id", "pods", "masks", "chips")
+
+    def __init__(self, key: str, namespace: str, gang_id: str | None) -> None:
+        self.key = key
+        self.namespace = namespace
+        self.gang_id = gang_id
+        self.pods: set[str] = set()
+        self.masks: dict[str, int] = {}
+        self.chips = 0
+
+    def to_victim(self) -> Victim:
+        return Victim(key=self.key, namespace=self.namespace,
+                      gang_id=self.gang_id, pods=tuple(sorted(self.pods)),
+                      chips_held=self.chips)
+
+
+def _victim_index(state: ClusterState) -> dict[str, _VictimRec]:
+    """Evictable-unit index over the state's occupancy: one record per
+    gang (all members — gangs are atomic) or lone pod, keyed
+    "namespace/gang-id" / "namespace/pod-name".  Deterministic: built
+    from the sorted occupancy records."""
+    recs: dict[str, _VictimRec] = {}
+    for ns, name, sid, held, gang_id, _assigned in state.occupancy_records():
+        key = f"{ns}/{gang_id}" if gang_id else f"{ns}/{name}"
+        rec = recs.get(key)
+        if rec is None:
+            rec = recs[key] = _VictimRec(key, ns, gang_id)
+        rec.pods.add(name)
+        dom = state.domains[sid]
+        rec.masks[sid] = rec.masks.get(sid, 0) | chips_mask(dom.topology,
+                                                            held)
+        rec.chips += len(held)
+    return recs
+
+
+def pressure_report(state: ClusterState, demands: list[tuple[int, int]],
+                    placeable: dict | None = None) -> dict:
+    """Observability: per-domain free/largest-free-box plus, per demand
+    shape, whether it can place anywhere right now — the /debug/defrag
+    summary block.  ``placeable`` (a ``{demand: bool}`` map, e.g.
+    :func:`plan_migration`'s ``placeable_out``) skips re-running the
+    placer-exact scan the plan call already paid for."""
+    domains = {}
+    for sid in sorted(state.domains):
+        dom = state.domains[sid]
+        largest = dom.allocator.largest_free_box()
+        domains[sid] = {
+            "free_chips": dom.allocator.free_count,
+            "largest_free_box": list(largest[1]) if largest else None,
+        }
+    out = {}
+    for demand in demands:
+        got = placeable.get(demand) if placeable is not None else None
+        if got is None:
+            got = any(placeable_free_box(state.domains[sid], demand)
+                      for sid in sorted(state.domains))
+        out[f"{demand[0]}x{demand[1]}"] = got
+    return {"domains": domains, "demand_placeable": out}
+
+
+def plan_migration(state: ClusterState, demands: list[tuple[int, int]], *,
+                   max_moves: int = 2, max_chips_moved: int = 64,
+                   pressured_out: list | None = None,
+                   placeable_out: dict | None = None) -> MigrationPlan | None:
+    """The cheapest within-budget migration plan serving the largest
+    pressured demand, or None (the do-nothing fallback).
+
+    Per demand (largest first): skip it if it can place somewhere
+    already; otherwise, in every domain with enough TOTAL free chips,
+    scan the demand's usable-box vocabulary and cost each candidate box
+    by the evictable units occupying it.  Boxes touching immovable
+    occupancy (unhealthy chips, conflict leftovers) are infeasible, and
+    a plan must be a NET contiguity gain: the chips it disturbs stay
+    strictly below the box volume it restores (evicting one gang to seat
+    another is churn, not defragmentation), whatever ``max_chips_moved``
+    allows.  Ranking: fewest chips moved, fewest jobs, best restored-box
+    bandwidth, most contact with already-free chips (the restored box
+    should extend a free region, not open an isolated hole), then
+    deterministic (box chips, domain id).
+
+    ``pressured_out``, when given, collects the demand shapes found
+    PRESSURED (not placeable anywhere, yet compaction-feasible in some
+    domain) — whether or not a plan fit the budget, so the caller never
+    re-runs this scan just to classify a None return.  ``placeable_out``
+    likewise receives each demand's placeable-anywhere verdict (what
+    :func:`pressure_report` consumes instead of rescanning)."""
+    victims = None  # built lazily — pressure usually absent
+    for demand in demands:
+        doms = [state.domains[sid] for sid in sorted(state.domains)]
+        needs = {d.slice_id: _demand_box(d, demand) for d in doms}
+        candidates = [d for d in doms if needs[d.slice_id] is not None]
+        placeable = any(placeable_free_box(d, demand) for d in candidates)
+        if placeable_out is not None:
+            placeable_out[demand] = placeable
+        if not candidates:
+            continue
+        if placeable:
+            continue  # no pressure: the scheduler can place this now
+        if pressured_out is not None and any(
+                d.allocator.free_count >= needs[d.slice_id][0]
+                for d in candidates):
+            pressured_out.append(demand)
+        best_key = None
+        best_plan: MigrationPlan | None = None
+        for dom in candidates:
+            volume, mode = needs[dom.slice_id]
+            alloc = dom.allocator
+            if alloc.free_count < volume:
+                continue  # compaction could not fit it either
+            if victims is None:
+                victims = _victim_index(state)
+            by_chip: dict[int, _VictimRec] = {}
+            movable = 0
+            for rec in victims.values():
+                m = rec.masks.get(dom.slice_id, 0)
+                movable |= m
+                while m:
+                    b = m & -m
+                    m ^= b
+                    by_chip[b.bit_length() - 1] = rec
+            immovable = alloc.used_mask & ~movable
+            free_mask = alloc.free_mask
+            # Chips not covered by any PRESENT node (a failed/deleted
+            # node's silicon): the allocator counts them free, but no pod
+            # can ever land there — a box touching them would "restore"
+            # capacity that cannot place (observed as zero-victim plans
+            # on traces with node failures).
+            present = 0
+            for node in dom.host_by_node:
+                present |= dom.node_masks.get(node, 0)
+            # Net-gain budget: never disturb as many chips as the box
+            # yields, whatever the configured ceiling allows.
+            budget = min(max_chips_moved, volume - 1)
+            for shape in enumerate_shapes(dom.topology, volume, alloc.cost):
+                gbps = _shape_gbps(dom, shape.dims)
+                for chips, mask, nbr in _usable_boxes(dom.topology,
+                                                      shape.dims, mode):
+                    if mask & ~present:
+                        continue  # box touches absent-node silicon
+                    occ = mask & alloc.used_mask
+                    if not occ:
+                        # A fully-free usable box contradicts the
+                        # placeable gate — defensive: an empty eviction
+                        # would still burn the cooldown for nothing.
+                        continue
+                    if occ & immovable:
+                        continue
+                    box_victims: dict[str, _VictimRec] = {}
+                    m = occ
+                    while m:
+                        b = m & -m
+                        m ^= b
+                        rec = by_chip[b.bit_length() - 1]
+                        box_victims[rec.key] = rec
+                    if len(box_victims) > max_moves:
+                        continue
+                    moved = sum(r.chips for r in box_victims.values())
+                    if moved > budget:
+                        continue
+                    free_contact = (nbr & free_mask).bit_count()
+                    key = (moved, len(box_victims), -gbps, -free_contact,
+                           chips, dom.slice_id)
+                    if best_key is None or key < best_key:
+                        best_key = key
+                        best_plan = MigrationPlan(
+                            slice_id=dom.slice_id,
+                            demand=demand,
+                            target_dims=shape.dims,
+                            box_chips=chips,
+                            box_mask=mask,
+                            victims=tuple(
+                                box_victims[k].to_victim()
+                                for k in sorted(box_victims)),
+                            chips_moved=moved,
+                            chips_to_clear=occ.bit_count(),
+                            predicted_gbps=gbps,
+                        )
+        if best_plan is not None:
+            return best_plan
+        # Largest demand pressured but unplannable within budget: fall
+        # through to the next demand shape — a smaller box may be both
+        # pressured and affordable.
+    return None
+
+
+def _shape_gbps(dom: SliceDomain, dims: tuple[int, ...]) -> float:
+    from tputopo.topology.score import predict_allreduce_gbps
+
+    return predict_allreduce_gbps(dom.topology, dims, dom.allocator.cost)

@@ -59,6 +59,21 @@ class ExtenderConfig:
     # 200): long-horizon incident forensics can raise it, memory-tight
     # deployments can shrink it.
     decisions_retention: int = 200
+    # Defragmentation loop (tputopo.defrag): opt-in background cycle that
+    # evicts the cheapest blocking jobs when pending gang shapes cannot
+    # place despite enough free chips.  The dry-run plan is always served
+    # at GET /debug/defrag (these knobs bound its search); the executing
+    # controller thread only runs when defrag_enabled is true.
+    defrag_enabled: bool = False
+    defrag_period_s: float = 60.0        # controller cycle period
+    defrag_target_chips: int = 0         # 0 = derive demand from Pending pods
+    defrag_max_moves: int = 1            # plan budget: jobs evicted per plan
+                                         # (single-victim plans won every
+                                         # axis in the sim knob sweep)
+    defrag_max_chips_moved: int = 64     # plan budget: chips disturbed
+    defrag_cooldown_s: float = 300.0     # min seconds between executed plans
+    defrag_hysteresis: int = 2           # consecutive pressured cycles first
+    defrag_max_concurrent: int = 1       # in-flight migrations cap
     # Per-generation LinkCostModel field overrides, e.g.
     # {"v5p": {"ici_link_gbps": 95.0, "dcn_host_gbps": 42.0}} — the explicit,
     # measured replacement for the reference's TODO weight table.

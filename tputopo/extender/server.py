@@ -99,6 +99,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._handle_state()
             elif url.path == "/debug/traces":
                 self._handle_traces(url.query)
+            elif url.path == "/debug/defrag":
+                self._handle_defrag(url.query)
             elif url.path == "/policy":
                 self._send_json(200, self.config.policy_json())
             else:
@@ -152,6 +154,55 @@ class _Handler(BaseHTTPRequestHandler):
             "enabled": tracer.enabled,
             "recorded": tracer.recorded,
             "traces": tracer.traces(n),
+        })
+
+    def _handle_defrag(self, query: str) -> None:
+        """GET /debug/defrag[?target=K] — DRY-RUN migration plan: the
+        per-domain pressure summary (free chips, largest free box,
+        per-demand placeability) plus the plan the defrag controller
+        WOULD execute under the config budget, or null (the do-nothing
+        fallback).  Never evicts anything; ``?target=K`` overrides the
+        demand derivation with one K-chip single-pod shape."""
+        from tputopo.defrag.planner import (list_pods_nocopy, pending_demand,
+                                            plan_migration, pressure_report,
+                                            target_demands)
+
+        qs = urllib.parse.parse_qs(query)
+        try:
+            target = int(qs.get("target", ["0"])[0])
+        except (ValueError, TypeError):
+            self.scheduler.metrics.inc("bad_requests")
+            self._send_json(400, {"error": f"bad target in query {query!r}"})
+            return
+        sched = self.scheduler
+        cfg = self.config
+        reader = (sched.informer if sched.informer is not None
+                  and sched.informer.synced else None)
+        state = sched._state(allow_cache=True, reader=reader)
+        if target <= 0:
+            target = cfg.defrag_target_chips
+        if target > 0:
+            demands = target_demands(state, target)
+        else:
+            demands = pending_demand(list_pods_nocopy(
+                reader if reader is not None else sched.api))
+        placeable: dict = {}
+        plan = plan_migration(state, demands,
+                              max_moves=cfg.defrag_max_moves,
+                              max_chips_moved=cfg.defrag_max_chips_moved,
+                              placeable_out=placeable)
+        self._send_json(200, {
+            "enabled": cfg.defrag_enabled,
+            "dry_run": True,
+            "demands": [{"replicas": r, "chips_per_member": k}
+                        for r, k in demands],
+            "pressure": pressure_report(state, demands, placeable),
+            "plan": plan.describe() if plan is not None else None,
+            "budget": {"max_moves": cfg.defrag_max_moves,
+                       "max_chips_moved": cfg.defrag_max_chips_moved,
+                       "cooldown_s": cfg.defrag_cooldown_s,
+                       "hysteresis": cfg.defrag_hysteresis,
+                       "max_concurrent": cfg.defrag_max_concurrent},
         })
 
     def _handle_sort(self) -> None:
@@ -338,6 +389,43 @@ def main() -> None:  # pragma: no cover - thin CLI wrapper
                 print(f"gc: released stale assumptions for {released}")
 
     threading.Thread(target=gc_loop, name="tputopo-gc", daemon=True).start()
+
+    if config.defrag_enabled:
+        # Defragmentation loop (tputopo.defrag): periodic controller
+        # cycles against the authoritative API, sharing the scheduler's
+        # Metrics (defrag_* Prometheus counters) and flight recorder
+        # ("defrag" traces in /debug/traces).
+        from tputopo.defrag import DefragController
+
+        defrag = DefragController(
+            api_server, metrics=scheduler.metrics, tracer=scheduler.tracer,
+            assume_ttl_s=config.assume_ttl_s,
+            cost_for_generation=config.cost_model,
+            target_chips=config.defrag_target_chips,
+            max_moves=config.defrag_max_moves,
+            max_chips_moved=config.defrag_max_chips_moved,
+            cooldown_s=config.defrag_cooldown_s,
+            hysteresis=config.defrag_hysteresis,
+            max_concurrent=config.defrag_max_concurrent)
+
+        def defrag_loop() -> None:
+            while not stop.wait(max(1.0, config.defrag_period_s)):
+                try:
+                    rec = defrag.run_cycle()
+                except Exception as e:  # API blip must not kill the loop
+                    print(f"defrag: cycle failed ({type(e).__name__}: {e}); "
+                          "retrying")
+                    continue
+                if rec["action"] == "executed":
+                    plan = rec["plan"] or {}
+                    print(f"defrag: evicted {plan.get('jobs_evicted', 0)} "
+                          f"job(s) / {plan.get('chips_moved', 0)} chips to "
+                          f"restore {plan.get('target_dims')} in "
+                          f"{plan.get('slice')}")
+
+        threading.Thread(target=defrag_loop, name="tputopo-defrag",
+                         daemon=True).start()
+
     print(f"tputopo extender listening on {server.address} "
           f"(prefix {config.url_prefix}, gc every {config.assume_ttl_s / 2:.0f}s)")
     server.start()

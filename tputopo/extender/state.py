@@ -226,8 +226,6 @@ class ClusterState:
 
         now = self.clock()
         self._synced_at = now
-        valid_chips = {sid: set(dom.topology.chips)
-                       for sid, dom in self.domains.items()}
         pods = sorted(
             self._list("pods"),
             key=lambda p: (
@@ -252,16 +250,33 @@ class ClusterState:
                 self._pod_index[key] = _PodRec(pa, dom.slice_id, "expired", ())
                 continue
             dom.assignments.append(pa)
-            valid = valid_chips[dom.slice_id]
-            fresh = [c for c in dict.fromkeys(pa.chips)
-                     if c in valid and c not in dom.allocator.used]
-            if len(fresh) != len(pa.chips):
+            # Mask-native freshness: one bitmask accumulation instead of
+            # materializing the allocator's coord-set `used` view per pod
+            # (the view cache is invalidated by every mark_used, so the
+            # old per-pod set membership rebuilt it O(chips) per
+            # assignment — a measured sim-wall item).  Out-of-slice
+            # coords, duplicates within the group, and overlaps with
+            # earlier claimants all drop out of the mask; any drop flags
+            # the conflict exactly as the set-based filter did.
+            alloc = dom.allocator
+            fresh_mask = 0
+            taken = alloc.used_mask
+            for c in pa.chips:
+                i = alloc._index.get(c)
+                if i is None:
+                    continue
+                b = 1 << i
+                if b & (taken | fresh_mask):
+                    continue
+                fresh_mask |= b
+            if fresh_mask.bit_count() != len(pa.chips):
                 # Overlap or out-of-slice chips: first pod keeps the chips,
                 # later claimants are flagged (fragmentation_report surfaces
                 # them; the operator or job controller resolves).
                 self.conflicts.append(pa)
                 dom.conflicts.append(pa)
-            dom.allocator.mark_used(fresh)
+            fresh = alloc.chips_of_mask(fresh_mask)
+            alloc.mark_used(fresh)
             self._pod_index[key] = _PodRec(pa, dom.slice_id, "active",
                                            tuple(fresh))
             if any(c in dom.unhealthy for c in pa.chips):
@@ -270,10 +285,13 @@ class ClusterState:
                 # accounted to the pod until it is deleted/re-placed.
                 dom.on_unhealthy.append(pa)
         # Dead chips are not placeable: mark the remainder used so no
-        # selector, gang plan, or k=1 pick can touch them.
+        # selector, gang plan, or k=1 pick can touch them (mask-native:
+        # one AND against the free mask, no coord-set view build).
         for dom in self.domains.values():
-            dom.allocator.mark_used(
-                [c for c in dom.unhealthy if c not in dom.allocator.used])
+            add = chips_mask(dom.topology, dom.unhealthy) \
+                & dom.allocator.free_mask
+            if add:
+                dom.allocator.mark_used(dom.allocator.chips_of_mask(add))
         return self
 
     def _domain_of_node(self, node_name: str) -> SliceDomain | None:
@@ -581,6 +599,20 @@ class ClusterState:
         if dom is None:
             return 0
         return dom.node_masks.get(node_name, 0) & dom.allocator.free_mask
+
+    def occupancy_records(self):
+        """Every pod currently holding chips, as ``(namespace, pod_name,
+        slice_id, held_chips, gang_id, assigned)`` tuples in sorted
+        (namespace, pod) order — the defrag planner's victim universe.
+        ``held_chips`` is the subset the pod actually occupies in the
+        allocator (conflicted claims excluded), so a plan built from
+        these records frees exactly what eviction frees."""
+        out = []
+        for (ns, name), rec in sorted(self._pod_index.items()):
+            if rec.status == "active" and rec.held:
+                out.append((ns, name, rec.sid, rec.held, rec.pa.gang_id,
+                            rec.pa.assigned))
+        return out
 
     def fragmentation_report(self) -> dict:
         """Observability: per-domain free/used and largest free box — the

@@ -109,8 +109,18 @@ class FakeApiServer:
         # Watch machinery: a bounded per-server event log + a condition the
         # watchers block on.  Event = {"type": ADDED|MODIFIED|DELETED,
         # "kind": ..., "rv": int, "object": deepcopy-at-emit}.
+        #
+        # The deepcopy-at-emit is LAZY: until the first watch consumer
+        # attaches (a watch() or list_with_version() call), _emit logs
+        # nothing — it only advances the unlogged floor.  A server with no
+        # watchers (the sim drives thousands of mutations per trace and
+        # never watches) pays zero emit copies; a watcher asking for a
+        # resourceVersion older than the floor gets Gone and relists,
+        # exactly as if the window had scrolled past it.
         self._watch_log: list[dict] = []
         self._watch_cond = threading.Condition(self._lock)
+        self._watch_attached = False
+        self._watch_floor = 0  # rv of the newest UNLOGGED event
         # Nocopy mutation guard (debug mode, off by default): when enabled,
         # every nocopy read records (resourceVersion, content digest); a
         # later read or server write that finds the content changed at an
@@ -162,10 +172,24 @@ class FakeApiServer:
         obj["metadata"]["resourceVersion"] = str(self._rv)
 
     def _emit(self, type_: str, kind: str, obj: dict) -> None:
+        if not self._watch_attached:
+            # No watcher has ever attached: nobody can be blocked on the
+            # condition, and the event can never be replayed (floor rule in
+            # watch()) — skip the log append AND its deepcopy (~10% of sim
+            # wall at fleet scale).
+            self._watch_floor = self._rv
+            return
         self._watch_log.append({"type": type_, "kind": kind, "rv": self._rv,
                                 "object": copy.deepcopy(obj)})
         del self._watch_log[:-_WATCH_WINDOW]
         self._watch_cond.notify_all()
+
+    def _attach_watch(self) -> None:
+        """First watch consumer: deepcopy-at-emit logging starts now.
+        Anything older than the floor is unreconstructable (it was never
+        logged) — watch() answers Gone for it, the standard relist path."""
+        with self._lock:
+            self._watch_attached = True
 
     def _store(self, kind: str) -> dict[tuple[str, str], dict]:
         return self._objects[kind]
@@ -298,8 +322,12 @@ class FakeApiServer:
 
     def list_with_version(self, kind: str) -> tuple[list[dict], str]:
         """(items, list resourceVersion) — the informer's initial sync point:
-        a watch from this rv sees exactly the mutations after this list."""
+        a watch from this rv sees exactly the mutations after this list.
+        Attaches the watch log (lazy-emit opt-out ends here): every event
+        after the returned rv is guaranteed logged, so the follow-up watch
+        never gets a spurious Gone for the list-to-watch gap."""
         with self._lock:
+            self._watch_attached = True
             out = [copy.deepcopy(o) for o in self._store(kind).values()]
             rv = str(self._rv)
         out.sort(key=lambda o: (o["metadata"].get("namespace", ""),
@@ -311,14 +339,22 @@ class FakeApiServer:
         """Yield events for ``kind`` with rv > resource_version, blocking up
         to ``timeout_s`` for new ones; returns on timeout (the caller
         re-watches from its last seen rv, exactly the K8s watch contract).
-        Raises Gone when resource_version predates the retained window."""
+        Raises Gone when resource_version predates the retained window —
+        or predates the lazy-emit floor (events before the first watch
+        consumer attached were never logged; the caller relists, the same
+        recovery as a scrolled window)."""
         try:
             last = int(resource_version)
         except (TypeError, ValueError):
             raise ValueError(f"bad resourceVersion {resource_version!r}") from None
+        self._attach_watch()
         deadline = time.monotonic() + timeout_s
         while True:
             with self._watch_cond:
+                if last < self._watch_floor:
+                    raise Gone(f"resourceVersion {last} too old (events "
+                               f"through {self._watch_floor} predate the "
+                               "first watch attach)")
                 if self._watch_log and last < self._watch_log[0]["rv"] - 1:
                     raise Gone(f"resourceVersion {last} too old "
                                f"(window starts at {self._watch_log[0]['rv']})")

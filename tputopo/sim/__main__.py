@@ -14,7 +14,7 @@ import json
 import sys
 import time
 
-from tputopo.sim.engine import run_trace
+from tputopo.sim.engine import DEFAULT_DEFRAG, run_trace
 from tputopo.sim.policies import available_policies
 from tputopo.sim.trace import TraceConfig
 
@@ -55,6 +55,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "(each policy's engine run is independent; the "
                         "report is byte-identical to --jobs 1 modulo the "
                         "wall-clock throughput block)")
+    p.add_argument("--defrag", action="store_true",
+                   help="run the periodic defragmentation cycle "
+                        "(tputopo.defrag) in every engine: evict the "
+                        "cheapest blocking jobs when queued gang shapes "
+                        "cannot place despite enough free chips; adds the "
+                        "per-policy defrag block (schema tputopo.sim/v3)")
+    p.add_argument("--defrag-period", type=float,
+                   default=DEFAULT_DEFRAG["period_s"],
+                   help="defrag cycle period (virtual seconds)")
+    p.add_argument("--defrag-max-moves", type=int,
+                   default=DEFAULT_DEFRAG["max_moves"],
+                   help="plan budget: max jobs evicted per cycle")
+    p.add_argument("--defrag-max-chips", type=int,
+                   default=DEFAULT_DEFRAG["max_chips_moved"],
+                   help="plan budget: max chips moved per cycle")
+    p.add_argument("--defrag-cooldown", type=float,
+                   default=DEFAULT_DEFRAG["cooldown_s"],
+                   help="min virtual seconds between executed plans")
+    p.add_argument("--defrag-hysteresis", type=int,
+                   default=DEFAULT_DEFRAG["hysteresis"],
+                   help="consecutive pressured cycles before acting")
     p.add_argument("--out", default=None, help="also write the report here")
     p.add_argument("--no-trace", action="store_true",
                    help="disable the flight recorder (NullTracer hot "
@@ -93,6 +114,13 @@ def main(argv: list[str] | None = None) -> int:
         node_failures=args.node_failures,
     )
     flight_trace = not args.no_trace
+    defrag = None
+    if args.defrag:
+        defrag = {"period_s": args.defrag_period,
+                  "max_moves": args.defrag_max_moves,
+                  "max_chips_moved": args.defrag_max_chips,
+                  "cooldown_s": args.defrag_cooldown,
+                  "hysteresis": args.defrag_hysteresis}
     t0 = time.perf_counter()
     if args.profile:
         # Profiling output is telemetry like the wall clock: stderr only,
@@ -109,6 +137,7 @@ def main(argv: list[str] | None = None) -> int:
                                    assume_ttl_s=args.assume_ttl,
                                    gc_period_s=args.gc_period,
                                    flight_trace=flight_trace,
+                                   defrag=defrag,
                                    return_states=True)
         prof.disable()
         buf = io.StringIO()
@@ -120,6 +149,7 @@ def main(argv: list[str] | None = None) -> int:
                                    gc_period_s=args.gc_period,
                                    jobs=args.jobs,
                                    flight_trace=flight_trace,
+                                   defrag=defrag,
                                    return_states=True)
     wall_s = time.perf_counter() - t0
     if args.trace_out:

@@ -22,6 +22,7 @@ from __future__ import annotations
 import heapq
 import time
 
+from tputopo.defrag import DefragController
 from tputopo.deviceplugin.reporter import node_object_for_probe
 from tputopo.discovery.shim import _probe_python, _to_host_probe
 from tputopo.extender.gc import AssumptionGC
@@ -122,17 +123,38 @@ def stage_nodes(cfg: TraceConfig) -> tuple[FakeApiServer, list[dict], dict]:
     return api, nodes, chips_by_node
 
 
+#: Default knobs for the sim's periodic defrag cycle (``--defrag``):
+#: conservative enough that one arrival spike never evicts running work
+#: (two consecutive pressured cycles = one period of hysteresis), one
+#: job moved per plan (single-victim plans won every axis in the
+#: standard-trace knob sweep — multi-victim plans buy bigger boxes at
+#: churn that shows up in queue-wait), with a cooldown long enough for
+#: the evicted job to re-place first.
+DEFAULT_DEFRAG = {
+    "period_s": 45.0,
+    "target_chips": 0,      # 0 = derive demand from the queued jobs
+    "max_moves": 1,
+    "max_chips_moved": 64,
+    "cooldown_s": 240.0,
+    "hysteresis": 2,
+    "max_concurrent": 1,
+}
+
+
 class SimEngine:
     """One policy's run over one trace."""
 
     # Event kinds, in tie-break order at equal timestamps: completions
-    # free capacity before the same-instant arrival tries to use it.
-    _COMPLETE, _REPAIR, _FAIL, _ARRIVAL, _GC = 0, 1, 2, 3, 4
+    # free capacity before the same-instant arrival tries to use it; the
+    # defrag cycle runs last so a same-instant GC sweep or completion is
+    # reflected in the state it plans from.
+    _COMPLETE, _REPAIR, _FAIL, _ARRIVAL, _GC, _DEFRAG = 0, 1, 2, 3, 4, 5
 
     def __init__(self, trace: Trace, policy_name: str, *,
                  assume_ttl_s: float = 60.0, gc_period_s: float = 30.0,
                  max_backfill_failures: int = 8,
-                 flight_trace: bool = True) -> None:
+                 flight_trace: bool = True,
+                 defrag: dict | None = None) -> None:
         self.trace = trace
         self.cfg = trace.config
         self.clock = VirtualClock(0.0)
@@ -196,8 +218,37 @@ class SimEngine:
         self._heap: list[tuple] = []
         self._seq = 0
         self._gc_pending = False
+        # Future substantive events (arrivals/completions/fail/repair) in
+        # the heap — what decides whether a periodic defrag cycle re-arms
+        # (a heap holding only housekeeping events must drain, or virtual
+        # time would tick forever).
+        self._substantive_pending = 0
         self.horizon_s = 0.0
         self.events_processed = 0  # heap pops — the throughput denominator
+
+        # Defragmentation loop (tputopo.defrag), opt-in: a periodic
+        # controller cycle on virtual time, evicting through the same
+        # requeue path node failures use.  Deterministic: the controller
+        # reads the engine's clock and plans against a fresh ClusterState
+        # sync of the engine's API.
+        self.defrag: DefragController | None = None
+        self.defrag_period_s = 0.0
+        if defrag is not None:
+            knobs = {**DEFAULT_DEFRAG, **defrag}
+            self.defrag_period_s = float(knobs["period_s"])
+            self.defrag = DefragController(
+                read_api, clock=self.clock, tracer=self.tracer,
+                assume_ttl_s=assume_ttl_s,
+                target_chips=int(knobs["target_chips"]),
+                max_moves=int(knobs["max_moves"]),
+                max_chips_moved=int(knobs["max_chips_moved"]),
+                cooldown_s=float(knobs["cooldown_s"]),
+                hysteresis=int(knobs["hysteresis"]),
+                max_concurrent=int(knobs["max_concurrent"]),
+                evict=self._defrag_evict,
+                state_factory=lambda: ClusterState(
+                    read_api, assume_ttl_s=assume_ttl_s,
+                    clock=self.clock).sync())
 
     # ---- event plumbing ----------------------------------------------------
 
@@ -205,6 +256,8 @@ class SimEngine:
         self._seq += 1
         if kind == self._GC:
             self._gc_pending = True
+        elif kind != self._DEFRAG:
+            self._substantive_pending += 1
         heapq.heappush(self._heap, (t, kind, self._seq, payload))
 
     # ---- run ---------------------------------------------------------------
@@ -248,6 +301,10 @@ class SimEngine:
             phases=self.tracer.phases_snapshot(),
             phase_wall_ms=self.tracer.phase_wall_snapshot(),
             decision_log=self.decision_log,
+            # Defrag counters (None when --defrag is off, which keeps the
+            # defrag-off report byte-identical to the pre-defrag schema).
+            defrag=(dict(self.defrag.counters)
+                    if self.defrag is not None else None),
         )
 
     def run_events(self) -> None:
@@ -257,6 +314,8 @@ class SimEngine:
             self._push(fail_t, self._FAIL, (victim, repair_t))
         if self.gc_period_s > 0:
             self._push(self.gc_period_s, self._GC, None)
+        if self.defrag is not None and self.defrag_period_s > 0:
+            self._push(self.defrag_period_s, self._DEFRAG, None)
 
         self._sample_occupancy()  # t=0 anchor for the time-weighted means
         while self._heap:
@@ -275,6 +334,10 @@ class SimEngine:
             elif kind == self._GC:
                 self._gc_pending = False
                 self._on_gc()
+            elif kind == self._DEFRAG:
+                self._on_defrag()
+            if kind not in (self._GC, self._DEFRAG):
+                self._substantive_pending -= 1
             if not self._heap and self.queue:
                 # Terminal drain: no future event will ever wake the queue
                 # again, so the per-wake failure budget must not be what
@@ -350,17 +413,7 @@ class SimEngine:
         victims = sorted({self.ledger[key] for key in dead
                           if key in self.ledger})
         for jname in victims:
-            run = self.jobs[jname]
-            self.metrics.preempt["pods_evicted"] += run.spec.replicas
-            self.metrics.preempt["jobs_requeued"] += 1
-            self.metrics.counts["evicted_requeues"] += 1
-            self._free_job(run)
-            self._delete_job_pods(run.spec)
-            self.ghosts.pop(jname, None)
-            run.incarnation += 1
-            run.enqueued_t = self.clock.t  # wait clock restarts at requeue
-            self.api.create_many("pods", pods_for_job(run.spec))
-            self.queue.append(run)
+            self._requeue_job(self.jobs[jname])
         # The dead node's remaining chips leave the placeable pool.
         blocked = [c for c in self.chips_by_node[name]
                    if c in self.twin[sid].free]
@@ -423,6 +476,57 @@ class SimEngine:
         if reclaimed:
             self._sample_occupancy()
         return len(released)
+
+    def _on_defrag(self) -> None:
+        """One controller cycle on virtual time.  Demand comes straight
+        from the queued jobs (deterministic — no pod listing needed);
+        eviction flows through :meth:`_defrag_evict`, the same requeue
+        path node failures use.  Re-arms only while future substantive
+        events exist: a heap holding nothing but housekeeping must drain
+        (with every job completed all chips are free, so defrag could
+        never unstick what a full retry cannot)."""
+        rec = self.defrag.run_cycle(
+            state=None,
+            demands=[(r.spec.replicas, r.spec.chips) for r in self.queue
+                     if not r.spec.multislice])
+        if self._substantive_pending > 0:
+            self._push(self.clock.t + self.defrag_period_s,
+                       self._DEFRAG, None)
+        if rec["action"] == "executed":
+            self._sample_occupancy()
+            # The restored box (and the requeued victims) may place
+            # queued work right now, not at the next event.
+            self.capacity_epoch += 1
+            self._try_schedule()
+
+    def _defrag_evict(self, victim) -> None:
+        """Eviction hook the controller calls per victim: requeue the
+        whole job through the same path node-failure evictions use —
+        gangs are atomic, so one victim is one whole job."""
+        for jname in sorted({self._job_of_pod(p) for p in victim.pods}):
+            run = self.jobs.get(jname)
+            if run is None:
+                continue  # completed/reclaimed since the plan was built
+            self._requeue_job(run)
+
+    def _requeue_job(self, run: _JobRun) -> None:
+        """THE eviction/requeue path (node failures AND defrag
+        migrations — one code path, so the report's preemption tally
+        counts both): free the job's chips, delete and recreate its pods
+        Pending, restart its wait clock, count the churn.  Recreated
+        Pending pods carry no derived-state impact, so no policy
+        invalidation is needed for them (deletions were folded by
+        _delete_job_pods)."""
+        self.metrics.preempt["pods_evicted"] += run.spec.replicas
+        self.metrics.preempt["jobs_requeued"] += 1
+        self.metrics.counts["evicted_requeues"] += 1
+        self._free_job(run)
+        self._delete_job_pods(run.spec)
+        self.ghosts.pop(run.spec.name, None)
+        run.incarnation += 1
+        run.enqueued_t = self.clock.t  # wait clock restarts at requeue
+        self.api.create_many("pods", pods_for_job(run.spec))
+        self.queue.append(run)
 
     @staticmethod
     def _job_of_pod(pod_name: str) -> str:
@@ -622,12 +726,12 @@ class RunState:
 
     __slots__ = ("policy_name", "horizon_s", "end_t", "metrics",
                  "placed_chips", "frag", "counters", "events_processed",
-                 "phases", "phase_wall_ms", "decision_log")
+                 "phases", "phase_wall_ms", "decision_log", "defrag")
 
     def __init__(self, *, policy_name, horizon_s, end_t, metrics,
                  placed_chips, frag, counters, events_processed,
                  phases=None, phase_wall_ms=None,
-                 decision_log=None) -> None:
+                 decision_log=None, defrag=None) -> None:
         self.policy_name = policy_name
         self.horizon_s = horizon_s
         self.end_t = end_t
@@ -639,6 +743,7 @@ class RunState:
         self.phases = phases or {}
         self.phase_wall_ms = phase_wall_ms or {}
         self.decision_log = decision_log or []
+        self.defrag = defrag
 
 
 def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
@@ -655,6 +760,11 @@ def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
     # the byte-determinism contract; wall-ms stays OUT of this block
     # (see run_trace's phase_wall).
     out["phases"] = rs.phases
+    if rs.defrag is not None:
+        # Deterministic controller counters — present only under --defrag
+        # (schema tputopo.sim/v3); its absence keeps defrag-off reports
+        # byte-identical to the v2 shape.
+        out["defrag"] = dict(sorted(rs.defrag.items()))
     return out
 
 
@@ -690,10 +800,10 @@ def _run_policy_worker(args) -> RunState:
     unit.  Regenerates the trace from the config (deterministic per seed,
     pinned by tests) so nothing heavyweight crosses the process boundary
     in either direction."""
-    cfg, name, assume_ttl_s, gc_period_s, flight_trace = args
+    cfg, name, assume_ttl_s, gc_period_s, flight_trace, defrag = args
     engine = SimEngine(generate_trace(cfg), name,
                        assume_ttl_s=assume_ttl_s, gc_period_s=gc_period_s,
-                       flight_trace=flight_trace)
+                       flight_trace=flight_trace, defrag=defrag)
     engine.run_events()
     return engine.run_state()
 
@@ -701,6 +811,7 @@ def _run_policy_worker(args) -> RunState:
 def run_trace(cfg: TraceConfig, policy_names: list[str], *,
               assume_ttl_s: float = 60.0, gc_period_s: float = 30.0,
               jobs: int = 1, flight_trace: bool = True,
+              defrag: dict | None = None,
               return_states: bool = False):
     """Replay one deterministic trace under each policy and build the
     A/B report.  Every policy sees the identical event stream.
@@ -717,10 +828,19 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
     ``phase_wall`` telemetry block, and explain records on the A/B
     ``first_divergence`` entry.  Off = the NullTracer hot path (the
     perf-figure configuration).  ``return_states=True`` additionally
-    returns the per-policy RunStates (the CLI's --trace-out consumer)."""
+    returns the per-policy RunStates (the CLI's --trace-out consumer).
+
+    ``defrag`` (a knob dict merged over :data:`DEFAULT_DEFRAG`, or None)
+    turns on the periodic defragmentation cycle in every engine: each
+    policy record gains a deterministic ``defrag`` counter block, the
+    knobs are recorded under ``engine.defrag``, and the report schema
+    becomes ``tputopo.sim/v3``.  Off (the default) emits the v2 shape
+    byte-identically."""
     t0 = time.perf_counter()
-    work = [(cfg, name, assume_ttl_s, gc_period_s, flight_trace)
-            for name in policy_names]
+    defrag_knobs = ({**DEFAULT_DEFRAG, **defrag}
+                    if defrag is not None else None)
+    work = [(cfg, name, assume_ttl_s, gc_period_s, flight_trace,
+             defrag_knobs) for name in policy_names]
     if jobs > 1 and len(work) > 1:
         import multiprocessing as mp
 
@@ -748,10 +868,17 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
     }
     wall_s = time.perf_counter() - t0
     events = sum(rs.events_processed for rs in states)
+    engine_params = {"assume_ttl_s": assume_ttl_s,
+                     "gc_period_s": gc_period_s}
+    if defrag_knobs is not None:
+        # Recorded like --assume-ttl/--gc-period: knobs that change
+        # results but are not part of the trace.  Present only when
+        # defrag is on, so defrag-off report bytes stay v2-identical.
+        engine_params["defrag"] = dict(sorted(defrag_knobs.items()))
     report = build_report(
         cfg.describe(), horizon, policies,
-        engine_params={"assume_ttl_s": assume_ttl_s,
-                       "gc_period_s": gc_period_s},
+        engine_params=engine_params,
+        schema_defrag=defrag_knobs is not None,
         throughput={
             "events": events,  # deterministic
             "wall_s": round(wall_s, 3),

@@ -26,7 +26,8 @@ Schema (``tputopo.sim/v2``)::
           "preemptions": {"node_failures", "pods_evicted", "jobs_requeued"},
           "gc": {"sweeps", "assumptions_released"},
           "scheduler": {<deterministic policy counters>},
-          "phases": {"<verb>/<phase>": {"count", "counters"?}, ...}
+          "phases": {"<verb>/<phase>": {"count", "counters"?}, ...},
+          "defrag": {<controller counters>}         # v3 (--defrag) only
         }, ...
       },
       "ab": {"policies": [...], "deltas": {<metric>: a_minus_b},
@@ -55,6 +56,11 @@ from __future__ import annotations
 from tputopo.extender.scheduler import quantile
 
 SCHEMA = "tputopo.sim/v2"
+#: v3 = v2 plus the per-policy ``defrag`` counter block and the
+#: ``engine.defrag`` knob record — emitted ONLY when the defrag loop ran
+#: (``--defrag``).  A defrag-off run keeps emitting the v2 shape
+#: byte-for-byte, so pre-defrag reports remain diffable against new ones.
+SCHEMA_DEFRAG = "tputopo.sim/v3"
 
 
 def _r(x: float, nd: int = 6) -> float:
@@ -207,9 +213,10 @@ def build_report(trace_desc: dict, horizon_s: float,
                  engine_params: dict | None = None,
                  throughput: dict | None = None,
                  first_divergence: dict | None = None,
-                 phase_wall: dict | None = None) -> dict:
+                 phase_wall: dict | None = None,
+                 schema_defrag: bool = False) -> dict:
     out = {
-        "schema": SCHEMA,
+        "schema": SCHEMA_DEFRAG if schema_defrag else SCHEMA,
         "trace": trace_desc,
         # Engine knobs that change results but are not part of the trace
         # (--assume-ttl / --gc-period): recorded so two reports differing
