@@ -1,0 +1,658 @@
+"""tputopo.chaos: deterministic fault injection, the retry/backoff/
+recovery hardening it exercises (scheduler bind legs, crash recovery,
+GC/defrag transient tolerance, informer relist under dropped watches),
+the gang-member meta index, and the invariant auditor."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.cluster import build_cluster
+from tputopo.chaos import ChaosApi, FaultPlan, audit_engine
+from tputopo.defrag import DefragController
+from tputopo.extender import (ExtenderConfig, ExtenderHTTPServer,
+                              ExtenderScheduler)
+from tputopo.extender.gc import AssumptionGC
+from tputopo.extender.scheduler import BindError
+from tputopo.k8s import FakeApiServer, make_pod
+from tputopo.k8s import objects as ko
+from tputopo.k8s.fakeapi import Conflict
+from tputopo.k8s.informer import Informer
+from tputopo.k8s.retry import ApiTimeout, ApiUnavailable, RetryPolicy
+from tputopo.sim.engine import SimEngine, run_trace
+from tputopo.sim.report import SCHEMA, SCHEMA_CHAOS
+from tputopo.sim.trace import TraceConfig, generate_trace
+
+from tests.test_informer import wait_until
+
+GANG_KEY = "tpu.dev/gang-id"
+
+
+def quiet_plan(**overrides):
+    """An api-flake plan with every fault off unless overridden."""
+    knobs = dict(conflict_prob=0.0, unavailable_prob=0.0, timeout_prob=0.0,
+                 ambiguous_timeout_prob=0.0, crash_prob=0.0, node_flaps=0,
+                 watch_drop_prob=0.0, watch_reorder_prob=0.0)
+    knobs.update(overrides)
+    return FaultPlan(0, "api-flake", **knobs)
+
+
+# ---- FaultPlan / RetryPolicy ------------------------------------------------
+
+
+def test_fault_plan_is_deterministic_per_seed():
+    a, b = quiet_plan(unavailable_prob=0.3), quiet_plan(unavailable_prob=0.3)
+    seq_a = [a.decide("x", 0.3, ("k",)) for _ in range(200)]
+    seq_b = [b.decide("x", 0.3, ("k",)) for _ in range(200)]
+    assert seq_a == seq_b
+    assert a.injected == b.injected
+    c = FaultPlan(1, "api-flake", unavailable_prob=0.3)
+    assert seq_a != [c.decide("x", 0.3, ("k",)) for _ in range(200)]
+
+
+def test_fault_plan_consecutive_cap_guarantees_progress():
+    plan = quiet_plan()
+    # Certain-hit fault: the cap must suppress the (max_consecutive+1)th
+    # consecutive injection on one op key — the liveness contract.
+    hits = [plan.decide("boom", 1.0, ("op",)) for _ in range(3)]
+    assert hits == [True, True, False]
+    assert plan.suppressed == 1
+    # After a pass-through the streak restarts.
+    assert plan.decide("boom", 1.0, ("op",)) is True
+
+
+def test_op_fault_cap_spans_mixed_fault_kinds():
+    """The liveness cap is per OPERATION, not per fault kind: alternating
+    timeout/500 draws on one op must still cap at max_consecutive, so a
+    caller retrying max_consecutive+1 times always gets through."""
+    plan = quiet_plan()
+    kinds = [("api_timeout", 0.5), ("api_unavailable", 0.5)]  # always hit
+    outcomes = [plan.op_fault(("op",), kinds) for _ in range(6)]
+    # Whatever mix of kinds fired, never more than 2 in a row land.
+    assert outcomes[2] is None and outcomes[5] is None
+    assert all(o is not None for o in outcomes[:2] + outcomes[3:5])
+    assert plan.suppressed == 2
+
+
+def test_high_rate_faults_never_crash_either_policy():
+    """Review regression: retries exhausting mid-commit must abort the
+    attempt cleanly (fault-classed None + reset), not crash the run or
+    strand feasible jobs at the terminal drain."""
+    chaos = {"profile": "api-flake",
+             "timeout_prob": 0.35, "unavailable_prob": 0.35}
+    for policy in ("naive", "ici"):
+        eng = SimEngine(generate_trace(_small_cfg()), policy, chaos=chaos)
+        eng.run_events()  # must not raise
+        rs = eng.run_state()
+        assert rs.chaos["invariants"]["ok"], \
+            (policy, rs.chaos["invariants"]["violations"])
+        j = eng.metrics.counts
+        # The fault-free run places all 40 jobs; the drain's fault-retry
+        # loop means chaos may not strand feasible work either.
+        assert j["unplaced_at_end"] == 0, (policy, j)
+
+
+def test_retry_policy_backs_off_then_succeeds_on_virtual_clock():
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+        def sleep(self, dt):
+            self.t += dt
+
+    clock = Clock()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ApiUnavailable("nope")
+        return "ok"
+
+    pol = RetryPolicy(max_attempts=4, base_backoff_s=0.5, jitter_frac=0.0)
+    assert pol.call(flaky, clock=clock, sleep=clock.sleep) == "ok"
+    assert len(calls) == 3
+    assert clock.t == pytest.approx(0.5 + 1.0)  # exponential, no jitter
+
+    # Attempts exhausted -> the transient error escapes.
+    calls.clear()
+    with pytest.raises(ApiUnavailable):
+        pol.call(lambda: (_ for _ in ()).throw(ApiUnavailable("always")),
+                 clock=clock, sleep=clock.sleep)
+
+    # A deadline the next backoff would overshoot ends the loop early.
+    calls.clear()
+    with pytest.raises(ApiUnavailable):
+        pol.call(flaky, clock=clock, sleep=clock.sleep, deadline_s=0.1)
+    assert len(calls) == 1
+
+
+# ---- ChaosApi injection -----------------------------------------------------
+
+
+def test_chaos_api_injects_cas_conflict_before_apply():
+    api = FakeApiServer()
+    api.create("pods", make_pod("p1", chips=1))
+    rv = api.get("pods", "p1", "default")["metadata"]["resourceVersion"]
+    chaos = ChaosApi(api, quiet_plan(conflict_prob=1.0))
+    with pytest.raises(Conflict):
+        chaos.patch_annotations("pods", "p1", {"a": "b"}, "default",
+                                expect_version=rv)
+    # Injected BEFORE apply: the store is untouched.
+    assert "a" not in api.get("pods", "p1",
+                              "default")["metadata"]["annotations"]
+    # Non-CAS patches never draw the conflict fault.
+    chaos.patch_annotations("pods", "p1", {"a": "b"}, "default")
+    assert chaos.plan.injected == {"cas_conflict": 1}
+
+
+def test_bind_survives_ambiguous_timeout_via_reconciliation():
+    """The nastiest injected fault: patch/bind APPLY, then time out.  The
+    retried patch is idempotent; the retried bind conflicts against its
+    own success and the scheduler must reconcile, not fail."""
+    api, _ = build_cluster()
+    chaos = ChaosApi(api, quiet_plan(ambiguous_timeout_prob=1.0))
+    sched = ExtenderScheduler(chaos, ExtenderConfig())
+    api.create("pods", make_pod("p1", chips=4))
+    pod = api.get("pods", "p1", "default")
+    scores = sched.sort(pod, ["node-0", "node-1", "node-2", "node-3"])
+    best = max(scores, key=lambda s: (s["Score"], s["Host"]))
+    assert best["Score"] > 0
+    decision = sched.bind("p1", "default", best["Host"])
+    assert decision["node"] == best["Host"]
+    bound = api.get("pods", "p1", "default")
+    assert bound["spec"]["nodeName"] == best["Host"]
+    assert sched.metrics.counters["bind_ambiguous_recovered"] == 1
+    assert sched.metrics.counters.get("retry_api_timeout", 0) >= 1
+    assert sched.metrics.counters["bind_success"] == 1
+
+
+def test_bind_transient_errors_retry_to_success():
+    api, _ = build_cluster()
+    chaos = ChaosApi(api, quiet_plan(unavailable_prob=1.0))  # capped at 2
+    sched = ExtenderScheduler(chaos, ExtenderConfig())
+    api.create("pods", make_pod("p1", chips=2))
+    decision = sched.bind("p1", "default", "node-0")
+    assert decision["node"] == "node-0"
+    assert sched.metrics.counters["retry_api_unavailable"] >= 2
+    assert "bind_errors" not in sched.metrics.counters
+
+
+# ---- crash recovery ---------------------------------------------------------
+
+
+def _gang_pods(api, gang, size, chips):
+    labels = {GANG_KEY: gang, "tpu.dev/gang-size": str(size)}
+    for m in range(size):
+        api.create("pods", make_pod(f"{gang}-{m}", chips=chips,
+                                    labels=labels))
+
+
+def _bind_first_member(api, gang, chips):
+    """Bind member 0 the way the extender would, then 'crash'."""
+    sched = ExtenderScheduler(api, ExtenderConfig())
+    pod = api.get("pods", f"{gang}-0", "default")
+    scores = sched.sort(pod, ["node-0", "node-1", "node-2", "node-3"])
+    best = max(scores, key=lambda s: (s["Score"], s["Host"]))
+    assert best["Score"] > 0
+    sched.bind(f"{gang}-0", "default", best["Host"])
+    return best["Host"]
+
+
+def test_recover_completes_feasible_in_flight_gang():
+    api, _ = build_cluster()  # v5p:2x2x4 — 4 hosts x 4 chips
+    _gang_pods(api, "g", 2, 4)
+    _bind_first_member(api, "g", 4)
+    # Fresh scheduler = the restarted extender (empty caches).
+    sched2 = ExtenderScheduler(api, ExtenderConfig())
+    outcome = sched2.recover()
+    assert outcome["completed"] == ["default/g"]
+    assert outcome["released"] == []
+    for m in range(2):
+        p = api.get("pods", f"g-{m}", "default")
+        assert p["spec"].get("nodeName"), f"member {m} not bound"
+        assert p["metadata"]["annotations"].get(ko.ANN_GROUP)
+    assert sched2.metrics.counters["crash_gangs_completed"] == 1
+
+
+def test_recover_releases_gang_with_missing_member_pod():
+    """A short roster can never complete: binding everything that exists
+    would still leave the gang partial, so recover() must release it —
+    not declare a 3-of-4 gang 'completed' because every bind succeeded."""
+    api, _ = build_cluster()
+    _gang_pods(api, "g", 2, 4)
+    _bind_first_member(api, "g", 4)
+    # Member 1's pod vanished while the extender was down (evicted and
+    # not yet recreated by the job controller).
+    api.delete("pods", "g-1", "default")
+    sched2 = ExtenderScheduler(api, ExtenderConfig())
+    outcome = sched2.recover()
+    assert outcome["completed"] == []
+    assert outcome["released"] == ["default/g"]
+    p0 = api.get("pods", "g-0", "default")
+    assert ko.ANN_GROUP not in p0["metadata"]["annotations"]
+    assert sched2.metrics.counters["crash_gangs_released"] == 1
+
+
+def test_recover_releases_infeasible_in_flight_gang():
+    api, _ = build_cluster()
+    _gang_pods(api, "g", 2, 4)
+    bound_node = _bind_first_member(api, "g", 4)
+    # Capacity vanished while the extender was down: every OTHER node is
+    # gone, so the remaining member can never place (one pod per host).
+    for n in ["node-0", "node-1", "node-2", "node-3"]:
+        if n != bound_node:
+            api.delete("nodes", n)
+    sched2 = ExtenderScheduler(api, ExtenderConfig())
+    outcome = sched2.recover()
+    assert outcome["completed"] == []
+    assert outcome["released"] == ["default/g"]
+    # Release-or-complete, never half: the bound member's assumptions are
+    # wiped (the job controller requeues it); nothing is half-reserved.
+    p0 = api.get("pods", "g-0", "default")
+    assert ko.ANN_GROUP not in p0["metadata"]["annotations"]
+    assert sched2.metrics.counters["crash_gangs_released"] == 1
+
+
+# ---- chaos sim runs ---------------------------------------------------------
+
+
+def _small_cfg(**kw):
+    kw.setdefault("seed", 0)
+    kw.setdefault("nodes", 16)
+    kw.setdefault("arrivals", 40)
+    return TraceConfig(**kw)
+
+
+def _canon(report):
+    r = dict(report)
+    r.pop("throughput", None)
+    r.pop("phase_wall", None)
+    return json.dumps(r, sort_keys=True)
+
+
+def test_chaos_run_deterministic_with_clean_invariants():
+    cfg = _small_cfg()
+    ra = run_trace(cfg, ["ici", "naive"], chaos="api-flake")
+    rb = run_trace(cfg, ["ici", "naive"], chaos="api-flake")
+    assert _canon(ra) == _canon(rb)
+    assert ra["schema"] == SCHEMA_CHAOS
+    assert ra["engine"]["chaos"]["profile"] == "api-flake"
+    for name, rec in ra["policies"].items():
+        c = rec["chaos"]
+        assert c["invariants"]["ok"], (name, c["invariants"]["violations"])
+        # Zero lost jobs: the arithmetic the auditor enforces.
+        jobs = rec["jobs"]
+        assert jobs["arrived"] == (jobs["completed"]
+                                   + jobs["ghost_reclaimed"]
+                                   + jobs["unplaced_at_end"])
+        assert c["injected"], "profile injected nothing — dead chaos run"
+
+
+def test_chaos_off_keeps_schema_and_omits_block():
+    r = run_trace(_small_cfg(arrivals=10), ["ici"])
+    assert r["schema"] == SCHEMA
+    assert "chaos" not in r["policies"]["ici"]
+    assert "chaos" not in r["engine"]
+
+
+def test_crash_storm_engine_ends_gangs_clean():
+    """Acceptance: crash-restarts injected mid-gang-bind end with every
+    gang fully bound or fully released+requeued — audited per event AND
+    at the end; recovery work shows up in the reason-split counters."""
+    eng = SimEngine(generate_trace(_small_cfg(arrivals=60)), "ici",
+                    chaos="crash-storm", audit_every=7)
+    eng.run_events()
+    rs = eng.run_state()
+    chaos = rs.chaos
+    assert chaos["injected"].get("crash_restart", 0) >= 1
+    assert chaos["invariants"]["ok"], chaos["invariants"]["violations"]
+    assert not eng.audit_violations
+    recovered = (chaos["retries"].get("crash_gangs_completed", 0)
+                 + chaos["retries"].get("crash_gangs_released", 0))
+    assert recovered >= 1
+    assert chaos["retries"].get("crash_recoveries", 0) == \
+        chaos["injected"]["crash_restart"]
+
+
+def test_audit_engine_flags_planted_double_booking():
+    eng = SimEngine(generate_trace(_small_cfg(arrivals=6)), "ici")
+    eng.run_events()
+    assert audit_engine(eng, final=True)["ok"]
+    # Plant a corruption: a second pod claiming chips the ledger says
+    # belong to someone else.
+    sid = next(iter(eng.domains))
+    chips = eng.chips_by_node["n00-00"][:2]
+    api = eng.api
+    api.create("pods", make_pod("evil-0", chips=2))
+    api.patch_annotations("pods", "evil-0", {
+        ko.ANN_GROUP: ko.coords_to_ann(chips),
+        ko.ANN_ASSUME_TIME: str(eng.clock.t),
+        ko.ANN_ASSIGNED: "true",
+    }, "default")
+    api.bind_pod("evil-0", "n00-00", "default")
+    result = audit_engine(eng, final=False)
+    assert not result["ok"]
+    assert any("ledger_mismatch" in v or "double_booked" in v
+               for v in result["violations"])
+
+
+# ---- informer under watch faults (satellite) --------------------------------
+
+
+def test_informer_relists_after_injected_watch_drop():
+    api = FakeApiServer()
+    api.create("nodes", ko.make_node("n1", chips=4))
+    chaos = ChaosApi(api, quiet_plan(watch_drop_prob=1.0))
+    inf = Informer(chaos, watch_timeout_s=0.5, relist_backoff_s=0.05).start()
+    try:
+        assert inf.wait_synced(10)
+        for i in range(6):
+            api.create("pods", make_pod(f"p{i}", chips=1))
+        # Every watch stream Gone's after 1-3 events; the mirror still
+        # converges because Gone -> relist is the recovery path.
+        assert wait_until(lambda: len(inf.list("pods")) == 6)
+        assert inf.metrics["relists"] >= 1
+    finally:
+        inf.stop()
+
+
+def test_watch_reorder_tallies_only_when_it_lands():
+    """`injected` records faults that LANDED (the module contract): a
+    held event the stream tail delivers in its original position is NOT
+    a reorder, and must not be counted as one."""
+    api = FakeApiServer()
+    _, rv = api.list_with_version("pods")
+    api.create("pods", make_pod("only", chips=1))
+    plan = quiet_plan(watch_reorder_prob=1.0)
+    chaos = ChaosApi(api, plan)
+    events = list(chaos.watch("pods", rv, timeout_s=0.1))
+    # One event: held, then tail-delivered in order — nothing landed.
+    assert [e["object"]["metadata"]["name"] for e in events
+            if e["type"] != "BOOKMARK"] == ["only"]
+    assert plan.injected.get("watch_reorder", 0) == 0
+
+    # With a successor to overtake the held event, the reorder lands
+    # (delivery order flips) and is tallied exactly once per landing.
+    _, rv2 = api.list_with_version("pods")
+    api.create("pods", make_pod("a", chips=1))
+    api.create("pods", make_pod("b", chips=1))
+    events = [e for e in chaos.watch("pods", rv2, timeout_s=0.1)
+              if e["type"] != "BOOKMARK"]
+    assert [e["object"]["metadata"]["name"] for e in events] == ["b", "a"]
+    assert plan.injected.get("watch_reorder", 0) == 1
+
+
+def test_informer_absorbs_reordered_watch_delivery():
+    api = FakeApiServer()
+    api.create("nodes", ko.make_node("n1", chips=4))
+    chaos = ChaosApi(api, quiet_plan(watch_reorder_prob=1.0))
+    inf = Informer(chaos, watch_timeout_s=0.5).start()
+    try:
+        assert inf.wait_synced(10)
+        api.create("pods", make_pod("p1", chips=1))
+        for i in range(10):
+            api.patch_annotations("pods", "p1", {"i": str(i)}, "default")
+
+        def settled():
+            try:
+                pod = inf.get("pods", "p1", "default")
+            except Exception:
+                return False
+            return pod["metadata"]["annotations"].get("i") == "9"
+
+        # Newest-wins upserts must land on the final value despite every
+        # other event being delivered late.
+        assert wait_until(settled)
+    finally:
+        inf.stop()
+
+
+def test_journal_gap_during_in_flight_fold_falls_back_cleanly():
+    """A derived state whose informer token fell off the bounded journal
+    (a churn burst outran the window) must rebuild, not fold garbage —
+    counted under the journal_gap reason."""
+    api, _ = build_cluster()
+    inf = Informer(api, watch_timeout_s=0.5).start()
+    try:
+        assert inf.wait_synced(10)
+        sched = ExtenderScheduler(api, ExtenderConfig(), informer=inf)
+        api.create("pods", make_pod("px", chips=1))
+        assert wait_until(lambda: len(inf.list("pods")) == 1)
+        pod = api.get("pods", "px", "default")
+        sched.sort(pod, ["node-0"])  # builds the (state, token) pair
+        assert sched._cached_informer_version is not None
+        # Outrun the 256-entry journal while the fold is in flight.
+        for i in range(300):
+            api.patch_annotations("pods", "px", {"i": str(i)}, "default")
+        assert wait_until(lambda: inf.get(
+            "pods", "px", "default")["metadata"]["annotations"].get("i")
+            == "299")
+        sched.sort(api.get("pods", "px", "default"), ["node-0"])
+        c = sched.metrics.counters
+        assert c.get("state_delta_fallback_journal_gap", 0) >= 1
+        assert c.get("state_full_rebuilds", 0) >= 2
+    finally:
+        inf.stop()
+
+
+# ---- gang-member meta index (satellite) -------------------------------------
+
+
+def _filtered(api, gang_id, namespace="default"):
+    return api.list("pods", lambda p: (
+        p["metadata"].get("namespace", "default") == namespace
+        and ({**p["metadata"].get("annotations", {}),
+              **p["metadata"].get("labels", {})}).get(GANG_KEY) == gang_id))
+
+
+def test_meta_index_tracks_create_patch_delete_recreate():
+    api = FakeApiServer()
+    names = lambda objs: [o["metadata"]["name"] for o in objs]  # noqa: E731
+    api.create("pods", make_pod("a-0", labels={GANG_KEY: "a"}))
+    api.create("pods", make_pod("a-1", labels={GANG_KEY: "a"}))
+    api.create("pods", make_pod("solo"))
+    assert names(api.list_by_meta("pods", GANG_KEY, "a")) == \
+        names(_filtered(api, "a")) == ["a-0", "a-1"]
+    # Annotation-only membership (the bind-time stamp) joins the index.
+    api.patch_annotations("pods", "solo", {GANG_KEY: "a"}, "default")
+    assert names(api.list_by_meta("pods", GANG_KEY, "a")) == \
+        ["a-0", "a-1", "solo"]
+    # A label patch MOVES membership.
+    api.patch_labels("pods", "a-1", {GANG_KEY: "b"}, "default")
+    assert names(api.list_by_meta("pods", GANG_KEY, "a")) == ["a-0", "solo"]
+    assert names(api.list_by_meta("pods", GANG_KEY, "b")) == ["a-1"]
+    # Labels shadow annotations (merged-meta precedence).
+    api.patch_labels("pods", "solo", {GANG_KEY: "c"}, "default")
+    assert names(api.list_by_meta("pods", GANG_KEY, "a")) == ["a-0"]
+    # Delete/recreate cycles stay exact.
+    api.delete("pods", "a-0", "default")
+    assert api.list_by_meta("pods", GANG_KEY, "a") == []
+    api.create("pods", make_pod("a-0", labels={GANG_KEY: "a"}))
+    assert names(api.list_by_meta("pods", GANG_KEY, "a")) == ["a-0"]
+    # Unindexed keys refuse loudly rather than scanning or lying.
+    with pytest.raises(KeyError):
+        api.list_by_meta("pods", "some/other-label", "x")
+
+
+def test_gang_members_uses_index_and_matches_filter():
+    api, _ = build_cluster()
+    _gang_pods(api, "g", 3, 4)
+    api.create("pods", make_pod("noise", chips=1))
+    sched = ExtenderScheduler(api, ExtenderConfig())
+    got = sched._gang_members("default", "g")
+    assert [p["metadata"]["name"] for p in got] == ["g-0", "g-1", "g-2"]
+    assert [p["metadata"]["name"] for p in got] == \
+        [p["metadata"]["name"] for p in _filtered(api, "g")]
+    # Namespace scoping still holds through the index path.
+    assert sched._gang_members("other", "g") == []
+
+
+def test_informer_mirror_index_matches_api():
+    api = FakeApiServer()
+    api.create("nodes", ko.make_node("n1", chips=4))
+    inf = Informer(api, watch_timeout_s=0.5).start()
+    try:
+        assert inf.wait_synced(10)
+        _gang_pods(api, "g", 2, 4)
+        assert wait_until(lambda: len(inf.list("pods")) == 2)
+        assert [p["metadata"]["name"]
+                for p in inf.list_by_meta("pods", GANG_KEY, "g")] == \
+            ["g-0", "g-1"]
+        api.delete("pods", "g-1", "default")
+        assert wait_until(lambda: len(
+            inf.list_by_meta("pods", GANG_KEY, "g")) == 1)
+    finally:
+        inf.stop()
+
+
+# ---- GC / defrag transient tolerance ----------------------------------------
+
+
+class _FlakyPatchApi:
+    """Raises ApiUnavailable on the first N patch_annotations calls."""
+
+    def __init__(self, api, failures):
+        self._api = api
+        self.failures = failures
+
+    def __getattr__(self, name):
+        return getattr(self._api, name)
+
+    def patch_annotations(self, *a, **kw):
+        if self.failures > 0:
+            self.failures -= 1
+            raise ApiUnavailable("injected")
+        return self._api.patch_annotations(*a, **kw)
+
+
+def test_gc_sweep_survives_transient_release_errors():
+    api, _ = build_cluster()
+    api.create("pods", make_pod("stale-0", chips=2))
+    api.patch_annotations("pods", "stale-0", {
+        ko.ANN_GROUP: "0,0,0;1,0,0",
+        ko.ANN_ASSUME_TIME: "0.0",
+        ko.ANN_ASSIGNED: "false",
+    }, "default")
+    api.bind_pod("stale-0", "node-0", "default")
+    flaky = _FlakyPatchApi(api, failures=1)
+    gc = AssumptionGC(flaky, assume_ttl_s=60.0, clock=lambda: 1000.0)
+    # First sweep: the release fails transiently — skipped, NOT raised.
+    assert gc.sweep() == []
+    # Next sweep retries and releases it durably.
+    assert gc.sweep() == ["default/stale-0"]
+    anns = api.get("pods", "stale-0", "default")["metadata"]["annotations"]
+    assert ko.ANN_GROUP not in anns
+
+
+def test_defrag_verify_failure_replans_instead_of_wedging():
+    api, _ = build_cluster()
+    # Checkerboard: two quads pinning hosts 0 and 2 (test_defrag's shape).
+    from tests.test_defrag import occupy, synced_state
+    state = synced_state(api)
+    dom = next(iter(state.domains.values()))
+    nodes = [dom.node_by_host[h] for h in sorted(dom.node_by_host)]
+    chips = {n: list(dom.chips_by_node[n]) for n in nodes}
+    occupy(api, "quad-a-0", nodes[0], chips[nodes[0]])
+    occupy(api, "quad-c-0", nodes[2], chips[nodes[2]])
+    ctl = DefragController(api, clock=lambda: 1000.0, assume_ttl_s=60.0,
+                           hysteresis=2, cooldown_s=0.0,
+                           evict=lambda v: None)  # evictions never land
+    demands = [(2, 4)]
+    assert ctl.run_cycle(demands=demands)["reason"] == "hysteresis"
+    rec = ctl.run_cycle(demands=demands)
+    assert rec["action"] == "executed" and rec["restored"] is False
+    assert ctl.counters["verify_failed"] == 1
+    assert ctl.counters.get("verify_replans") == 1
+    # Re-plan, not wedge: the failed verify carries the pressure streak,
+    # so the very next cycle (cooldown permitting) plans and acts again
+    # instead of re-earning the hysteresis from zero.
+    rec3 = ctl.run_cycle(demands=demands)
+    assert rec3["action"] == "executed"
+    assert ctl.counters["plans_executed"] == 2
+
+
+def test_defrag_eviction_tolerates_transient_delete_errors():
+    api, _ = build_cluster()
+    from tests.test_defrag import occupy, synced_state
+    state = synced_state(api)
+    dom = next(iter(state.domains.values()))
+    nodes = [dom.node_by_host[h] for h in sorted(dom.node_by_host)]
+    chips = {n: list(dom.chips_by_node[n]) for n in nodes}
+    occupy(api, "quad-a-0", nodes[0], chips[nodes[0]])
+    occupy(api, "quad-c-0", nodes[2], chips[nodes[2]])
+
+    class _FlakyDelete:
+        def __init__(self, api):
+            self._api = api
+            self.fail_next = 3  # < RetryPolicy.max_attempts
+
+        def __getattr__(self, name):
+            return getattr(self._api, name)
+
+        def delete(self, *a, **kw):
+            if self.fail_next > 0:
+                self.fail_next -= 1
+                raise ApiUnavailable("injected")
+            return self._api.delete(*a, **kw)
+
+    ctl = DefragController(_FlakyDelete(api), clock=lambda: 1000.0,
+                           assume_ttl_s=60.0, hysteresis=1, cooldown_s=0.0)
+    rec = ctl.run_cycle(demands=[(2, 4)])
+    # The retried deletes eventually land; the migration verifies.
+    assert rec["action"] == "executed"
+    assert rec["restored"] is True
+
+
+# ---- hardened HTTP surface (satellite) --------------------------------------
+
+
+def test_debug_endpoints_fail_with_structured_500_and_counter():
+    api, _ = build_cluster()
+    config = ExtenderConfig()
+    sched = ExtenderScheduler(api, config)
+    srv = ExtenderHTTPServer(sched, config, port=0).start()
+    try:
+        host, port = srv.address
+
+        def get(path):
+            return urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                          timeout=5)
+
+        boom = RuntimeError("kaboom")
+
+        def exploding_state(*a, **kw):
+            raise boom
+
+        sched._state = exploding_state
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/state")
+        assert ei.value.code == 500
+        body = json.loads(ei.value.read())
+        # Structured: type/message/path, no traceback text.
+        assert body["error"]["type"] == "RuntimeError"
+        assert body["error"]["message"] == "kaboom"
+        assert body["error"]["path"] == "/state"
+        assert "Traceback" not in json.dumps(body)
+        assert sched.metrics.counters["http_internal_errors"] == 1
+        # The failure is itself scrape-able; /metrics still serves.
+        with get("/metrics") as resp:
+            text = resp.read().decode()
+        assert "tputopo_extender_http_internal_errors_total 1" in text
+    finally:
+        srv.stop()
+
+
+def test_http_handler_carries_request_deadline():
+    api, _ = build_cluster()
+    config = ExtenderConfig(http_timeout_s=7.5)
+    sched = ExtenderScheduler(api, config)
+    srv = ExtenderHTTPServer(sched, config, port=0)
+    try:
+        assert srv.httpd.RequestHandlerClass.timeout == 7.5
+    finally:
+        srv.httpd.server_close()

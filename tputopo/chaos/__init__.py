@@ -1,0 +1,17 @@
+"""tputopo.chaos — deterministic fault injection + invariant auditing.
+
+The robustness harness around the control plane: :class:`FaultPlan`
+(seeded fault decisions), :class:`ChaosApi` (the injecting API proxy),
+the chaos profile vocabulary (:data:`PROFILES`), and
+:class:`InvariantAuditor` / :func:`audit_engine` (the correctness
+contract a chaos trace is judged against).  The *hardening* this layer
+flushed out lives where it belongs — :mod:`tputopo.k8s.retry` (shared
+backoff), the extender's crash ``recover()``, the GC/defrag transient
+tolerance — this package only breaks things and checks the wreckage.
+"""
+
+from tputopo.chaos.audit import InvariantAuditor, audit_engine
+from tputopo.chaos.faults import PROFILES, ChaosApi, FaultPlan
+
+__all__ = ["ChaosApi", "FaultPlan", "InvariantAuditor", "PROFILES",
+           "audit_engine"]

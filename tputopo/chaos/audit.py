@@ -1,0 +1,181 @@
+"""Invariant auditor: the correctness contract a chaos trace must hold.
+
+Faults are only interesting if we can say what "survived" means.  The
+auditor checks the control plane's hard invariants against **API truth**
+(a fresh authoritative :class:`ClusterState` sync of the raw server —
+never through the chaos wrapper):
+
+1. **No chip double-booked** — no two live assignments claim one chip
+   (``ClusterState.conflicts`` empty), and the engine's independent chip
+   ledger agrees exactly with the API's occupancy records.
+2. **Gang atomicity** — every gang is all-or-none bound; no gang sits
+   with a strict subset of members bound between events.
+3. **No orphaned assumptions after GC** (final audit) — one sweep later,
+   no expired unconfirmed assumption still claims chips.
+4. **No lost jobs** (final audit) — every arrived job is terminal
+   (completed / ghost-reclaimed) or still queued with its pods intact;
+   arithmetic AND identity are both checked.
+
+``audit_engine(engine)`` runs the suite against a finished (or
+mid-trace) :class:`~tputopo.sim.engine.SimEngine`; the result dict is
+deterministic (sorted violations, stable counts) and lands in the chaos
+report block.  Per-event auditing (``SimEngine(audit_every=N)``) runs
+the occupancy/atomicity subset every N events — the test-tier dial; a
+violation there raises at the exact event that broke the invariant
+instead of a post-mortem at the end of the trace.
+"""
+
+from __future__ import annotations
+
+from tputopo.extender.gc import AssumptionGC
+from tputopo.extender.scheduler import _gang_of
+from tputopo.extender.state import ClusterState
+
+#: Violations kept verbatim in the report; the rest collapse to a count
+#: (a broken run must not emit an O(pods) report).
+_MAX_VIOLATIONS = 50
+
+
+class InvariantAuditor:
+    """Audits one sim engine's world.  Stateless between calls — every
+    audit re-reads API truth."""
+
+    def __init__(self, engine) -> None:
+        self.engine = engine
+
+    def _state(self) -> ClusterState:
+        return ClusterState(self.engine.api,
+                            assume_ttl_s=self.engine.assume_ttl_s,
+                            clock=self.engine.clock).sync()
+
+    # ---- individual invariants --------------------------------------------
+
+    def check_no_double_booking(self, state: ClusterState,
+                                violations: list[str]) -> int:
+        for pa in state.conflicts:
+            violations.append(
+                f"double_booked: {pa.namespace}/{pa.pod_name} overlaps an "
+                f"earlier claim on {pa.node_name}")
+        return sum(len(d.assignments) for d in state.domains.values())
+
+    def check_ledger_matches_api(self, state: ClusterState,
+                                 violations: list[str]) -> int:
+        """The engine's independent chip ledger vs API occupancy — equal
+        as maps, modulo ghosts already past their TTL (the API side has
+        expired them; the engine reaps them lazily at the next wake)."""
+        eng = self.engine
+        now = eng.clock()
+        stale_ghosts = {name for name, exp in eng.ghosts.items()
+                        if exp <= now}
+        api_claims: dict[tuple, str] = {}
+        for ns, pod, sid, held, _gang, _assigned in state.occupancy_records():
+            job = pod.rsplit("-", 1)[0]
+            for chip in held:
+                api_claims[(sid, tuple(chip))] = job
+        ledger = {key: job for key, job in eng.ledger.items()
+                  if job not in stale_ghosts}
+        for key in sorted(set(ledger) | set(api_claims)):
+            lj, aj = ledger.get(key), api_claims.get(key)
+            if lj != aj:
+                violations.append(
+                    f"ledger_mismatch: chip {key} ledger={lj} api={aj}")
+        return len(api_claims)
+
+    def check_gang_atomicity(self, violations: list[str]) -> int:
+        """All-or-none: no gang may end a trace partially bound.
+
+        Deliberately re-derives gang grouping and the partial-gang
+        predicate from raw API objects instead of sharing the scheduler's
+        ``recover()`` helpers: the auditor exists to catch bugs in exactly
+        that code, and an invariant checked with the checked code's own
+        predicate can never see the predicate go wrong.  Keep this
+        implementation independent."""
+        pods = self.engine.api.list("pods")
+        gangs: dict[tuple[str, str], dict] = {}
+        for p in pods:
+            g = _gang_of(p)
+            if g is None:
+                continue
+            info = gangs.setdefault((g[0], g[1]), {"size": g[2], "bound": 0})
+            if p["spec"].get("nodeName"):
+                info["bound"] += 1
+        for (ns, gid), info in sorted(gangs.items()):
+            if 0 < info["bound"] < info["size"]:
+                violations.append(
+                    f"gang_partial: {ns}/{gid} has {info['bound']} of "
+                    f"{info['size']} members bound")
+        return len(gangs)
+
+    def check_no_orphaned_assumptions(self, violations: list[str]) -> int:
+        """One sweep, then: nothing expired may remain.  Uses the raw API
+        and the engine clock — GC on virtual time, like the sim's own."""
+        gc = AssumptionGC(self.engine.api,
+                          assume_ttl_s=self.engine.assume_ttl_s,
+                          clock=self.engine.clock)
+        released = gc.sweep()
+        state = self._state()
+        for pa in state.expired:
+            violations.append(
+                f"orphaned_assumption: {pa.namespace}/{pa.pod_name} expired "
+                "but still annotated after a GC sweep")
+        return len(released)
+
+    def check_no_lost_jobs(self, violations: list[str]) -> int:
+        eng = self.engine
+        counts = eng.metrics.counts
+        arrived = counts["arrived"]
+        terminal = counts["completed"] + counts["ghost_reclaimed"]
+        queued = len(eng.queue)
+        if arrived != terminal + queued:
+            violations.append(
+                f"jobs_lost: arrived={arrived} != completed+reclaimed="
+                f"{terminal} + queued={queued}")
+        queued_names = {r.spec.name for r in eng.queue}
+        live_names = set(eng.jobs)
+        for name in sorted(live_names - queued_names):
+            violations.append(f"job_limbo: {name} tracked but neither "
+                              "queued nor terminal")
+        for run in eng.queue:
+            for m in range(run.spec.replicas):
+                pod_name = f"{run.spec.name}-{m}"
+                try:
+                    pod = eng.api.get("pods", pod_name, "default")
+                except Exception:
+                    violations.append(
+                        f"job_pod_missing: queued {pod_name} has no pod")
+                    continue
+                if pod["spec"].get("nodeName"):
+                    violations.append(
+                        f"queued_but_bound: {pod_name} is bound while its "
+                        "job waits in queue")
+        return arrived
+
+    # ---- suites ------------------------------------------------------------
+
+    def audit(self, final: bool = True) -> dict:
+        """The full audit.  ``final=False`` (the per-event form) skips the
+        GC-dependent and end-of-trace accounting checks, which only hold
+        once the event loop has drained."""
+        violations: list[str] = []
+        checks: dict[str, int] = {}
+        state = self._state()
+        checks["assignments"] = self.check_no_double_booking(state, violations)
+        checks["api_chips_claimed"] = self.check_ledger_matches_api(
+            state, violations)
+        checks["gangs"] = self.check_gang_atomicity(violations)
+        if final:
+            checks["jobs_arrived"] = self.check_no_lost_jobs(violations)
+            checks["gc_final_released"] = self.check_no_orphaned_assumptions(
+                violations)
+        violations.sort()
+        out = {"ok": not violations,
+               "checks": dict(sorted(checks.items())),
+               "violations": violations[:_MAX_VIOLATIONS]}
+        if len(violations) > _MAX_VIOLATIONS:
+            out["violations_omitted"] = len(violations) - _MAX_VIOLATIONS
+        return out
+
+
+def audit_engine(engine, final: bool = True) -> dict:
+    """Run the invariant suite against a sim engine (see class docs)."""
+    return InvariantAuditor(engine).audit(final=final)

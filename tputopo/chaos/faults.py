@@ -1,0 +1,329 @@
+"""Seeded, deterministic fault injection for the control plane.
+
+:class:`FaultPlan` is the single source of fault decisions: one Philox
+stream (``SeedSequence``-folded, the same construction as the sim trace
+generator) drawn in a fixed call order, so an identical (seed, profile)
+injects an identical fault sequence into an identical operation stream —
+chaos runs replay byte-for-byte, across processes (``--jobs 2``)
+included.  :class:`ChaosApi` wraps an API-server surface and consults
+the plan per intercepted verb:
+
+- **CAS conflicts** beyond the organic ones: a compare-and-swap
+  ``patch_annotations`` raises :class:`Conflict` before applying.
+- **Transient 500s / timeouts**: :class:`ApiUnavailable` /
+  :class:`ApiTimeout` raised before the verb applies (the retry path).
+- **Ambiguous timeouts**: the verb APPLIES, then :class:`ApiTimeout` is
+  raised — the nastiest real-world failure, exercising the caller's
+  retry-reconciliation (idempotent bind replay, conflict-vs-own-success
+  resolution).
+- **Watch drops**: the stream raises :class:`Gone` mid-flight, forcing
+  the informer's relist path; **delayed/reordered delivery** holds an
+  event back past its successor (the mirror's newest-wins upserts must
+  absorb it).
+- **Node flaps** (:meth:`FaultPlan.flap_events`) and **crash-restart
+  points** (:meth:`FaultPlan.crash_point`) are consumed by the sim
+  engine / ici policy rather than the API wrapper.
+
+The **consecutive-failure cap** (``max_consecutive``) is the liveness
+contract: per (fault kind, operation key), at most ``max_consecutive``
+injections land in a row before one is suppressed — so any caller
+retrying at least ``max_consecutive + 1`` times is guaranteed to get
+through, and a chaos trace can assert *zero lost jobs* rather than
+"probably none".
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tputopo.k8s.fakeapi import Conflict, Gone
+from tputopo.k8s.retry import ApiTimeout, ApiUnavailable
+
+#: Named chaos profiles (the ``--chaos <profile>`` vocabulary).  Every
+#: knob a profile omits falls back to :data:`DEFAULT_KNOBS`.
+PROFILES: dict[str, dict] = {
+    # The standing chaos trace: a flaky-but-functional API server plus a
+    # restart-happy extender — every hardened path exercised, rates low
+    # enough that headline axes degrade gracefully instead of collapsing.
+    "api-flake": {
+        "conflict_prob": 0.05,
+        "unavailable_prob": 0.03,
+        "timeout_prob": 0.02,
+        "ambiguous_timeout_prob": 0.01,
+        "crash_prob": 0.02,
+        "node_flaps": 2,
+        "flap_outage_s": 45.0,
+    },
+    # Crash-restart focus: the extender dies mid-gang-bind often; API
+    # itself is healthy.  The recovery (complete-or-release) path is the
+    # hot one.
+    "crash-storm": {
+        "crash_prob": 0.3,
+        "conflict_prob": 0.02,
+    },
+    # Watch-stream focus for informer-backed deployments: drops (Gone ->
+    # relist) and reordered delivery; no API write faults.
+    "watch-flake": {
+        "watch_drop_prob": 0.2,
+        "watch_reorder_prob": 0.2,
+    },
+}
+
+DEFAULT_KNOBS: dict = {
+    "conflict_prob": 0.0,            # injected CAS 409s
+    "unavailable_prob": 0.0,         # transient 500s (before apply)
+    "timeout_prob": 0.0,             # timeouts (before apply)
+    "ambiguous_timeout_prob": 0.0,   # verb applies, then times out
+    "crash_prob": 0.0,               # extender crash mid-gang-bind
+    "watch_drop_prob": 0.0,          # watch stream raises Gone
+    "watch_reorder_prob": 0.0,       # event held back past its successor
+    "node_flaps": 0,                 # extra short fail->repair cycles
+    "flap_outage_s": 45.0,           # flap repair delay (virtual seconds)
+    "max_consecutive": 2,            # liveness cap per (kind, op) — see above
+}
+
+
+class FaultPlan:
+    """Deterministic fault oracle: ``decide(kind, prob, key)`` draws from
+    one seeded stream and tallies what it injected (``injected`` by kind)
+    and what the consecutive cap suppressed (``suppressed``)."""
+
+    def __init__(self, seed: int, profile: str = "api-flake",
+                 **overrides) -> None:
+        if profile not in PROFILES:
+            raise KeyError(f"unknown chaos profile {profile!r}; "
+                           f"available: {sorted(PROFILES)}")
+        knobs = {**DEFAULT_KNOBS, **PROFILES[profile], **overrides}
+        unknown = set(knobs) - set(DEFAULT_KNOBS)
+        if unknown:
+            raise ValueError(f"unknown chaos knobs {sorted(unknown)}")
+        self.profile = profile
+        self.knobs = knobs
+        for k, v in knobs.items():
+            setattr(self, k, v)
+        # Same SeedSequence folding as TraceConfig.rng — a distinct
+        # entropy tag keeps the fault stream independent of the trace's.
+        self._rng = np.random.Generator(np.random.Philox(
+            seed=np.random.SeedSequence(entropy=(0xC4A05, seed))))
+        self.injected: dict[str, int] = {}
+        self.suppressed = 0
+        self._streaks: dict[tuple, int] = {}
+
+    def describe(self) -> dict:
+        """The resolved knob set — recorded in the report's ``engine``
+        block so two chaos reports differing only in knobs are
+        distinguishable."""
+        return {"profile": self.profile,
+                **{k: self.knobs[k] for k in sorted(self.knobs)}}
+
+    # ---- draws -------------------------------------------------------------
+
+    def _draw(self) -> float:
+        return float(self._rng.random())
+
+    def _apply_streak(self, streak_key: tuple | None, kind: str) -> bool:
+        """THE consecutive-cap gate, shared by every decision path: a hit
+        passes through (tallied) unless ``max_consecutive`` hits already
+        landed in a row for ``streak_key`` — then it is suppressed
+        (counted) and the streak restarts.  This single definition is
+        what the 'retrying max_consecutive + 1 times always gets through'
+        liveness contract rests on."""
+        if streak_key is not None:
+            n = self._streaks.get(streak_key, 0)
+            if n >= self.max_consecutive:
+                self._streaks.pop(streak_key, None)
+                self.suppressed += 1
+                return False
+            self._streaks[streak_key] = n + 1
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+        return True
+
+    def decide(self, kind: str, prob: float, key: tuple | None = None) -> bool:
+        """One injection decision.  ``key`` scopes the consecutive-failure
+        cap: after ``max_consecutive`` injections in a row for the same
+        (kind, key), the next hit is suppressed (counted), guaranteeing a
+        retried operation eventually passes."""
+        if prob <= 0.0:
+            return False
+        streak_key = None if key is None else (kind,) + key
+        if self._draw() >= prob:
+            if streak_key is not None:
+                self._streaks.pop(streak_key, None)
+            return False
+        return self._apply_streak(streak_key, kind)
+
+    def op_fault(self, op_key: tuple,
+                 kinds_probs: list[tuple[str, float]]) -> str | None:
+        """One failure decision for one API call: at most one fault kind
+        fires, chosen by stacked probability from ONE draw, and the
+        consecutive cap applies to the CALL (``op_key``), not the kind —
+        so the liveness contract holds even when an operation is subject
+        to several fault kinds (timeout + 500 + ambiguous): a caller
+        retrying ``max_consecutive + 1`` times always gets through."""
+        u = self._draw()
+        acc = 0.0
+        chosen = None
+        for kind, prob in kinds_probs:
+            acc += prob
+            if u < acc:
+                chosen = kind
+                break
+        if chosen is None:
+            self._streaks.pop(op_key, None)
+            return None
+        return chosen if self._apply_streak(op_key, chosen) else None
+
+    def crash_point(self, replicas: int) -> int | None:
+        """Member index (1..replicas-1) before whose bind the extender
+        "dies" this gang attempt, or None.  Only mid-bind points are
+        drawn: a crash before member 0 is indistinguishable from no
+        attempt, and after the last member the gang is already whole.
+        NOT tallied here — an attempt that fails before reaching the
+        crash point never crashes; the consumer records the injection
+        via :meth:`record` when the crash actually fires."""
+        if replicas < 2 or self.crash_prob <= 0.0:
+            return None
+        if self._draw() >= self.crash_prob:
+            return None
+        return 1 + int(self._draw() * (replicas - 1))
+
+    def record(self, kind: str, by: int = 1) -> None:
+        """Tally a fault the consumer injected from a plan decision
+        (e.g. a crash point that actually fired)."""
+        self.injected[kind] = self.injected.get(kind, 0) + by
+
+    def flap_events(self, n_nodes: int,
+                    horizon_s: float) -> list[tuple[float, float, int]]:
+        """Extra (fail_t, repair_t, victim_index) node-flap events to merge
+        into the sim's event stream — short outages that exercise the
+        evict -> requeue -> re-place chain beyond the trace's organic
+        failures.  Drawn once, at engine init (fixed stream position).
+        Not tallied here: the engine ``record``s each flap when it LANDS
+        (fails a live node or extends an outage) — a flap fully shadowed
+        by a longer organic failure of the same node never counts, same
+        convention as watch drops."""
+        out = []
+        for _ in range(int(self.node_flaps)):
+            t = round(self._draw() * max(horizon_s, 1.0), 6)
+            victim = int(self._draw() * max(n_nodes, 1))
+            out.append((t, round(t + self.flap_outage_s, 6), victim))
+        return sorted(out)
+
+
+class ChaosApi:
+    """Fault-injecting proxy over an API-server surface (the fake server,
+    the sim's copy-free facade, or the REST client — anything with the
+    FakeApiServer method shape).  Reads and writes not listed below pass
+    through untouched via ``__getattr__``; the engine's own bookkeeping
+    writes go to the raw server, so injection lands exactly on the
+    control plane under test (scheduler, GC, defrag)."""
+
+    def __init__(self, api, plan: FaultPlan) -> None:
+        self._api = api
+        self.plan = plan
+
+    def __getattr__(self, name):
+        return getattr(self._api, name)
+
+    # ---- helpers -----------------------------------------------------------
+
+    def _guarded(self, verb: str, key: tuple, fn, *, ambiguous: bool = True):
+        """One API call under injection: a single plan decision (one
+        draw, one per-OPERATION failure streak shared across every fault
+        kind) picks at most one of timeout / 500 — raised BEFORE the verb
+        applies — or, for write verbs, an ambiguous timeout raised AFTER
+        it applied.  The shared streak is what makes the consecutive cap
+        a real liveness bound: mixed fault kinds cannot stack past it."""
+        p = self.plan
+        kinds = [("api_timeout", p.timeout_prob),
+                 ("api_unavailable", p.unavailable_prob)]
+        if ambiguous:
+            kinds.append(("ambiguous_timeout", p.ambiguous_timeout_prob))
+        kind = p.op_fault(("op", verb) + key, kinds)
+        if kind == "api_timeout":
+            raise ApiTimeout(f"injected timeout: {verb} {key}")
+        if kind == "api_unavailable":
+            raise ApiUnavailable(f"injected 500: {verb} {key}")
+        out = fn()
+        if kind == "ambiguous_timeout":
+            raise ApiTimeout(f"injected timeout AFTER apply: {verb} {key}")
+        return out
+
+    # ---- intercepted verbs -------------------------------------------------
+
+    def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
+        return self._guarded("get", (kind, namespace, name),
+                             lambda: self._api.get(kind, name, namespace),
+                             ambiguous=False)  # reads have no apply side
+
+    def patch_annotations(self, kind: str, name: str, patch,
+                          namespace: str | None = None,
+                          expect_version: str | None = None) -> dict:
+        key = (kind, namespace, name)
+        p = self.plan
+        if expect_version is not None and \
+                p.decide("cas_conflict", p.conflict_prob, ("c",) + key):
+            # Conflicts live outside the op streak: they are not blind-
+            # retried (the caller re-plans), and their own per-kind streak
+            # bounds consecutive injections so a re-planned bind cannot
+            # starve forever.
+            raise Conflict(f"injected CAS conflict: {kind} {name}")
+        return self._guarded(
+            "patch", key,
+            lambda: self._api.patch_annotations(kind, name, patch,
+                                                namespace, expect_version))
+
+    def bind_pod(self, name: str, node_name: str,
+                 namespace: str | None = None) -> dict:
+        return self._guarded(
+            "bind", ("pods", namespace, name),
+            lambda: self._api.bind_pod(name, node_name, namespace))
+
+    def delete(self, kind: str, name: str,
+               namespace: str | None = None) -> None:
+        return self._guarded(
+            "delete", (kind, namespace, name),
+            lambda: self._api.delete(kind, name, namespace),
+            ambiguous=False)  # delete-then-timeout replays as NotFound
+                              # at the caller, already handled everywhere
+
+    def watch(self, kind: str, resource_version: str,
+              timeout_s: float = 30.0):
+        """The underlying watch with drop / delayed-delivery injection:
+        a drop raises :class:`Gone` after at least one event (the
+        informer must relist); reorder holds one event back and delivers
+        it after its successor (never dropped — at stream end at the
+        latest), so the mirror's newest-wins logic is what's tested, not
+        event loss."""
+        p = self.plan
+        drop_after = None
+        if p.watch_drop_prob > 0.0 and p._draw() < p.watch_drop_prob:
+            # Armed, not yet tallied: an idle window can end before the
+            # drop point, and `injected` records faults that LANDED.
+            drop_after = 1 + int(p._draw() * 3)
+        held = None
+        n = 0
+        for ev in self._api.watch(kind, resource_version, timeout_s):
+            if drop_after is not None and n >= drop_after:
+                if held is not None:
+                    yield held
+                p.record("watch_drop")
+                raise Gone(f"injected watch drop on {kind}")
+            if held is None and ev["type"] != "BOOKMARK" and \
+                    p.watch_reorder_prob > 0.0 and \
+                    p._draw() < p.watch_reorder_prob:
+                # Armed, not yet tallied (same contract as the drop):
+                # the stream can end before a successor overtakes the
+                # held event, in which case the tail delivery below is
+                # in-order and no reorder LANDED.
+                held = ev
+                continue
+            yield ev
+            n += 1
+            if held is not None:
+                yield held
+                held = None
+                n += 1
+                p.record("watch_reorder")
+        if held is not None:
+            yield held

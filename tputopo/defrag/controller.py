@@ -32,6 +32,7 @@ block).
 
 from __future__ import annotations
 
+import random
 import time
 
 from tputopo.defrag.planner import (MigrationPlan, dedupe_demands,
@@ -39,6 +40,7 @@ from tputopo.defrag.planner import (MigrationPlan, dedupe_demands,
                                     plan_migration, target_demands)
 from tputopo.extender.state import ClusterState
 from tputopo.k8s.fakeapi import NotFound
+from tputopo.k8s.retry import ApiUnavailable, RetryPolicy, bind_retry
 from tputopo.obs import NULL_TRACER
 
 
@@ -68,7 +70,7 @@ class DefragController:
                  target_chips: int = 0, max_moves: int = 2,
                  max_chips_moved: int = 64, cooldown_s: float = 300.0,
                  hysteresis: int = 2, max_concurrent: int = 1,
-                 evict=None, state_factory=None) -> None:
+                 evict=None, state_factory=None, retry_rng=None) -> None:
         self.api = api
         self.clock = clock
         self.tracer = tracer if tracer is not None else NULL_TRACER
@@ -80,6 +82,17 @@ class DefragController:
         self.hysteresis = max(1, hysteresis)
         self.max_concurrent = max_concurrent
         self._evict = evict if evict is not None else self._evict_via_api
+        # Eviction deletes go through the shared retry policy via the one
+        # shared ``bind_retry`` wiring: a transient API failure
+        # mid-eviction must not wedge the cycle (and the sweep advances
+        # virtual time deterministically when the clock sleeps), and each
+        # retry is attributed (retry_api_timeout / retry_api_unavailable
+        # under the defrag_ metrics prefix) like every other call site.
+        # Jitter rng: per-instance entropy by default (no lockstep across
+        # replicas); the sim injects a pinned one.
+        retry_rng = retry_rng if retry_rng is not None else random.Random()
+        self._retry_call = bind_retry(RetryPolicy(), clock, retry_rng,
+                                      inc=self._count)
         self._state_factory = state_factory or (lambda: ClusterState(
             api, assume_ttl_s=assume_ttl_s, clock=clock,
             cost_for_generation=cost_for_generation).sync())
@@ -95,16 +108,28 @@ class DefragController:
     # ---- helpers -----------------------------------------------------------
 
     def _count(self, key: str, by: int = 1) -> None:
-        self.counters[key] += by
+        # .get, not []: fault-path keys (evict_errors, verify_replans)
+        # appear lazily on first increment — COUNTER_KEYS stays the
+        # pre-zeroed deterministic report vocabulary, so fault-free report
+        # bytes are unchanged by the fault counters' existence.
+        self.counters[key] = self.counters.get(key, 0) + by
         if self.metrics is not None:
             self.metrics.inc(f"defrag_{key}", by)
 
     def _evict_via_api(self, victim) -> None:
         for pod in victim.pods:
             try:
-                self.api.delete("pods", pod, victim.namespace)
+                self._retry_call(self.api.delete, "pods", pod,
+                                 victim.namespace)
             except NotFound:
                 continue  # completed/deleted meanwhile — nothing to move
+            except ApiUnavailable:
+                # Retries exhausted on one pod: count it and keep going —
+                # a partial eviction fails verification, and the verify
+                # path's re-plan (below) picks the work back up; a raise
+                # here would wedge the controller loop instead.
+                self._count("evict_errors")
+                continue
 
     def demands(self, state: ClusterState) -> list[tuple[int, int]]:
         """The demand shapes this cycle plans for: the configured fixed
@@ -141,6 +166,9 @@ class DefragController:
                     obj = self.api.get("pods", pod, ns)
                 except NotFound:
                     unbound = True  # deleted or not yet recreated
+                    break
+                except ApiUnavailable:
+                    unbound = True  # indeterminate — keep the slot held
                     break
                 if not obj.get("spec", {}).get("nodeName"):
                     unbound = True  # recreated, still Pending
@@ -229,12 +257,28 @@ class DefragController:
             self._pressure_streak = 0
 
         with tr.phase("verify") as sp:
-            after = self._state_factory()
-            dom = after.domains.get(plan.slice_id)
-            restored = (dom is not None
-                        and plan.box_mask & dom.allocator.used_mask == 0)
+            try:
+                after = self._state_factory()
+                dom = after.domains.get(plan.slice_id)
+                restored = (dom is not None
+                            and plan.box_mask & dom.allocator.used_mask == 0)
+            except ApiUnavailable:
+                # Verification itself failed transiently: indeterminate,
+                # treated like a failed verify — the re-plan below covers
+                # it instead of the old raise wedging the loop.
+                restored = False
             sp.count("restored" if restored else "failed")
             self._count("boxes_restored" if restored else "verify_failed")
+            if not restored:
+                # Re-plan instead of wedging: the evictions happened but
+                # the box is not (provably) free — something re-placed
+                # into it, a delete failed, or the verify read itself
+                # errored.  Pressure is still real, so carry the streak at
+                # the hysteresis threshold: the next cycle may plan again
+                # as soon as the cooldown passes, rather than re-earning
+                # ``hysteresis`` pressured cycles on top of it.
+                self._pressure_streak = self.hysteresis
+                self._count("verify_replans")
         return self._done(tr, "executed",
                           "restored" if restored else "box_not_free",
                           plan, restored)

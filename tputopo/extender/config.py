@@ -59,6 +59,13 @@ class ExtenderConfig:
     # 200): long-horizon incident forensics can raise it, memory-tight
     # deployments can shrink it.
     decisions_retention: int = 200
+    # Per-request socket deadline on the extender's HTTP server: a client
+    # that stops reading or writing must not pin a server thread forever.
+    # Applied via the handler's socket timeout; a tripped deadline closes
+    # the connection.  (Upstream API stalls are bounded separately, by the
+    # scheduler's per-verb retry deadlines — this knob only covers the
+    # client socket.)
+    http_timeout_s: float = 30.0
     # Defragmentation loop (tputopo.defrag): opt-in background cycle that
     # evicts the cheapest blocking jobs when pending gang shapes cannot
     # place despite enough free chips.  The dry-run plan is always served

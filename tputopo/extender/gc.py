@@ -20,7 +20,8 @@ from __future__ import annotations
 import time
 
 from tputopo.k8s import objects as ko
-from tputopo.k8s.fakeapi import FakeApiServer, NotFound
+from tputopo.k8s.fakeapi import Conflict, FakeApiServer, NotFound
+from tputopo.k8s.retry import ApiUnavailable
 from tputopo.extender.state import ClusterState
 
 
@@ -78,6 +79,14 @@ class AssumptionGC:
                 released.append(f"{ns}/{name}")
             except NotFound:
                 continue  # pod deleted meanwhile — already released
+            except (ApiUnavailable, Conflict):
+                # Transient API failure or a racing writer on ONE victim
+                # must not abort the whole sweep (the other victims still
+                # need releasing) and must not kill the GC loop: skip it —
+                # the pod stays expired, so the next sweep retries.
+                if self.metrics is not None:
+                    self.metrics.inc("gc_release_errors")
+                continue
         self.released.extend(released)
         del self.released[:-500]
         if self.metrics is not None:

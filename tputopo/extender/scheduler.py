@@ -25,12 +25,15 @@ from __future__ import annotations
 import bisect
 import functools
 import math
+import random
 import threading
 import time
 from dataclasses import dataclass, field
 
 from tputopo.k8s import objects as ko
 from tputopo.k8s.fakeapi import Conflict, FakeApiServer, NotFound
+from tputopo.k8s.retry import (ApiTimeout, ApiUnavailable, RetryPolicy,
+                               bind_retry)
 from tputopo.obs import NULL_TRACER, Tracer
 from tputopo.extender.config import ExtenderConfig
 from tputopo.extender.state import (ClusterState, PodAssignment, SliceDomain,
@@ -62,7 +65,15 @@ def _host_grid(generation, grid_dims: tuple[int, ...],
 
 
 class BindError(RuntimeError):
-    pass
+    """A bind verb failure.  ``reason`` is the structured failure class
+    (``conflict`` / ``unavailable`` / ``timeout`` / ``gang_infeasible`` /
+    ``wrong_node`` / ``not_found`` / ``already_bound`` / ``error``) — what
+    the sim's retry-by-reason accounting and a caller deciding between
+    re-queue and re-plan key on, instead of parsing the message."""
+
+    def __init__(self, msg: str, reason: str = "error") -> None:
+        super().__init__(msg)
+        self.reason = reason
 
 
 def quantile(sorted_xs, q: float):
@@ -171,6 +182,17 @@ def _wanted_generation(pod: dict) -> str | None:
     return meta.get(ko.ANN_GENERATION_LABEL)
 
 
+def bound_as_planned(pod: dict, node_name: str, group: str) -> bool:
+    """True when ``pod`` is bound to ``node_name`` carrying exactly the
+    chip-group annotation ``group`` — THE predicate for "this Conflict is
+    the echo of my own timed-out-but-applied bind".  Shared by the bind
+    verb's reconciliation and the sim baseline policy, so the rule can
+    never drift between them."""
+    return (pod.get("spec", {}).get("nodeName") == node_name
+            and pod.get("metadata", {}).get("annotations", {})
+                   .get(ko.ANN_GROUP) == group)
+
+
 def _gang_of(pod: dict) -> tuple[str, str, int] | None:
     """(namespace, gang_id, size) — gang identity is namespace-scoped so
     same-named gangs in different namespaces never merge."""
@@ -191,10 +213,22 @@ def _gang_of(pod: dict) -> tuple[str, str, int] | None:
 class ExtenderScheduler:
     def __init__(self, api_server: FakeApiServer,
                  config: ExtenderConfig | None = None,
-                 clock=time.time, informer=None, tracer=None) -> None:
+                 clock=time.time, informer=None, tracer=None,
+                 retry: RetryPolicy | None = None, retry_rng=None) -> None:
         self.api = api_server
         self.config = config or ExtenderConfig()
         self.clock = clock
+        # Shared retry discipline (tputopo.k8s.retry) for the API calls the
+        # verbs make: transient 5xx/timeouts back off and retry instead of
+        # surfacing as hard verb failures.  Sleep rides the clock when it
+        # carries one (the sim's VirtualClock advances virtual time —
+        # deterministic backoff); ``retry_rng`` seeds the jitter — the
+        # sim pins one, and the default is per-instance entropy so N
+        # deployed extenders never retry a flapping apiserver in
+        # lockstep (the whole point of jitter).
+        self.retry = retry if retry is not None else RetryPolicy()
+        self._retry_rng = retry_rng if retry_rng is not None \
+            else random.Random()
         # Flight recorder (tputopo.obs): sort/bind open a trace with
         # nested phase spans and attach a per-decision explain record.
         # An explicit ``tracer`` wins (the sim injects its virtual-clock
@@ -217,6 +251,8 @@ class ExtenderScheduler:
         # derived state so neither verb pays an O(pods) re-sync per call.
         self.informer = informer
         self.metrics = Metrics()
+        self._retry_call = bind_retry(self.retry, clock, self._retry_rng,
+                                      inc=self.metrics.inc)
         self.decisions: list[dict] = []  # recent decision records (observability)
         self._cached_state: ClusterState | None = None
         self._cached_at: float = 0.0
@@ -725,6 +761,26 @@ class ExtenderScheduler:
             )
 
         src = reader or self.api
+        # O(gang) fast path: the fake API and the informer mirror both
+        # maintain a merged-meta equality index over the gang-id key
+        # (fakeapi.INDEXED_META), so membership is an index lookup instead
+        # of a client-side filtered LIST over every pod (~580k is_member
+        # calls per standard sim trace before this).  ``copy=False`` only
+        # against the mirror (entries replaced wholesale — safe snapshot);
+        # the authoritative server may have concurrent in-place patchers,
+        # so it deepcopies the O(gang) result.  The REST client has no
+        # index — the filtered LIST below stays its path.
+        fast = getattr(src, "list_by_meta", None)
+        if fast is not None:
+            try:
+                members = fast("pods", LABEL_GANG_ID, gang_id,
+                               copy=reader is None)
+            except (KeyError, TypeError):
+                members = None
+            if members is not None:
+                return [p for p in members
+                        if p["metadata"].get("namespace", "default")
+                        == namespace]
         try:
             # Copy-free when the reader supports it (the informer mirror,
             # whose stored objects are replaced wholesale, never mutated):
@@ -1118,15 +1174,40 @@ class ExtenderScheduler:
             if not anns.get(ko.ANN_GROUP) or anns.get(ko.ANN_ASSIGNED) != "false":
                 continue
             try:
-                self.api.patch_annotations(
+                self._api_call(
+                    "release", self.api.patch_annotations,
                     "pods", md["name"],
                     {ko.ANN_GROUP: None, ko.ANN_ASSUME_TIME: None,
                      ko.ANN_ASSIGNED: None, ko.ANN_PREDICTED_GBPS: None},
                     namespace=md.get("namespace", "default"),
                     expect_version=md.get("resourceVersion"),
                 )
-            except (Conflict, NotFound):
-                continue  # racing Allocate confirm or deletion — leave it
+            except NotFound:
+                continue  # deleted meanwhile — nothing left to release
+            except Conflict:
+                # Either a racing writer (Allocate confirm — leave it to
+                # the GC) or the echo of our OWN release: an ambiguous
+                # timeout after the patch applied means the retry replays
+                # against a bumped resourceVersion and conflicts with its
+                # own success.  Re-read and reconcile, as the bind leg
+                # does: assumptions already wiped = the release landed.
+                try:
+                    cur = self.api.get("pods", md["name"],
+                                       md.get("namespace", "default"))
+                except NotFound:
+                    continue
+                except ApiUnavailable:
+                    self.metrics.inc("release_unavailable")
+                    continue
+                if (cur.get("metadata", {}).get("annotations")
+                        or {}).get(ko.ANN_GROUP):
+                    continue  # genuine racing writer — leave it to the GC
+                self.metrics.inc("release_conflict_resolved")
+            except ApiUnavailable:
+                # Retries exhausted: the TTL GC is the durable backstop for
+                # exactly this — an assumption we could not release now.
+                self.metrics.inc("release_unavailable")
+                continue
             released.append(md["name"])
             if self.informer is not None:
                 try:
@@ -1140,6 +1221,111 @@ class ExtenderScheduler:
             # The derived state still counts those chips as used.
             self._cached_state = None
         return released
+
+    # ---- crash recovery ----------------------------------------------------
+
+    def recover(self) -> dict:
+        """Startup/crash recovery: rebuild the assumption cache from API
+        truth and resolve every **in-flight gang** atomically.
+
+        The reference's statelessness posture (SURVEY.md §5.4: "a
+        restarted extender rebuilds its world from the API server") covers
+        occupancy but not in-flight *work*: an extender killed mid-gang-
+        bind leaves a gang with some members bound-and-assumed and the
+        rest Pending — chips half-reserved, the gang unable to run.  This
+        method closes that gap with the all-or-nothing rule applied at
+        restart: each such gang is either **completed** (the remaining
+        members still plan and bind — the normal sort/bind pipeline, so
+        recovery exercises no special-case placement code) or **released**
+        (every unconfirmed member's assumptions wiped via the CAS-guarded
+        release; the job controller re-queues it) — never left half.
+        Gangs with *confirmed* members that cannot complete are
+        additionally flagged ``stranded`` (running containers are the job
+        controller's to reclaim, the GC's stranded-gang rule).
+
+        Returns ``{"completed": [...], "released": [...], "stranded":
+        [...]}`` of ``namespace/gang-id`` strings, for logs and tests."""
+        self.metrics.inc("crash_recoveries")
+        with self._cache_lock:
+            self._cached_state = None
+            self._cached_informer_version = None
+        self._gang_plan_cache.clear()
+        self._unmirrored_binds.clear()
+        outcome: dict = {"completed": [], "released": [], "stranded": []}
+        state = self._state(allow_cache=False)
+        node_names = sorted(state._dom_by_node)
+        try:
+            pods = self._api_call("list", self.api.list, "pods")
+        except ApiUnavailable as e:
+            outcome["error"] = f"api unavailable listing pods: {e}"
+            return outcome
+        gangs: dict[tuple[str, str], dict] = {}
+        for p in pods:
+            g = _gang_of(p)
+            if g is None:
+                continue
+            info = gangs.setdefault((g[0], g[1]),
+                                    {"size": g[2], "members": []})
+            info["members"].append(p)
+        for (ns, gid), info in sorted(gangs.items()):
+            members = info["members"]
+            bound = [p for p in members if p["spec"].get("nodeName")]
+            if not bound or len(bound) >= info["size"]:
+                continue  # whole or untouched — not in flight
+            # Completing requires the full roster: with a member pod
+            # absent (deleted, or not yet recreated by the job
+            # controller), binding everything that EXISTS would still
+            # leave the gang partially bound — short rosters go straight
+            # to release.
+            completed = len(members) >= info["size"]
+            for p in sorted((m for m in members
+                             if not m["spec"].get("nodeName")),
+                            key=lambda m: m["metadata"]["name"]) \
+                    if completed else ():
+                try:
+                    scores = self.sort(p, node_names)
+                    best = (max(scores, key=lambda s: (s["Score"], s["Host"]))
+                            if scores else None)
+                    if best is None or best["Score"] <= 0:
+                        completed = False
+                        break
+                    self.bind(p["metadata"]["name"], ns, best["Host"])
+                except BindError:
+                    completed = False
+                    break
+            if completed:
+                self.metrics.inc("crash_gangs_completed")
+                outcome["completed"].append(f"{ns}/{gid}")
+                continue
+            # Release-or-complete, never half: wipe every still-unconfirmed
+            # member (bind's infeasible path may already have — the wipe is
+            # idempotent); confirmed members are running and flagged.
+            members_now = self._gang_members(ns, gid)
+            self._release_gang_assumptions(ns, gid, members=members_now)
+            self.metrics.inc("crash_gangs_released")
+            outcome["released"].append(f"{ns}/{gid}")
+            if any(p["spec"].get("nodeName")
+                   and p["metadata"].get("annotations", {})
+                         .get(ko.ANN_ASSIGNED) == "true"
+                   for p in members_now):
+                outcome["stranded"].append(f"{ns}/{gid}")
+        return outcome
+
+    # ---- retried API calls -------------------------------------------------
+
+    #: Per-verb retry deadlines (seconds on the scheduler clock): reads
+    #: give up fast (the caller re-queues), the CAS write leg gets the
+    #: longest leash (abandoning it mid-gang costs a rollback).
+    _VERB_DEADLINE_S = {"get": 5.0, "cas": 10.0, "release": 5.0,
+                        "list": 10.0}
+
+    def _api_call(self, verb: str, fn, *args, **kwargs):
+        """One API call under the shared RetryPolicy.  Each retry is
+        counted by failure class (``retry_api_timeout`` /
+        ``retry_api_unavailable``) so a chaos run's recovery work is
+        attributable from /metrics and the sim's chaos block."""
+        return self._retry_call(
+            fn, *args, deadline_s=self._VERB_DEADLINE_S.get(verb), **kwargs)
 
     # ---- bind --------------------------------------------------------------
 
@@ -1213,6 +1399,21 @@ class ExtenderScheduler:
         except ValueError:
             return None
 
+    def _resolve_bind_conflict(self, pod_name: str, namespace: str,
+                               node_name: str, anns: dict) -> dict | None:
+        """After a Conflict from the bind subresource: the pod as-bound if
+        the conflict is the echo of our own (timed-out-but-applied) bind —
+        same node, same chip group — else None (a real race)."""
+        try:
+            cur = self._api_call("get", self.api.get, "pods", pod_name,
+                                 namespace)
+        except Exception:
+            return None
+        if bound_as_planned(cur, node_name, anns[ko.ANN_GROUP]):
+            self.metrics.inc("bind_ambiguous_recovered")
+            return cur
+        return None
+
     def _bind_locked(self, pod_name: str, namespace: str, node_name: str) -> dict:
         tr = self.tracer.start(
             "bind", pod=f"{namespace or 'default'}/{pod_name}",
@@ -1228,10 +1429,21 @@ class ExtenderScheduler:
         self.metrics.inc("bind_requests")
         memo_base = self._memo_counter_snapshot() if tr.enabled else None
         try:
-            pod = self.api.get("pods", pod_name, namespace)
+            pod = self._api_call("get", self.api.get, "pods", pod_name,
+                                 namespace)
         except NotFound:
             self.metrics.inc("bind_errors")
-            raise BindError(f"pod {namespace}/{pod_name} not found") from None
+            raise BindError(f"pod {namespace}/{pod_name} not found",
+                            reason="not_found") from None
+        except ApiUnavailable as e:
+            # Retries exhausted: fail the verb cleanly — the kube-scheduler
+            # (or the sim engine) re-queues the pod and tries again later.
+            self.metrics.inc("bind_errors")
+            self.metrics.inc("bind_unavailable")
+            raise BindError(
+                f"api unavailable fetching {namespace}/{pod_name}: {e}",
+                reason=("timeout" if isinstance(e, ApiTimeout)
+                        else "unavailable")) from e
         # Idempotent retry (ADVICE r3): a bind replayed after a timed-out-
         # but-successful earlier bind must return the recorded decision,
         # not re-place the pod — re-running selection would overwrite the
@@ -1247,7 +1459,8 @@ class ExtenderScheduler:
             raise BindError(
                 f"pod {namespace}/{pod_name} is already bound to "
                 f"{prior_node}" + ("" if prior_node == node_name
-                                   else f", not {node_name}"))
+                                   else f", not {node_name}"),
+                reason="already_bound")
         # Sort's informer-coherent derived state serves bind too: binds are
         # serialized, every bind write-throughs its own delta (below), and
         # the API server's CAS on the patch/bind leg stays the authority —
@@ -1313,7 +1526,7 @@ class ExtenderScheduler:
                         raise BindError(
                             f"gang {gang_id!r} already has {n_bound} bound "
                             f"members of declared size {gang[2]} — nothing "
-                            "left to bind"
+                            "left to bind", reason="already_bound"
                         )
                     self.metrics.inc("bind_gang_infeasible")
                     # All-or-nothing, promptly: members that already hold
@@ -1327,13 +1540,15 @@ class ExtenderScheduler:
                     raise BindError(
                         f"gang {gang_id!r} cannot fit ({gang[2]} x {k} "
                         "chips) — binding nothing (all-or-nothing; released "
-                        f"{len(released)} unconfirmed member assumption(s))"
+                        f"{len(released)} unconfirmed member assumption(s))",
+                        reason="gang_infeasible"
                     )
                 if node_name not in gang_ctx["plan"]:
                     self.metrics.inc("bind_gang_wrong_node")
                     raise BindError(
                         f"node {node_name} is not in gang {gang_id!r}'s plan "
-                        f"(planned: {sorted(gang_ctx['plan'])})"
+                        f"(planned: {sorted(gang_ctx['plan'])})",
+                        reason="wrong_node"
                     )
                 placement = gang_ctx["plan"][node_name]
             else:
@@ -1357,11 +1572,37 @@ class ExtenderScheduler:
             anns[ko.ANN_GANG_ID] = gang_id
         with tr.phase("cas_patch"):
             try:
-                self.api.patch_annotations("pods", pod_name, anns, namespace)
-                bound_obj = self.api.bind_pod(pod_name, node_name, namespace)
-            except (Conflict, NotFound) as e:
+                self._api_call("cas", self.api.patch_annotations, "pods",
+                               pod_name, anns, namespace)
+                try:
+                    bound_obj = self._api_call("cas", self.api.bind_pod,
+                                               pod_name, node_name, namespace)
+                except Conflict as e:
+                    # Ambiguity resolution: a retried bind whose earlier
+                    # attempt actually committed (timeout-after-apply)
+                    # conflicts against its OWN success.  Re-read: bound to
+                    # our node carrying our chip group means the bind is
+                    # done — anything else is a genuine race.
+                    bound_obj = self._resolve_bind_conflict(
+                        pod_name, namespace, node_name, anns)
+                    if bound_obj is None:
+                        raise
+            except Conflict as e:
                 self.metrics.inc("bind_errors")
-                raise BindError(f"bind race on {pod_name}: {e}") from e
+                self.metrics.inc("bind_conflicts")
+                raise BindError(f"bind race on {pod_name}: {e}",
+                                reason="conflict") from e
+            except NotFound as e:
+                self.metrics.inc("bind_errors")
+                raise BindError(f"bind race on {pod_name}: {e}",
+                                reason="not_found") from e
+            except ApiUnavailable as e:
+                self.metrics.inc("bind_errors")
+                self.metrics.inc("bind_unavailable")
+                raise BindError(
+                    f"api unavailable binding {pod_name}: {e}",
+                    reason=("timeout" if isinstance(e, ApiTimeout)
+                            else "unavailable")) from e
         # Manual span (not ``with``): the publish section is a pair of
         # top-level alternative branches; everything inside either swallows
         # its exceptions or cannot raise, and the root trace records even

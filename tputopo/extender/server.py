@@ -40,6 +40,13 @@ class _Handler(BaseHTTPRequestHandler):
     scheduler: ExtenderScheduler  # set by server factory
     config: ExtenderConfig
 
+    #: Per-request socket deadline (BaseHTTPRequestHandler applies it in
+    #: setup()): a stalled client cannot pin a server thread forever.
+    #: Upstream API stalls are bounded by the scheduler's per-verb retry
+    #: deadlines, not this.  Overridden from ExtenderConfig.http_timeout_s
+    #: by the server factory.
+    timeout = 30.0
+
     # ---- plumbing ----------------------------------------------------------
 
     def log_message(self, fmt, *args):  # quiet; metrics cover observability
@@ -52,6 +59,25 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _send_guarded(self, code: int, obj) -> None:
+        """Send an error body with the send itself guarded: when the
+        failure IS the socket (client gone, deadline tripped), there is
+        nothing left to write to and a second exception here would just
+        spray the server log."""
+        try:
+            self._send_json(code, obj)
+        except Exception:
+            pass
+
+    def _send_error_json(self, code: int, exc: BaseException,
+                         path: str) -> None:
+        """Structured error body — type/message/path, never a traceback."""
+        self._send_guarded(code, {"error": {
+            "type": type(exc).__name__,
+            "message": str(exc),
+            "path": path,
+        }})
 
     def _send_text(self, code: int, text: str) -> None:
         body = text.encode()
@@ -81,12 +107,22 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(404, {"error": f"unknown path {self.path}"})
         except (ValueError, KeyError, json.JSONDecodeError) as e:
             self.scheduler.metrics.inc("bad_requests")
-            self._send_json(400, {"error": str(e)})
+            self._send_guarded(400, {"error": str(e)})
+        except OSError:
+            # OUR socket, not the API server: the client stalled past
+            # http_timeout_s or hung up mid-request/response.  KubeApiClient
+            # converts its transport OSErrors (URLError, socket timeouts) to
+            # ApiUnavailable/ApiTimeout before they reach a verb, so an
+            # OSError escaping here is the handler's own connection — count
+            # it apart from api_errors (an apiserver-health signal) and
+            # don't answer a dead socket.
+            self.scheduler.metrics.inc("http_client_errors")
         except Exception as e:  # API-server unreachable, etc. — fail closed
             # with a response, not a dropped socket (a real KubeApiClient
-            # raises URLError/RuntimeError the in-memory fake never did).
+            # raises ApiUnavailable/RuntimeError the in-memory fake never
+            # did).
             self.scheduler.metrics.inc("api_errors")
-            self._send_json(503, {"error": f"{type(e).__name__}: {e}"})
+            self._send_guarded(503, {"error": f"{type(e).__name__}: {e}"})
 
     def do_GET(self) -> None:
         url = urllib.parse.urlsplit(self.path)
@@ -105,9 +141,17 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, self.config.policy_json())
             else:
                 self._send_json(404, {"error": f"unknown path {self.path}"})
+        except OSError:
+            # Scraper hung up or stalled past http_timeout_s — the
+            # handler's own socket, not a handler bug (see do_POST).
+            self.scheduler.metrics.inc("http_client_errors")
         except Exception as e:
-            self.scheduler.metrics.inc("api_errors")
-            self._send_json(503, {"error": f"{type(e).__name__}: {e}"})
+            # Observability endpoints fail with a counted, structured 500
+            # — never a traceback down the socket, never an uncounted
+            # drop.  (The scheduling verbs above keep their 503 fail-
+            # closed semantics; this is the monitoring surface.)
+            self.scheduler.metrics.inc("http_internal_errors")
+            self._send_error_json(500, e, url.path)
 
     def _handle_state(self) -> None:
         # Serve from the informer mirror exactly like the verbs do
@@ -312,6 +356,7 @@ class ExtenderHTTPServer:
         self.config = config or scheduler.config
         handler = type("Handler", (_Handler,), {
             "scheduler": scheduler, "config": self.config,
+            "timeout": getattr(self.config, "http_timeout_s", 30.0) or None,
         })
         self.httpd = ThreadingHTTPServer(
             (host, self.config.port if port is None else port), handler)
@@ -368,6 +413,21 @@ def main() -> None:  # pragma: no cover - thin CLI wrapper
     informer = Informer(api_server).start()
     scheduler = ExtenderScheduler(api_server, config, informer=informer)
     server = ExtenderHTTPServer(scheduler, config, host=args.host)
+
+    # Crash recovery before serving: a restart mid-gang-bind left gangs
+    # half-assumed in the API — resolve each to fully-bound or fully-
+    # released (ExtenderScheduler.recover) so the first live verb plans
+    # against a whole world.  Failures are logged, not fatal: the GC's
+    # TTL remains the durable backstop.
+    informer.wait_synced(timeout=30.0)
+    try:
+        rec = scheduler.recover()
+        if rec.get("completed") or rec.get("released"):
+            print(f"recover: completed {rec['completed']}, "
+                  f"released {rec['released']}, stranded {rec['stranded']}")
+    except Exception as e:
+        print(f"recover: skipped ({type(e).__name__}: {e}); "
+              "GC remains the backstop")
 
     from tputopo.extender.gc import AssumptionGC
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import ssl
 import urllib.error
 import urllib.parse
@@ -29,13 +30,27 @@ import urllib.request
 from typing import Callable
 
 from tputopo.k8s.fakeapi import Conflict, Gone, NotFound
+from tputopo.k8s.retry import ApiTimeout, ApiUnavailable, RetryPolicy
 
 _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+#: HTTP statuses that mean "the server is fine to ask again" — surfaced
+#: as :class:`ApiUnavailable` so every caller shares one transient
+#: vocabulary (the fake API's chaos layer raises the same types).
+_TRANSIENT_HTTP = (429, 500, 502, 503, 504)
+
+#: Methods the transport itself retries: idempotent by HTTP semantics
+#: (GET/DELETE) or by payload (merge-PATCH of the same content; a CAS
+#: PATCH whose first attempt applied conflicts on replay, which the verb
+#: layer resolves).  POST (create/bind) is NOT transport-retried — its
+#: ambiguity is the caller's to reconcile (see the bind verb).
+_RETRIED_METHODS = frozenset({"GET", "DELETE", "PATCH", "PUT"})
 
 
 class KubeApiClient:
     def __init__(self, base_url: str | None = None, token: str | None = None,
-                 ca_path: str | None = None, timeout_s: float = 10.0) -> None:
+                 ca_path: str | None = None, timeout_s: float = 10.0,
+                 retry: RetryPolicy | None = None) -> None:
         if base_url is None:
             host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
             port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
@@ -48,6 +63,17 @@ class KubeApiClient:
                     token = f.read().strip()
         self.token = token
         self.timeout_s = timeout_s
+        # The transport default is deliberately TIGHT: one fast replay to
+        # absorb a connection blip, deadline-capped at the socket timeout.
+        # Callers above (scheduler `_api_call`, defrag, GC) wrap verbs in
+        # their own RetryPolicy with per-verb deadlines; a loose transport
+        # loop underneath would multiply attempts and let a single verb
+        # call block for attempts x timeout_s, far past those deadlines.
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_attempts=2, deadline_s=timeout_s)
+        # Per-client entropy for backoff jitter: many extender replicas
+        # must not retry a flapping apiserver in lockstep.
+        self._retry_rng = random.Random()
         self._ctx: ssl.SSLContext | None = None
         if self.base_url.startswith("https"):
             ca = ca_path or os.path.join(_SA_DIR, "ca.crt")
@@ -58,6 +84,18 @@ class KubeApiClient:
 
     def _request(self, method: str, path: str, body: dict | None = None,
                  content_type: str = "application/json") -> dict:
+        """One HTTP round-trip with the shared retry discipline: transient
+        statuses and timeouts become :class:`ApiUnavailable` /
+        :class:`ApiTimeout`, and idempotent methods are retried with the
+        jittered-backoff :class:`RetryPolicy` before the error escapes to
+        the verb layer."""
+        if method in _RETRIED_METHODS:
+            return self.retry.call(self._request_once, method, path, body,
+                                   content_type, rng=self._retry_rng)
+        return self._request_once(method, path, body, content_type)
+
+    def _request_once(self, method: str, path: str, body: dict | None = None,
+                      content_type: str = "application/json") -> dict:
         url = self.base_url + path
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
@@ -76,7 +114,19 @@ class KubeApiClient:
                 raise NotFound(f"{method} {path}: {detail}") from None
             if e.code == 409:
                 raise Conflict(f"{method} {path}: {detail}") from None
+            if e.code in _TRANSIENT_HTTP:
+                raise ApiUnavailable(
+                    f"{method} {path} -> {e.code}: {detail}") from None
             raise RuntimeError(f"{method} {path} -> {e.code}: {detail}") from None
+        except TimeoutError as e:  # socket.timeout — response never came
+            raise ApiTimeout(f"{method} {path}: {e}") from None
+        except urllib.error.URLError as e:
+            # Connection refused / DNS / TLS reset — no response, so the
+            # request did not apply; a timeout buried in the reason is
+            # ambiguous and surfaces as such.
+            if isinstance(getattr(e, "reason", None), TimeoutError):
+                raise ApiTimeout(f"{method} {path}: {e.reason}") from None
+            raise ApiUnavailable(f"{method} {path}: {e.reason}") from None
         return json.loads(raw) if raw else {}
 
     @staticmethod
@@ -175,7 +225,14 @@ class KubeApiClient:
             detail = e.read().decode(errors="replace")[:500]
             if e.code == 410:
                 raise Gone(f"watch {kind}@{resource_version}: {detail}") from None
+            if e.code in _TRANSIENT_HTTP:
+                raise ApiUnavailable(
+                    f"watch {kind} -> {e.code}: {detail}") from None
             raise RuntimeError(f"watch {kind} -> {e.code}: {detail}") from None
+        except TimeoutError as e:
+            raise ApiTimeout(f"watch {kind}: {e}") from None
+        except urllib.error.URLError as e:
+            raise ApiUnavailable(f"watch {kind}: {e.reason}") from None
         with resp:
             for raw in resp:
                 line = raw.strip()

@@ -31,7 +31,7 @@ import time
 from collections import deque
 from typing import Callable
 
-from tputopo.k8s.fakeapi import Gone, NotFound, matches_labels
+from tputopo.k8s.fakeapi import (Gone, MetaIndex, NotFound, matches_labels)
 
 
 def _obj_rv(obj: dict) -> int:
@@ -60,6 +60,13 @@ class Informer:
         self.relist_backoff_s = relist_backoff_s
         self._store: dict[str, dict[tuple[str, str], dict]] = {
             k: {} for k in kinds}
+        # Mirror-side meta equality index — the same MetaIndex structure
+        # (and key vocabulary / precedence rule) as the fake API server's
+        # authoritative one.  Maintained wherever a mirror entry is
+        # installed/removed (_relist / _apply / observe), so gang-member
+        # lookup against the mirror is O(gang) instead of a filtered LIST
+        # of every pod.
+        self._meta_index = MetaIndex()
         self._rv: dict[str, str] = {}
         # Content version: bumped ONLY when the mirror's content actually
         # changes (install of a new/newer object, a delete that removed
@@ -152,6 +159,7 @@ class Informer:
                 # unordered — install (can't prove identity) and bump.
                 if cur is None or obj_rv > cur_rv or obj_rv == cur_rv == 0:
                     self._store[kind][key] = obj
+                    self._index_install(kind, key, cur, obj)
                     self._content += 1
                     self._journal.append((self._content, kind, "MODIFIED", obj))
                     self._observe_count += 1
@@ -187,6 +195,21 @@ class Informer:
                 return None
             return [(kind, etype, obj) for _, kind, etype, obj in tail], token
 
+    # ---- meta index maintenance (call under self._lock) --------------------
+
+    def _index_install(self, kind: str, key: tuple[str, str],
+                       old: dict | None, new: dict) -> None:
+        self._meta_index.install(kind, key, new, old=old)
+
+    def _index_remove(self, kind: str, key: tuple[str, str],
+                      obj: dict) -> None:
+        self._meta_index.remove(kind, key, obj)
+
+    def _index_rebuild(self, kind: str) -> None:
+        self._meta_index.drop_kind(kind)
+        for key, obj in self._store[kind].items():
+            self._meta_index.install(kind, key, obj)
+
     # ---- list+watch loop ---------------------------------------------------
 
     def _relist(self, kind: str) -> None:
@@ -207,6 +230,7 @@ class Informer:
                 if cur_rv > snap_rv and cur_rv > _obj_rv(new_store.get(key, {})):
                     new_store[key] = cur
             self._store[kind] = new_store
+            self._index_rebuild(kind)
             self._rv[kind] = rv
             self._content += 1  # conservative: a relist may change anything
         self.metrics["lists"] += 1
@@ -232,7 +256,9 @@ class Informer:
                     if del_rv == 0:
                         self.metrics["unordered_deletes_kept"] += 1
                 else:
-                    if self._store[kind].pop(key, None) is not None:
+                    removed = self._store[kind].pop(key, None)
+                    if removed is not None:
+                        self._index_remove(kind, key, removed)
                         self._content += 1
                         self._journal.append(
                             (self._content, kind, "DELETED", obj))
@@ -248,6 +274,7 @@ class Informer:
                 obj_rv, cur_rv = _obj_rv(obj), _obj_rv(cur or {})
                 if cur is None or obj_rv > cur_rv or obj_rv == cur_rv == 0:
                     self._store[kind][key] = obj
+                    self._index_install(kind, key, cur, obj)
                     self._content += 1
                     self._journal.append(
                         (self._content, kind, event["type"], obj))
@@ -299,6 +326,23 @@ class Informer:
             out = [o for o in out if selector(o)]
         return sorted(out, key=lambda o: (o["metadata"].get("namespace", ""),
                                           o["metadata"]["name"]))
+
+    def list_by_meta(self, kind: str, key: str, value: str,
+                     copy: bool = True) -> list[dict]:
+        """Mirror objects whose merged metadata maps ``key`` to ``value``
+        — the informer half of :meth:`FakeApiServer.list_by_meta`
+        (O(result) via the maintained index; unindexed keys raise
+        KeyError).  ``copy=False`` returns the mirrored dicts under the
+        same read-only contract as ``list(copy=False)``; mirror entries
+        are replaced wholesale, never mutated, so each is a consistent
+        snapshot.  Sorted by (namespace, name)."""
+        import copy as copymod
+        with self._lock:
+            objs = self._meta_index.lookup(kind, key, value)
+        if copy:
+            objs = [copymod.deepcopy(o) for o in objs]
+        return sorted(objs, key=lambda o: (o["metadata"].get("namespace", ""),
+                                           o["metadata"]["name"]))
 
     def get(self, kind: str, name: str, namespace: str | None = None) -> dict:
         import copy

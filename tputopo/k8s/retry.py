@@ -1,0 +1,135 @@
+"""Shared retry/backoff policy for API-server calls.
+
+A production scheduler spends its life absorbing transient API failures —
+409 CAS conflicts, throttled 429s, apiserver 5xxs, plain network timeouts
+— and before this module every such error either crashed the calling
+thread or surfaced as a hard verb failure.  This is the one retry
+discipline every control-plane caller shares (:mod:`tputopo.k8s.client`
+transport, the extender's bind/publish legs, the defrag controller's
+evictions), so backoff behavior is a policy, not N ad-hoc loops.
+
+Two error classes split the transient vocabulary:
+
+- :class:`ApiUnavailable` — the server answered and said "not now"
+  (5xx/429).  The request certainly did NOT apply.
+- :class:`ApiTimeout` — no answer in time.  **Ambiguous**: the request
+  may or may not have applied, so callers of non-idempotent verbs must
+  resolve the ambiguity on retry (the bind path re-reads the pod and
+  treats "already bound to my node with my chip group" as its own
+  success — see ``_bind_spanned``).
+
+Virtual-clock awareness: ``call`` takes ``clock``/``sleep`` hooks, so the
+simulator retries on *virtual* time (deterministic backoff, seeded
+jitter) while the deployed extender uses ``time.time``/``time.sleep``.
+Conflict (409) is deliberately NOT retryable here: a CAS conflict means
+the caller's world view is stale, and the correct reaction is a re-sync
+and re-plan at the verb layer, not a blind replay of the same write.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+
+class ApiUnavailable(RuntimeError):
+    """Transient API-server failure (5xx / 429 / connection refused): the
+    request did not apply; retrying with backoff is safe for every verb."""
+
+
+class ApiTimeout(ApiUnavailable):
+    """No response within the deadline.  Retry-safe for idempotent verbs;
+    AMBIGUOUS for writes — the request may have applied, so non-idempotent
+    callers must re-read and reconcile on retry."""
+
+
+#: The exception tuple retry loops catch by default.
+TRANSIENT_ERRORS = (ApiUnavailable,)
+
+
+def count_retries(inc):
+    """An ``on_retry`` hook that attributes each retry to the standard
+    counter names (``retry_api_timeout`` / ``retry_api_unavailable``) via
+    ``inc(name)`` — THE fault-class-to-counter mapping, shared by every
+    call site so chaos-report retry attribution can never drift."""
+
+    def on_retry(e, attempt):
+        inc("retry_api_timeout" if isinstance(e, ApiTimeout)
+            else "retry_api_unavailable")
+
+    return on_retry
+
+
+def bind_retry(policy: "RetryPolicy", clock, rng, inc=None):
+    """Wire a :class:`RetryPolicy` to one caller's clock and counter sink.
+
+    Returns ``call(fn, *args, deadline_s=None, **kwargs)``.  Sleep is
+    derived from the clock (``clock.sleep`` when present, so the sim's
+    backoffs cost virtual seconds) and every retry is attributed through
+    :func:`count_retries` when ``inc`` is given — the ONE spelling of
+    this wiring, shared by the extender scheduler, the sim baseline
+    policy, and the defrag controller so none of them can drift (the
+    defrag copy once silently dropped the counting hook)."""
+    sleep = getattr(clock, "sleep", None) or time.sleep
+    on_retry = None if inc is None else count_retries(inc)
+
+    def call(fn, *args, deadline_s=None, **kwargs):
+        return policy.call(fn, *args, clock=clock, sleep=sleep, rng=rng,
+                           deadline_s=deadline_s, on_retry=on_retry,
+                           **kwargs)
+
+    return call
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Jittered exponential backoff with per-call deadlines.
+
+    ``max_attempts`` bounds total tries (first call included);
+    ``deadline_s`` bounds the whole operation on the caller's clock —
+    whichever trips first ends the retry loop by re-raising the last
+    transient error.  Jitter is ``±jitter_frac`` of the backoff, drawn
+    from the caller-supplied ``rng`` (seeded in the simulator so chaos
+    runs stay byte-deterministic; no rng means no jitter)."""
+
+    max_attempts: int = 4
+    base_backoff_s: float = 0.05
+    backoff_factor: float = 2.0
+    max_backoff_s: float = 2.0
+    jitter_frac: float = 0.5
+    deadline_s: float = 30.0
+
+    def backoff_s(self, attempt: int, rng=None) -> float:
+        """Backoff before retry number ``attempt`` (1-based)."""
+        b = min(self.max_backoff_s,
+                self.base_backoff_s * self.backoff_factor ** (attempt - 1))
+        if rng is not None and self.jitter_frac > 0:
+            b *= 1.0 + self.jitter_frac * (2.0 * rng.random() - 1.0)
+        return b
+
+    def call(self, fn, *args, clock=time.time, sleep=time.sleep, rng=None,
+             deadline_s: float | None = None,
+             retry_on=TRANSIENT_ERRORS, on_retry=None, **kwargs):
+        """Run ``fn(*args, **kwargs)``, retrying transient failures.
+
+        ``on_retry(exc, attempt)`` is called before each backoff sleep —
+        the metrics hook (the extender counts ``retry_api_timeout`` /
+        ``retry_api_unavailable`` there).  The deadline is judged on
+        ``clock`` BEFORE sleeping: a backoff that would overshoot it
+        re-raises immediately instead of sleeping into certain failure."""
+        deadline = clock() + (self.deadline_s if deadline_s is None
+                              else deadline_s)
+        attempt = 0
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except retry_on as e:
+                attempt += 1
+                if attempt >= self.max_attempts:
+                    raise
+                pause = self.backoff_s(attempt, rng)
+                if clock() + pause > deadline:
+                    raise
+                if on_retry is not None:
+                    on_retry(e, attempt)
+                sleep(pause)

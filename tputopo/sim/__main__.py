@@ -76,6 +76,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--defrag-hysteresis", type=int,
                    default=DEFAULT_DEFRAG["hysteresis"],
                    help="consecutive pressured cycles before acting")
+    p.add_argument("--chaos", default=None, metavar="PROFILE",
+                   help="run under the seeded fault-injection layer "
+                        "(tputopo.chaos): injected CAS conflicts, "
+                        "transient 500s/timeouts, node flaps, extender "
+                        "crash-restarts mid-gang-bind — profile from "
+                        "tputopo.chaos.PROFILES (e.g. api-flake, "
+                        "crash-storm); adds the per-policy chaos block + "
+                        "invariant audit (schema tputopo.sim/v4), still "
+                        "byte-deterministic per (seed, profile)")
     p.add_argument("--out", default=None, help="also write the report here")
     p.add_argument("--no-trace", action="store_true",
                    help="disable the flight recorder (NullTracer hot "
@@ -113,6 +122,13 @@ def main(argv: list[str] | None = None) -> int:
         duration_mean_s=args.duration_mean, ghost_prob=args.ghost_prob,
         node_failures=args.node_failures,
     )
+    if args.chaos is not None:
+        from tputopo.chaos import PROFILES
+
+        if args.chaos not in PROFILES:
+            print(f"unknown chaos profile {args.chaos!r}; available: "
+                  f"{sorted(PROFILES)}", file=sys.stderr)
+            return 2
     flight_trace = not args.no_trace
     defrag = None
     if args.defrag:
@@ -138,6 +154,7 @@ def main(argv: list[str] | None = None) -> int:
                                    gc_period_s=args.gc_period,
                                    flight_trace=flight_trace,
                                    defrag=defrag,
+                                   chaos=args.chaos,
                                    return_states=True)
         prof.disable()
         buf = io.StringIO()
@@ -150,6 +167,7 @@ def main(argv: list[str] | None = None) -> int:
                                    jobs=args.jobs,
                                    flight_trace=flight_trace,
                                    defrag=defrag,
+                                   chaos=args.chaos,
                                    return_states=True)
     wall_s = time.perf_counter() - t0
     if args.trace_out:

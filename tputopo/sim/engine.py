@@ -20,6 +20,7 @@ A/B across policies and assembles the report.
 from __future__ import annotations
 
 import heapq
+import random
 import time
 
 from tputopo.defrag import DefragController
@@ -45,14 +46,20 @@ class SimError(RuntimeError):
 
 
 class VirtualClock:
-    """The sim's time source — advanced only by the event loop, read by
-    the scheduler/GC through their existing ``clock`` hooks."""
+    """The sim's time source — advanced by the event loop, read by the
+    scheduler/GC through their existing ``clock`` hooks.  ``sleep``
+    advances virtual time directly: retry backoffs (tputopo.k8s.retry
+    discovers it via ``getattr(clock, "sleep")``) cost virtual seconds
+    instead of wall seconds, deterministically."""
 
     def __init__(self, t: float = 0.0) -> None:
         self.t = t
 
     def __call__(self) -> float:
         return self.t
+
+    def sleep(self, dt: float) -> None:
+        self.t += max(0.0, dt)
 
 
 class _CopyFreeApi:
@@ -79,6 +86,11 @@ class _CopyFreeApi:
 
     def get(self, kind, name, namespace=None):
         return self._api.get_nocopy(kind, name, namespace)
+
+    def list_by_meta(self, kind, key, value, copy=True):
+        # The engine is single-threaded: gang-member lookups read the
+        # stored objects directly (same contract as get/list above).
+        return self._api.list_by_meta(kind, key, value, copy=False)
 
 
 class _JobRun:
@@ -141,6 +153,30 @@ DEFAULT_DEFRAG = {
 }
 
 
+class _GcChaosMetrics:
+    """Counter-only Metrics facade for the engine's :class:`AssumptionGC`.
+
+    The GC sweeps through the same (possibly chaos-wrapped) API the
+    policy binds through, so an injected fault on a release patch is
+    recovery work that must be attributable from the chaos report — it
+    flows into the policy's chaos sink (``inc_chaos``).  Steady-state
+    sweep tallies and wall-ms observations are dropped: the engine
+    already reports GC activity deterministically, and host wall has no
+    place in report bytes."""
+
+    _KEEP = frozenset({"gc_release_errors"})
+
+    def __init__(self, policy) -> None:
+        self._policy = policy
+
+    def inc(self, name: str, by: int = 1) -> None:
+        if name in self._KEEP:
+            self._policy.inc_chaos(name, by)
+
+    def observe_ms(self, verb: str, ms: float) -> None:
+        pass
+
+
 class SimEngine:
     """One policy's run over one trace."""
 
@@ -154,7 +190,9 @@ class SimEngine:
                  assume_ttl_s: float = 60.0, gc_period_s: float = 30.0,
                  max_backfill_failures: int = 8,
                  flight_trace: bool = True,
-                 defrag: dict | None = None) -> None:
+                 defrag: dict | None = None,
+                 chaos: str | dict | None = None,
+                 audit_every: int = 0) -> None:
         self.trace = trace
         self.cfg = trace.config
         self.clock = VirtualClock(0.0)
@@ -163,6 +201,27 @@ class SimEngine:
                                   for n in self._node_objects}
         self.node_names = sorted(self._node_obj_by_name)
         read_api = _CopyFreeApi(self.api)
+        # Chaos (tputopo.chaos), opt-in: a seeded FaultPlan plus the
+        # injecting API proxy wrapped around everything the CONTROL PLANE
+        # under test reads/writes (policy scheduler, GC, defrag) — the
+        # engine's own bookkeeping (staging, confirms, pod deletes) models
+        # the job controller/kubelet and stays on the raw server.  One
+        # plan per engine, seeded from the trace seed: byte-deterministic
+        # per (seed, profile), across --jobs processes too.
+        self.fault_plan = None
+        self.chaos_profile: str | None = None
+        if chaos is not None:
+            from tputopo.chaos import ChaosApi, FaultPlan
+
+            if isinstance(chaos, str):
+                profile, overrides = chaos, {}
+            else:
+                knobs = dict(chaos)
+                profile = knobs.pop("profile")
+                overrides = knobs
+            self.fault_plan = FaultPlan(self.cfg.seed, profile, **overrides)
+            self.chaos_profile = profile
+            read_api = ChaosApi(read_api, self.fault_plan)
         # Flight recorder (tputopo.obs), on by default: a virtual-clock
         # tracer, so trace timestamps and explain records are
         # deterministic per (seed, config) — only span wall-ms is host
@@ -173,14 +232,16 @@ class SimEngine:
         self.tracer = (ObsTracer(capacity=64, clock=self.clock)
                        if flight_trace else NULL_TRACER)
         self.policy = get_policy(policy_name, read_api, self.clock,
-                                 assume_ttl_s, tracer=self.tracer)
+                                 assume_ttl_s, tracer=self.tracer,
+                                 fault_plan=self.fault_plan)
         # Chronological log of committed placements: (job, t, members)
         # always (cheap, deterministic — what the A/B first-divergence
         # finder compares); the policy's explain record attached when
         # tracing is on.
         self.decision_log: list[dict] = []
         self.gc = AssumptionGC(read_api, assume_ttl_s=assume_ttl_s,
-                               clock=self.clock)
+                               clock=self.clock,
+                               metrics=_GcChaosMetrics(self.policy))
         self.assume_ttl_s = assume_ttl_s
         self.gc_period_s = gc_period_s
         self.max_backfill_failures = max_backfill_failures
@@ -213,11 +274,24 @@ class SimEngine:
         self.capacity_epoch = 0
         self._scan_start = 0  # rotating backfill window (see _try_schedule)
         self.failed_nodes: set[str] = set()
+        self._repair_at: dict[str, float] = {}  # failed node -> latest declared repair
         self._blocked: dict[str, list[tuple]] = {}  # failed node -> chips blocked in twin
         self.ghosts: dict[str, float] = {}  # job name -> assume expiry time
         self._heap: list[tuple] = []
         self._seq = 0
         self._gc_pending = False
+        # Chaos accounting: requeues by cause (node failure vs defrag vs
+        # crash recovery) and failed place() attempts by the policy's
+        # structured reason — the attribution the chaos report block
+        # carries (kept cheap enough to track unconditionally).
+        self.requeue_reasons: dict[str, int] = {}
+        self.place_retry_reasons: dict[str, int] = {}
+        # Per-event invariant auditing (tests): every N processed events,
+        # run the occupancy/atomicity audit subset; violations collect
+        # here AND fail the final audit.
+        self.audit_every = audit_every
+        self.audit_violations: list[str] = []
+        self._chaos_block: dict | None = None  # memoized by run_state
         # Future substantive events (arrivals/completions/fail/repair) in
         # the heap — what decides whether a periodic defrag cycle re-arms
         # (a heap holding only housekeeping events must drain, or virtual
@@ -245,6 +319,7 @@ class SimEngine:
                 cooldown_s=float(knobs["cooldown_s"]),
                 hysteresis=int(knobs["hysteresis"]),
                 max_concurrent=int(knobs["max_concurrent"]),
+                retry_rng=random.Random(0xDEF4),
                 evict=self._defrag_evict,
                 state_factory=lambda: ClusterState(
                     read_api, assume_ttl_s=assume_ttl_s,
@@ -286,6 +361,31 @@ class SimEngine:
         needs — what a ``run_trace(jobs=N)`` worker process ships back
         instead of the engine (whose API server holds thread primitives).
         Call after :meth:`run_events`."""
+        chaos = self._chaos_block
+        if self.fault_plan is not None and chaos is None:
+            # Memoized: the final audit's "no orphans after GC" check runs
+            # a REAL sweep against the API — building the block twice
+            # would observe (and cause) different post-sweep worlds.
+            from tputopo.chaos.audit import audit_engine
+
+            invariants = audit_engine(self, final=True)
+            if self.audit_violations:
+                invariants = dict(invariants)
+                invariants["ok"] = False
+                invariants["per_event_violations"] = \
+                    self.audit_violations[:50]
+            chaos = {
+                "profile": self.chaos_profile,
+                "injected": dict(sorted(self.fault_plan.injected.items())),
+                "suppressed": self.fault_plan.suppressed,
+                "retries": self.policy.chaos_counters(),
+                "place_retries_by_reason": dict(
+                    sorted(self.place_retry_reasons.items())),
+                "requeues_by_reason": dict(
+                    sorted(self.requeue_reasons.items())),
+                "invariants": invariants,
+            }
+            self._chaos_block = chaos
         return RunState(
             policy_name=self.policy.name,
             horizon_s=self.horizon_s,
@@ -305,6 +405,10 @@ class SimEngine:
             # defrag-off report byte-identical to the pre-defrag schema).
             defrag=(dict(self.defrag.counters)
                     if self.defrag is not None else None),
+            # Chaos block (None when chaos is off — chaos-off reports stay
+            # byte-identical to the v3/v2 shapes): injected faults by
+            # kind, retry/requeue attribution, and the invariant audit.
+            chaos=chaos,
         )
 
     def run_events(self) -> None:
@@ -312,6 +416,15 @@ class SimEngine:
             self._push(job.arrival_s, self._ARRIVAL, job)
         for fail_t, repair_t, victim in self.trace.node_events:
             self._push(fail_t, self._FAIL, (victim, repair_t))
+        if self.fault_plan is not None:
+            # Injected node flaps: short fail->repair cycles beyond the
+            # trace's organic failures, drawn deterministically from the
+            # fault plan and delivered through the SAME failure path.
+            horizon = (self.trace.jobs[-1].arrival_s
+                       if self.trace.jobs else 0.0)
+            for fail_t, repair_t, victim in self.fault_plan.flap_events(
+                    len(self.node_names), horizon):
+                self._push(fail_t, self._FAIL, (victim, repair_t, True))
         if self.gc_period_s > 0:
             self._push(self.gc_period_s, self._GC, None)
         if self.defrag is not None and self.defrag_period_s > 0:
@@ -341,15 +454,32 @@ class SimEngine:
             if not self._heap and self.queue:
                 # Terminal drain: no future event will ever wake the queue
                 # again, so the per-wake failure budget must not be what
-                # leaves a feasible job stranded — retry everything once
+                # leaves a feasible job stranded — retry everything
                 # without it.  Placements push completion events, so the
-                # loop resumes; a drain that places nothing ends the run,
-                # and what remains is genuinely infeasible.
+                # loop resumes.  Under chaos, one pass is not enough: a
+                # feasible job's only drain attempt can draw an injected
+                # fault, and "the next wake retries" has no next wake —
+                # so keep draining while fault-classed retries occur (the
+                # consecutive-failure cap bounds each op's streak, and
+                # the pass bound backstops pathological draws).  A pass
+                # with neither placements nor faults means what remains
+                # is genuinely infeasible.  Fault-free this reduces
+                # exactly to the old single pass.
                 budget = self.max_backfill_failures
-                self.max_backfill_failures = len(self.queue) + 1
-                self.capacity_epoch += 1  # clear per-epoch failure memos
                 try:
-                    self._try_schedule()
+                    for _ in range(16):
+                        self.max_backfill_failures = len(self.queue) + 1
+                        self.capacity_epoch += 1  # clear failure memos
+                        placed_before = self.metrics.counts["scheduled"]
+                        faults_before = sum(self.place_retry_reasons
+                                            .values())
+                        self._try_schedule()
+                        if self._heap or not self.queue:
+                            break  # progress resumed the loop, or done
+                        if (self.metrics.counts["scheduled"] == placed_before
+                                and sum(self.place_retry_reasons.values())
+                                == faults_before):
+                            break  # no progress, no faults: infeasible
                 finally:
                     self.max_backfill_failures = budget
             # Invariant: an outstanding unconfirmed assumption always has
@@ -363,6 +493,18 @@ class SimEngine:
             # forever.)
             if self.ghosts and not self._gc_pending and self.gc_period_s > 0:
                 self._push(self.clock.t + self.gc_period_s, self._GC, None)
+            if self.audit_every and \
+                    self.events_processed % self.audit_every == 0:
+                from tputopo.chaos.audit import audit_engine
+
+                mid = audit_engine(self, final=False)
+                if not mid["ok"]:
+                    self.audit_violations.extend(
+                        f"event {self.events_processed} t={self.clock.t:.3f}: "
+                        f"{v}" for v in mid["violations"])
+        # Retry backoffs advance the virtual clock past the last event's
+        # timestamp; the report horizon must cover them.
+        self.horizon_s = max(self.horizon_s, self.clock.t)
         self.metrics.counts["unplaced_at_end"] = len(self.queue)
         self._sample_occupancy()
 
@@ -393,12 +535,25 @@ class SimEngine:
         del self.jobs[name]
         self._try_schedule()
 
-    def _on_node_fail(self, victim: int, repair_t: float) -> None:
+    def _on_node_fail(self, victim: int, repair_t: float,
+                      injected: bool = False) -> None:
         if victim >= len(self.node_names):
             return
         name = self.node_names[victim]
+        t_eff = max(repair_t, self.clock.t)
         if name in self.failed_nodes:
-            return  # overlapping failure of the same node — ignore
+            # Overlapping failure of an already-dead node: nothing new to
+            # evict, but the outage must last until the LATEST declared
+            # repair — a short injected flap must not silently truncate a
+            # longer organic outage (or vice versa).
+            if t_eff > self._repair_at.get(name, 0.0):
+                self._repair_at[name] = t_eff
+                self._push(t_eff, self._REPAIR, name)
+                if injected and self.fault_plan is not None:
+                    self.fault_plan.record("node_flap")
+            return
+        if injected and self.fault_plan is not None:
+            self.fault_plan.record("node_flap")
         self.failed_nodes.add(name)
         self.metrics.preempt["node_failures"] += 1
         try:
@@ -413,13 +568,14 @@ class SimEngine:
         victims = sorted({self.ledger[key] for key in dead
                           if key in self.ledger})
         for jname in victims:
-            self._requeue_job(self.jobs[jname])
+            self._requeue_job(self.jobs[jname], "node_failure")
         # The dead node's remaining chips leave the placeable pool.
         blocked = [c for c in self.chips_by_node[name]
                    if c in self.twin[sid].free]
         self._twin_mark(sid, blocked)
         self._blocked[name] = blocked
-        self._push(max(repair_t, self.clock.t), self._REPAIR, name)
+        self._repair_at[name] = t_eff
+        self._push(t_eff, self._REPAIR, name)
         self._sample_occupancy()
         if victims:
             # Evicted gangs freed chips on SURVIVING nodes too — requeued
@@ -429,6 +585,9 @@ class SimEngine:
     def _on_node_repair(self, name: str) -> None:
         if name not in self.failed_nodes:
             return
+        if self.clock.t < self._repair_at.get(name, 0.0):
+            return  # superseded by a later-declared repair of this outage
+        self._repair_at.pop(name, None)
         self.failed_nodes.discard(name)
         self.api.create("nodes", self._node_obj_by_name[name], echo=False)
         self.policy.invalidate()
@@ -507,16 +666,18 @@ class SimEngine:
             run = self.jobs.get(jname)
             if run is None:
                 continue  # completed/reclaimed since the plan was built
-            self._requeue_job(run)
+            self._requeue_job(run, "defrag_evict")
 
-    def _requeue_job(self, run: _JobRun) -> None:
+    def _requeue_job(self, run: _JobRun, reason: str = "other") -> None:
         """THE eviction/requeue path (node failures AND defrag
         migrations — one code path, so the report's preemption tally
         counts both): free the job's chips, delete and recreate its pods
-        Pending, restart its wait clock, count the churn.  Recreated
-        Pending pods carry no derived-state impact, so no policy
-        invalidation is needed for them (deletions were folded by
-        _delete_job_pods)."""
+        Pending, restart its wait clock, count the churn.  ``reason``
+        attributes the requeue (``node_failure`` / ``defrag_evict``) in
+        the chaos report block.  Recreated Pending pods carry no
+        derived-state impact, so no policy invalidation is needed for
+        them (deletions were folded by _delete_job_pods)."""
+        self.requeue_reasons[reason] = self.requeue_reasons.get(reason, 0) + 1
         self.metrics.preempt["pods_evicted"] += run.spec.replicas
         self.metrics.preempt["jobs_requeued"] += 1
         self.metrics.counts["evicted_requeues"] += 1
@@ -566,9 +727,23 @@ class SimEngine:
             decisions = self.policy.place(run.spec, alive,
                                           handles=run.handles)
             if decisions is None:
-                if run.spec.replicas > 1:
+                # Fault attribution: a None caused by a transient fault
+                # (bind conflict, API timeout, crash recovery) is a retry,
+                # not a capacity verdict — tally it by reason, and do NOT
+                # burn a per-epoch failure memo on it (capacity did not
+                # shrink; the very next wake may succeed).  Fault-aborted
+                # attempts get the reset check at ANY size: a single pod
+                # can end up bound-but-unreported after an exhausted
+                # ambiguous-timeout retry, not just a partial gang.
+                reason = getattr(self.policy, "last_none_reason", None)
+                faulted = reason is not None and reason != "infeasible"
+                if faulted:
+                    self.place_retry_reasons[reason] = \
+                        self.place_retry_reasons.get(reason, 0) + 1
+                else:
+                    run.failed_epoch = self.capacity_epoch
+                if run.spec.replicas > 1 or faulted:
                     self._reset_if_partially_bound(run)
-                run.failed_epoch = self.capacity_epoch
                 failures += 1
                 continue
             self._commit(run, decisions)
@@ -726,12 +901,13 @@ class RunState:
 
     __slots__ = ("policy_name", "horizon_s", "end_t", "metrics",
                  "placed_chips", "frag", "counters", "events_processed",
-                 "phases", "phase_wall_ms", "decision_log", "defrag")
+                 "phases", "phase_wall_ms", "decision_log", "defrag",
+                 "chaos")
 
     def __init__(self, *, policy_name, horizon_s, end_t, metrics,
                  placed_chips, frag, counters, events_processed,
                  phases=None, phase_wall_ms=None,
-                 decision_log=None, defrag=None) -> None:
+                 decision_log=None, defrag=None, chaos=None) -> None:
         self.policy_name = policy_name
         self.horizon_s = horizon_s
         self.end_t = end_t
@@ -744,6 +920,7 @@ class RunState:
         self.phase_wall_ms = phase_wall_ms or {}
         self.decision_log = decision_log or []
         self.defrag = defrag
+        self.chaos = chaos
 
 
 def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
@@ -765,6 +942,11 @@ def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
         # (schema tputopo.sim/v3); its absence keeps defrag-off reports
         # byte-identical to the v2 shape.
         out["defrag"] = dict(sorted(rs.defrag.items()))
+    if rs.chaos is not None:
+        # Chaos accounting + invariant audit — present only under --chaos
+        # (schema tputopo.sim/v4); its absence keeps chaos-off reports
+        # byte-identical to the v3/v2 shapes.
+        out["chaos"] = rs.chaos
     return out
 
 
@@ -800,10 +982,11 @@ def _run_policy_worker(args) -> RunState:
     unit.  Regenerates the trace from the config (deterministic per seed,
     pinned by tests) so nothing heavyweight crosses the process boundary
     in either direction."""
-    cfg, name, assume_ttl_s, gc_period_s, flight_trace, defrag = args
+    cfg, name, assume_ttl_s, gc_period_s, flight_trace, defrag, chaos = args
     engine = SimEngine(generate_trace(cfg), name,
                        assume_ttl_s=assume_ttl_s, gc_period_s=gc_period_s,
-                       flight_trace=flight_trace, defrag=defrag)
+                       flight_trace=flight_trace, defrag=defrag,
+                       chaos=chaos)
     engine.run_events()
     return engine.run_state()
 
@@ -812,6 +995,7 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
               assume_ttl_s: float = 60.0, gc_period_s: float = 30.0,
               jobs: int = 1, flight_trace: bool = True,
               defrag: dict | None = None,
+              chaos: str | None = None,
               return_states: bool = False):
     """Replay one deterministic trace under each policy and build the
     A/B report.  Every policy sees the identical event stream.
@@ -835,12 +1019,20 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
     policy record gains a deterministic ``defrag`` counter block, the
     knobs are recorded under ``engine.defrag``, and the report schema
     becomes ``tputopo.sim/v3``.  Off (the default) emits the v2 shape
-    byte-identically."""
+    byte-identically.
+
+    ``chaos`` (a profile name from :data:`tputopo.chaos.PROFILES`, or
+    None) runs every engine under the seeded fault-injection layer: each
+    policy record gains a deterministic ``chaos`` block (faults injected
+    by kind, retry/requeue attribution, the invariant audit), the
+    resolved knobs land under ``engine.chaos``, and the schema becomes
+    ``tputopo.sim/v4``.  Off (the default) leaves report bytes exactly
+    as before."""
     t0 = time.perf_counter()
     defrag_knobs = ({**DEFAULT_DEFRAG, **defrag}
                     if defrag is not None else None)
     work = [(cfg, name, assume_ttl_s, gc_period_s, flight_trace,
-             defrag_knobs) for name in policy_names]
+             defrag_knobs, chaos) for name in policy_names]
     if jobs > 1 and len(work) > 1:
         import multiprocessing as mp
 
@@ -875,10 +1067,18 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
         # results but are not part of the trace.  Present only when
         # defrag is on, so defrag-off report bytes stay v2-identical.
         engine_params["defrag"] = dict(sorted(defrag_knobs.items()))
+    if chaos is not None:
+        # The resolved fault-plan knobs (profile + every probability):
+        # two chaos reports differing only in knobs must be
+        # distinguishable, same rule as the defrag record above.
+        from tputopo.chaos import FaultPlan
+
+        engine_params["chaos"] = FaultPlan(cfg.seed, chaos).describe()
     report = build_report(
         cfg.describe(), horizon, policies,
         engine_params=engine_params,
         schema_defrag=defrag_knobs is not None,
+        schema_chaos=chaos is not None,
         throughput={
             "events": events,  # deterministic
             "wall_s": round(wall_s, 3),

@@ -21,15 +21,18 @@ and bench.py's sim scenario go through them.
 
 from __future__ import annotations
 
+import random
 from typing import Callable
 
 from tputopo.extender.config import ExtenderConfig
 from tputopo.extender.scheduler import (BindError, ExtenderScheduler,
                                         LABEL_ALLOW_MULTISLICE, LABEL_GANG_ID,
-                                        LABEL_GANG_SIZE)
+                                        LABEL_GANG_SIZE, bound_as_planned)
 from tputopo.extender.state import ClusterState
 from tputopo.k8s import objects as ko
-from tputopo.k8s.fakeapi import FakeApiServer
+from tputopo.k8s.fakeapi import Conflict, FakeApiServer, NotFound
+from tputopo.k8s.retry import (ApiTimeout, ApiUnavailable, RetryPolicy,
+                               bind_retry)
 from tputopo.sim.trace import JobSpec
 from tputopo.topology.baselines import BASELINE_PICKERS
 from tputopo.topology.score import _box_of, score_chip_set
@@ -70,12 +73,33 @@ class PlacementPolicy:
     name = "abstract"
 
     def __init__(self, api: FakeApiServer, clock, assume_ttl_s: float,
-                 tracer=None) -> None:
+                 tracer=None, fault_plan=None) -> None:
         self.api = api
         self.clock = clock
         self.assume_ttl_s = assume_ttl_s
         self.tracer = tracer
         self._trace_on = tracer is not None and tracer.enabled
+        # Chaos (tputopo.chaos): the engine's FaultPlan, consulted for
+        # crash-restart points (the ici policy).  ``last_none_reason``
+        # attributes a None from place(): "infeasible" (a capacity
+        # verdict — the engine memoizes it per epoch) vs a fault class
+        # ("bind_conflict" / "api_timeout" / "api_unavailable" /
+        # "crash_recovery" — transient; the engine retries without
+        # burning the epoch memo and tallies the reason).
+        self.fault_plan = fault_plan
+        self.last_none_reason: str | None = None
+
+    def chaos_counters(self) -> dict:
+        """Deterministic retry/recovery counters for the chaos report
+        block (empty when the policy tracked none)."""
+        return {}
+
+    def inc_chaos(self, name: str, by: int = 1) -> None:
+        """Route a fault-recovery counter into this policy's chaos sink
+        (no-op by default).  External per-run machinery that shares the
+        policy's faulted API — the engine's AssumptionGC — reports its
+        recovery work through here so it lands in the same chaos block
+        as the policy's own retries instead of vanishing."""
 
     def place(self, job: JobSpec, node_names: list[str],
               handles: list | None = None) -> list[dict] | None:
@@ -105,8 +129,10 @@ class IciAwarePolicy(PlacementPolicy):
 
     name = "ici"
 
-    def __init__(self, api, clock, assume_ttl_s, tracer=None) -> None:
-        super().__init__(api, clock, assume_ttl_s, tracer=tracer)
+    def __init__(self, api, clock, assume_ttl_s, tracer=None,
+                 fault_plan=None) -> None:
+        super().__init__(api, clock, assume_ttl_s, tracer=tracer,
+                         fault_plan=fault_plan)
         # Informer-less assume-cache mode: the engine is the sole writer
         # and calls invalidate() on every out-of-band mutation, so a
         # scheduling wake pays ONE cluster sync and each bind publishes
@@ -118,13 +144,27 @@ class IciAwarePolicy(PlacementPolicy):
         # The engine's tracer (virtual clock — deterministic explain
         # timestamps) is handed straight to the scheduler; when tracing
         # is off the scheduler runs with the shared no-op NullTracer.
+        self.sched = self._make_scheduler()
+        self._last_explain: dict | None = None
+        # Counters of schedulers "killed" by injected crashes, carried so
+        # counters()/chaos_counters() report run totals, not just the
+        # latest incarnation's.
+        self._counter_carry: dict[str, int] = {}
+
+    def _make_scheduler(self) -> ExtenderScheduler:
+        """One extender instance — called at init AND per injected
+        crash-restart (a fresh instance IS the restart: empty assumption
+        cache, empty gang-plan cache, world rebuilt from API truth).
+        Retry jitter rng is pinned so chaos runs stay deterministic."""
         from tputopo.obs import NULL_TRACER
 
-        self.sched = ExtenderScheduler(
-            api, ExtenderConfig(assume_ttl_s=assume_ttl_s,
-                                state_cache_s=1e12, bind_from_cache=True),
-            clock=clock, tracer=tracer if tracer is not None else NULL_TRACER)
-        self._last_explain: dict | None = None
+        return ExtenderScheduler(
+            self.api, ExtenderConfig(assume_ttl_s=self.assume_ttl_s,
+                                     state_cache_s=1e12,
+                                     bind_from_cache=True),
+            clock=self.clock,
+            tracer=self.tracer if self.tracer is not None else NULL_TRACER,
+            retry_rng=random.Random(0x7E7))
 
     def invalidate(self, events=None) -> None:
         if events is not None:
@@ -134,9 +174,17 @@ class IciAwarePolicy(PlacementPolicy):
 
     def place(self, job: JobSpec, node_names: list[str],
               handles: list | None = None) -> list[dict] | None:
+        self.last_none_reason = "infeasible"
         decisions = []
         sort_explain = None
+        # Chaos: does the extender "die" mid-gang-bind this attempt?  The
+        # crash point is drawn up front (deterministic stream position)
+        # and hit after ``crash_at`` members are bound.
+        crash_at = (self.fault_plan.crash_point(job.replicas)
+                    if self.fault_plan is not None else None)
         for m in range(job.replicas):
+            if crash_at is not None and m == crash_at:
+                return self._crash_restart(job, handles)
             pod_name = f"{job.name}-{m}"
             # Copy-free member read: the engine's key-stable handle when
             # given, else the facade's get (itself nocopy in the sim).
@@ -158,17 +206,28 @@ class IciAwarePolicy(PlacementPolicy):
                 # gang, so member 0 failing means the gang doesn't fit and
                 # no member was bound (single-threaded engine).  m > 0
                 # failing can only follow a cluster change mid-attempt,
-                # which the engine never does — treat it as a hard bug.
+                # which the engine never does without injected faults —
+                # under chaos it is a clean abort (the engine's reset path
+                # recreates the pods); fault-free it stays a hard bug.
                 if decisions:
+                    if self.fault_plan is not None:
+                        self.last_none_reason = "mid_bind_infeasible"
+                        return None
                     raise RuntimeError(
                         f"gang {job.name} became infeasible mid-bind "
                         f"(member {m} of {job.replicas})")
                 return None
             try:
                 d = self.sched.bind(pod_name, "default", best["Host"])
-            except BindError:
+            except BindError as e:
                 # All-or-nothing: the scheduler released any assumptions;
-                # report "does not fit now" to the engine.
+                # report "does not fit now" to the engine, attributed by
+                # the structured failure reason.
+                self.last_none_reason = {
+                    "conflict": "bind_conflict",
+                    "timeout": "api_timeout",
+                    "unavailable": "api_unavailable",
+                }.get(e.reason, "infeasible")
                 return None
             decisions.append({
                 "pod": pod_name, "node": d["node"], "slice": d["slice"],
@@ -184,11 +243,67 @@ class IciAwarePolicy(PlacementPolicy):
                                   "bind": self.tracer.last_explain}
         return decisions
 
+    def _crash_restart(self, job: JobSpec,
+                       handles: list | None) -> list[dict] | None:
+        """The injected extender death mid-gang-bind: the old scheduler
+        instance (its assumption cache, gang-plan cache, in-flight bind)
+        is GONE; a fresh one starts and runs :meth:`ExtenderScheduler.
+        recover` against API truth.  Per the release-or-complete rule the
+        gang ends whole (recovery bound the remaining members — return
+        the full decision list, reconstructed from API state) or released
+        (return None; the engine's reset path requeues it cleanly)."""
+        self.fault_plan.record("crash_restart")
+        for name, v in self.sched.metrics.counters.items():
+            self._counter_carry[name] = self._counter_carry.get(name, 0) + v
+        self.sched = self._make_scheduler()
+        self.sched.recover()
+        decisions = []
+        for m in range(job.replicas):
+            pod_name = f"{job.name}-{m}"
+            try:
+                pod = (handles[m].fetch() if handles is not None
+                       else self.api.get("pods", pod_name, "default"))
+            except NotFound:
+                pod = None
+            if pod is None or not pod["spec"].get("nodeName"):
+                # Recovery released the gang (or it was never completable):
+                # all-or-nothing holds, the engine requeues.
+                self.last_none_reason = "crash_recovery"
+                return None
+            d = self.sched._replay_decision(pod, pod["spec"]["nodeName"])
+            decisions.append({
+                "pod": pod_name, "node": d["node"], "slice": d["slice"],
+                "chips": [tuple(c) for c in d["chips"]],
+                "predicted_gbps": d["predicted_allreduce_gbps"],
+                "contiguous": d["contiguous"],
+            })
+        if self._trace_on:
+            self._last_explain = {"policy": self.name,
+                                  "crash_recovered": True,
+                                  "job": job.name}
+        return decisions
+
     def explain_last(self) -> dict | None:
         return self._last_explain
 
+    #: Counter prefixes/names that attribute chaos-recovery work —
+    #: reported dynamically (only when nonzero), so fault-free report
+    #: bytes never change for carrying the machinery.
+    _CHAOS_COUNTER_PREFIXES = ("retry_", "crash_", "bind_conflicts",
+                               "bind_unavailable",
+                               "bind_ambiguous_recovered",
+                               "release_unavailable", "gc_release_errors")
+
+    def _merged_counters(self) -> dict:
+        """Live scheduler counters plus the carry from crash-killed
+        incarnations — run totals, whatever the restart count."""
+        out = dict(self._counter_carry)
+        for k, v in self.sched.metrics.counters.items():
+            out[k] = out.get(k, 0) + v
+        return out
+
     def counters(self) -> dict:
-        c = self.sched.metrics.counters
+        c = self._merged_counters()
         keep = ("sort_requests", "bind_requests", "bind_success",
                 "bind_gang_infeasible", "gang_assumptions_released",
                 "gang_plan_reuse_hits", "gang_multislice_plans",
@@ -204,7 +319,22 @@ class IciAwarePolicy(PlacementPolicy):
         # rebuild storm is attributable from the report alone.
         out.update({k: v for k, v in c.items()
                     if k.startswith("state_delta_fallback_")})
+        # Retry/recovery attribution (chaos runs; zero-cost otherwise —
+        # absent counters simply don't appear).
+        out.update({k: v for k, v in c.items()
+                    if k.startswith(self._CHAOS_COUNTER_PREFIXES)})
         return out
+
+    def chaos_counters(self) -> dict:
+        c = self._merged_counters()
+        return dict(sorted(
+            (k, v) for k, v in c.items()
+            if k.startswith(self._CHAOS_COUNTER_PREFIXES)))
+
+    def inc_chaos(self, name: str, by: int = 1) -> None:
+        # Into the LIVE scheduler's Metrics: _merged_counters folds it
+        # with the crash carry, so the report sees run totals either way.
+        self.sched.metrics.inc(name, by)
 
 
 class BaselinePolicy(PlacementPolicy):
@@ -212,10 +342,20 @@ class BaselinePolicy(PlacementPolicy):
     committed through the same annotation handshake as the extender."""
 
     def __init__(self, api, clock, assume_ttl_s, picker_name: str,
-                 picker: Callable, tracer=None) -> None:
-        super().__init__(api, clock, assume_ttl_s, tracer=tracer)
+                 picker: Callable, tracer=None, fault_plan=None) -> None:
+        super().__init__(api, clock, assume_ttl_s, tracer=tracer,
+                         fault_plan=fault_plan)
         self.name = picker_name
         self.picker = picker
+        # Commit-leg hardening (chaos runs): the same shared RetryPolicy
+        # the extender uses, with pinned jitter, wired through the one
+        # shared ``bind_retry`` spelling — a baseline must survive the
+        # same flaky API as the system under test or the A/B dies on the
+        # comparator side.  Lazily-counted: fault-free report bytes
+        # carry no new keys.
+        self._chaos_counters: dict[str, int] = {}
+        self._call = bind_retry(RetryPolicy(), clock,
+                                random.Random(0xBA5E), inc=self.inc_chaos)
         # invalidate_drops: every one is a full O(cluster) re-sync on the
         # next place() — the counter that attributes the ROADMAP's
         # "BaselinePolicy.invalidate full drops" sim-wall item from the
@@ -237,8 +377,12 @@ class BaselinePolicy(PlacementPolicy):
             self._counters["invalidate_drops"] += 1
         self._cached_state = None
 
+    def inc_chaos(self, name: str, by: int = 1) -> None:
+        self._chaos_counters[name] = self._chaos_counters.get(name, 0) + by
+
     def place(self, job: JobSpec, node_names: list[str],
               handles: list | None = None) -> list[dict] | None:
+        self.last_none_reason = "infeasible"
         self._counters["plans"] += 1
         state = self._cached_state
         if state is None:
@@ -289,7 +433,24 @@ class BaselinePolicy(PlacementPolicy):
             plan.append((node, picked))
         # Commit: same three-field handshake the extender stamps, so the
         # GC, ClusterState accounting, and metrics read both policies
-        # identically.
+        # identically.  Retries exhausted mid-commit (possible only when
+        # faults outlast the whole retry budget) abort the attempt
+        # cleanly: drop the cached state (its local marks no longer match
+        # the half-committed API) and report a fault-classed None — the
+        # engine's reset path deletes/recreates any bound members, so
+        # all-or-nothing holds on the comparator side too.
+        try:
+            return self._commit(job, plan, state, walk)
+        except ApiUnavailable as e:
+            self._cached_state = None
+            self.last_none_reason = ("api_timeout" if isinstance(e, ApiTimeout)
+                                     else "api_unavailable")
+            self._chaos_counters["commit_aborted"] = \
+                self._chaos_counters.get("commit_aborted", 0) + 1
+            return None
+
+    def _commit(self, job: JobSpec, plan, state,
+                walk: list | None) -> list[dict]:
         now = self.clock()
         decisions = []
         for m, (node, picked) in enumerate(plan):
@@ -305,8 +466,19 @@ class BaselinePolicy(PlacementPolicy):
             }
             if job.replicas > 1:
                 anns[ko.ANN_GANG_ID] = job.name
-            self.api.patch_annotations("pods", pod_name, anns, "default")
-            self.api.bind_pod(pod_name, node, "default")
+            self._call(self.api.patch_annotations, "pods", pod_name, anns,
+                       "default")
+            try:
+                self._call(self.api.bind_pod, pod_name, node, "default")
+            except Conflict:
+                # Ambiguous-timeout echo: an earlier attempt applied and
+                # the retry conflicts against its own success — the
+                # extender's shared node+chip-group predicate decides;
+                # anything else is a real race.
+                cur = self._call(self.api.get, "pods", pod_name, "default")
+                if not bound_as_planned(cur, node, anns[ko.ANN_GROUP]):
+                    raise
+                self.inc_chaos("bind_ambiguous_recovered")
             self._counters["binds"] += 1
             decisions.append({
                 "pod": pod_name, "node": node, "slice": dom.slice_id,
@@ -329,7 +501,12 @@ class BaselinePolicy(PlacementPolicy):
         return self._last_explain
 
     def counters(self) -> dict:
-        return dict(self._counters)
+        out = dict(self._counters)
+        out.update(self._chaos_counters)
+        return out
+
+    def chaos_counters(self) -> dict:
+        return dict(sorted(self._chaos_counters.items()))
 
 
 def available_policies() -> list[str]:
@@ -341,12 +518,13 @@ def available_policies() -> list[str]:
 
 
 def get_policy(name: str, api, clock, assume_ttl_s: float,
-               tracer=None) -> PlacementPolicy:
+               tracer=None, fault_plan=None) -> PlacementPolicy:
     if name == "ici":
-        return IciAwarePolicy(api, clock, assume_ttl_s, tracer=tracer)
+        return IciAwarePolicy(api, clock, assume_ttl_s, tracer=tracer,
+                              fault_plan=fault_plan)
     picker = BASELINE_PICKERS.get(name)
     if picker is not None:
         return BaselinePolicy(api, clock, assume_ttl_s, name, picker,
-                              tracer=tracer)
+                              tracer=tracer, fault_plan=fault_plan)
     raise KeyError(f"unknown policy {name!r}; available: "
                    f"{available_policies()}")

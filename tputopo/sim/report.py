@@ -27,7 +27,11 @@ Schema (``tputopo.sim/v2``)::
           "gc": {"sweeps", "assumptions_released"},
           "scheduler": {<deterministic policy counters>},
           "phases": {"<verb>/<phase>": {"count", "counters"?}, ...},
-          "defrag": {<controller counters>}         # v3 (--defrag) only
+          "defrag": {<controller counters>},        # v3 (--defrag) only
+          "chaos": {"profile", "injected", "suppressed", "retries",
+                    "place_retries_by_reason", "requeues_by_reason",
+                    "invariants": {"ok", "checks", "violations"}}
+                                                    # v4 (--chaos) only
         }, ...
       },
       "ab": {"policies": [...], "deltas": {<metric>: a_minus_b},
@@ -61,6 +65,14 @@ SCHEMA = "tputopo.sim/v2"
 #: (``--defrag``).  A defrag-off run keeps emitting the v2 shape
 #: byte-for-byte, so pre-defrag reports remain diffable against new ones.
 SCHEMA_DEFRAG = "tputopo.sim/v3"
+#: v4 = the above plus the per-policy ``chaos`` block (faults injected
+#: by kind, retry/requeue attribution, the invariant audit verdict) and
+#: the ``engine.chaos`` resolved-knob record — emitted ONLY under
+#: ``--chaos``.  A chaos-off run keeps the v3/v2 shape byte-for-byte.
+#: The chaos block is fully deterministic (seeded fault plan, virtual
+#: clock) — it is part of the byte-determinism contract, not a third
+#: wall-clock exception.
+SCHEMA_CHAOS = "tputopo.sim/v4"
 
 
 def _r(x: float, nd: int = 6) -> float:
@@ -214,9 +226,11 @@ def build_report(trace_desc: dict, horizon_s: float,
                  throughput: dict | None = None,
                  first_divergence: dict | None = None,
                  phase_wall: dict | None = None,
-                 schema_defrag: bool = False) -> dict:
+                 schema_defrag: bool = False,
+                 schema_chaos: bool = False) -> dict:
     out = {
-        "schema": SCHEMA_DEFRAG if schema_defrag else SCHEMA,
+        "schema": (SCHEMA_CHAOS if schema_chaos
+                   else SCHEMA_DEFRAG if schema_defrag else SCHEMA),
         "trace": trace_desc,
         # Engine knobs that change results but are not part of the trace
         # (--assume-ttl / --gc-period): recorded so two reports differing
