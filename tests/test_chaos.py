@@ -15,12 +15,11 @@ from tputopo.defrag import DefragController
 from tputopo.extender import (ExtenderConfig, ExtenderHTTPServer,
                               ExtenderScheduler)
 from tputopo.extender.gc import AssumptionGC
-from tputopo.extender.scheduler import BindError
 from tputopo.k8s import FakeApiServer, make_pod
 from tputopo.k8s import objects as ko
 from tputopo.k8s.fakeapi import Conflict
 from tputopo.k8s.informer import Informer
-from tputopo.k8s.retry import ApiTimeout, ApiUnavailable, RetryPolicy
+from tputopo.k8s.retry import ApiUnavailable, RetryPolicy
 from tputopo.sim.engine import SimEngine, run_trace
 from tputopo.sim.report import SCHEMA, SCHEMA_CHAOS
 from tputopo.sim.trace import TraceConfig, generate_trace

@@ -12,7 +12,6 @@ from tputopo.defrag import DefragController, pending_demand, plan_migration
 from tputopo.defrag.planner import placeable_free_box, pressure_report
 from tputopo.extender.state import ClusterState
 from tputopo.k8s import objects as ko
-from tputopo.k8s.fakeapi import FakeApiServer
 from tputopo.sim.engine import SimEngine, finalize_run_state, run_trace
 from tputopo.sim.report import SCHEMA, SCHEMA_DEFRAG
 from tputopo.sim.trace import JobSpec, Trace, TraceConfig

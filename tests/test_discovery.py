@@ -2,10 +2,7 @@
 (SURVEY.md §4.2 — the fake backend is the rebuild's only topology fixture
 source, the analog of the reference's `nvidia-smi topo -m` PNG)."""
 
-import ctypes
-import json
 import os
-import subprocess
 
 import pytest
 

@@ -4,8 +4,6 @@ v1beta1 proto encoding — no in-process shortcuts.  The kubelet side is
 FakeKubeletGrpcServer, which (like the real kubelet) dials back to the
 plugin's socket after Register."""
 
-import time
-
 import pytest
 
 pytest.importorskip("grpc")
@@ -145,7 +143,6 @@ def test_serve_cli_exits_on_kubelet_restart(tmp_path):
     import os
     import subprocess
     import sys
-    import threading
 
     kubelet = FakeKubeletGrpcServer(str(tmp_path)).start()
     try:

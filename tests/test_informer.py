@@ -9,7 +9,7 @@ import pytest
 
 from tests.cluster import build_cluster
 from tests.k8s_mock import MockKubeApi
-from tputopo.extender import ClusterState, ExtenderConfig, ExtenderScheduler
+from tputopo.extender import ExtenderConfig, ExtenderScheduler
 from tputopo.k8s import FakeApiServer, make_pod
 from tputopo.k8s import objects as ko
 from tputopo.k8s.client import KubeApiClient

@@ -142,6 +142,7 @@ def test_nocopy_guard_catches_caller_mutation():
     api.patch_annotations("pods", "p0", {"ok": "1"}, "default")
     api.verify_nocopy_digests()
     pod = api.get_nocopy("pods", "p0", "default")
+    # tpulint: disable=nocopy -- deliberate violation: this test exercises the digest guard
     pod["spec"]["illegal"] = True  # the contract violation
     with pytest.raises(RuntimeError, match="nocopy contract violation"):
         api.get_nocopy("pods", "p0", "default")
@@ -155,6 +156,7 @@ def test_nocopy_guard_checks_before_server_writes():
     api = FakeApiServer()
     api.nocopy_guard = True
     api.create("pods", make_pod("p0", chips=1))
+    # tpulint: disable=nocopy -- deliberate violation: this test exercises the digest guard
     api.get_nocopy("pods", "p0", "default")["status"]["phase"] = "Hacked"
     with pytest.raises(RuntimeError, match="nocopy contract violation"):
         api.verify_nocopy_digests()
@@ -173,7 +175,7 @@ def test_create_echo_optout_copy_count(monkeypatch):
     real = copymod.deepcopy
     calls = {"n": 0}
 
-    def counting(x, memo=None, _nil=[]):
+    def counting(x, memo=None, _nil=[]):  # noqa: B006 — mirrors copy.deepcopy's real signature
         calls["n"] += 1
         return real(x, memo)
 
@@ -211,7 +213,7 @@ def test_watch_log_copy_is_lazy_until_attach(monkeypatch):
     real = copymod.deepcopy
     calls = {"n": 0}
 
-    def counting(x, memo=None, _nil=[]):
+    def counting(x, memo=None, _nil=[]):  # noqa: B006 — mirrors copy.deepcopy's real signature
         calls["n"] += 1
         return real(x, memo)
 

@@ -1,0 +1,473 @@
+"""tputopo.lint — checker fixtures, waiver grammar, CLI exit codes, and
+the whole-repo-clean meta-test that pins the contract for future PRs.
+
+Each checker gets true-positive fixtures (a seeded violation must be
+found) and false-positive fixtures (the corrected form must pass) — the
+acceptance shape from ISSUE 7.  Fixtures are in-memory sources fed
+through the same LintRun the CLI uses, with repo-shaped relpaths so the
+per-rule scoping applies exactly as in a real run.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+from tputopo.lint import (ClockDisciplineChecker, DeterminismChecker,
+                          LockGuardChecker, NocopyChecker, SingleDefChecker,
+                          default_checkers, run_lint)
+from tputopo.lint.core import WAIVER_RULE, LintRun
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def lint_sources(checkers, *sources: tuple[str, str]):
+    """Run ``checkers`` over (relpath, source) fixtures; return
+    (active findings, run)."""
+    run = LintRun(checkers)
+    for relpath, src in sources:
+        run.add_source(relpath, textwrap.dedent(src))
+    return run.finish(), run
+
+
+# ---- determinism -------------------------------------------------------------
+
+class TestDeterminismChecker:
+    def test_wall_clock_call_in_sim_is_flagged(self):
+        findings, _ = lint_sources(
+            [DeterminismChecker()],
+            ("tputopo/sim/fixture.py", """\
+                import time
+                def now():
+                    return time.time()
+            """))
+        assert [f.rule for f in findings] == ["determinism"]
+        assert "time.time" in findings[0].message
+        assert findings[0].line == 3
+
+    def test_injected_clock_default_is_the_escape_hatch(self):
+        findings, _ = lint_sources(
+            [DeterminismChecker()],
+            ("tputopo/sim/fixture.py", """\
+                import time
+                def now(clock=time.time):
+                    return clock()
+            """))
+        assert findings == []
+
+    def test_unseeded_rng_flagged_seeded_allowed(self):
+        findings, _ = lint_sources(
+            [DeterminismChecker()],
+            ("tputopo/chaos/fixture.py", """\
+                import random
+                import numpy as np
+                bad = random.Random()
+                worse = random.random()
+                ambient = np.random.default_rng()
+                ok = random.Random(0x7E7)
+                also_ok = np.random.Generator(np.random.Philox(
+                    seed=np.random.SeedSequence(entropy=(1, 2))))
+                seeded = np.random.default_rng(0)
+            """))
+        assert [f.line for f in findings] == [3, 4, 5]
+        assert all(f.rule == "determinism" for f in findings)
+
+    def test_out_of_scope_module_not_checked(self):
+        findings, _ = lint_sources(
+            [DeterminismChecker()],
+            ("tputopo/extender/fixture.py",
+             "import time\nt = time.time()\n"))
+        assert findings == []
+
+    def test_defrag_planner_in_scope_controller_not(self):
+        src = "import time\nt = time.sleep(1)\n"
+        flagged, _ = lint_sources([DeterminismChecker()],
+                                  ("tputopo/defrag/planner.py", src))
+        clean, _ = lint_sources([DeterminismChecker()],
+                                ("tputopo/defrag/controller.py", src))
+        assert len(flagged) == 1 and clean == []
+
+
+# ---- clock discipline --------------------------------------------------------
+
+class TestClockDisciplineChecker:
+    def test_clock_taking_fn_calling_wall_clock_is_flagged(self):
+        findings, _ = lint_sources(
+            [ClockDisciplineChecker()],
+            ("tputopo/extender/fixture.py", """\
+                import time
+                def retry(fn, clock):
+                    deadline = time.monotonic() + 5
+                    return fn()
+            """))
+        assert [f.rule for f in findings] == ["clock"]
+        assert "time.monotonic" in findings[0].message
+
+    def test_clock_used_properly_is_clean(self):
+        findings, _ = lint_sources(
+            [ClockDisciplineChecker()],
+            ("tputopo/extender/fixture.py", """\
+                import time
+                def retry(fn, clock=time.time, sleep=time.sleep):
+                    deadline = clock() + 5
+                    sleep(0.1)
+                    return fn()
+            """))
+        assert findings == []
+
+    def test_nested_fn_with_own_clock_param_owns_its_body(self):
+        findings, _ = lint_sources(
+            [ClockDisciplineChecker()],
+            ("tputopo/extender/fixture.py", """\
+                import time
+                def outer(clock):
+                    def inner(clock):
+                        return clock()
+                    return inner(clock) + time.time()
+            """))
+        # exactly one finding, attributed to outer's body
+        assert len(findings) == 1 and findings[0].line == 5
+
+
+# ---- nocopy ------------------------------------------------------------------
+
+class TestNocopyChecker:
+    def check(self, body, relpath="tputopo/extender/fixture.py"):
+        findings, _ = lint_sources([NocopyChecker()], (relpath, body))
+        return findings
+
+    def test_mutating_a_named_nocopy_result(self):
+        findings = self.check("""\
+            def f(api):
+                pod = api.get_nocopy("pods", "p0")
+                pod["spec"]["nodeName"] = "n1"
+        """)
+        assert [f.rule for f in findings] == ["nocopy"]
+
+    def test_mutating_elements_of_a_nocopy_list(self):
+        findings = self.check("""\
+            def f(api):
+                for o in api.list_nocopy("pods"):
+                    o["metadata"]["labels"] = {}
+        """)
+        assert len(findings) == 1
+
+    def test_mutating_method_call_and_direct_call_result(self):
+        findings = self.check("""\
+            def f(api, h):
+                pod = h.fetch()
+                pod["metadata"]["annotations"].update(x="1")
+                api.get_nocopy("pods", "p")["status"] = {}
+        """)
+        assert len(findings) == 2
+
+    def test_storing_onto_self_and_returning_escape(self):
+        findings = self.check("""\
+            class S:
+                def grab(self, api):
+                    self.pod = api.get_nocopy("pods", "p0")
+                def hand_out(self, api):
+                    return api.list_nocopy("pods")
+        """)
+        assert len(findings) == 2
+
+    def test_owner_module_may_return_nocopy_views(self):
+        findings = self.check("""\
+            def get(api):
+                return api.get_nocopy("pods", "p0")
+        """, relpath="tputopo/sim/engine.py")
+        assert findings == []
+
+    def test_read_only_use_and_copying_api_are_clean(self):
+        findings = self.check("""\
+            import copy
+            def f(api):
+                pod = api.get_nocopy("pods", "p0")
+                name = pod["metadata"]["name"]
+                mine = copy.deepcopy(pod)
+                mine["spec"]["nodeName"] = "n1"
+                pods = api.list("pods")
+                pods[0]["x"] = 1
+                pod = {}
+                pod["now"] = "rebound, fine"
+        """)
+        assert findings == []
+
+
+# ---- lock guard --------------------------------------------------------------
+
+_LOCK_FIXTURE = """\
+    import threading
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+            self._store = {{}}  # guarded-by: _lock|_cond
+            self._state = None  # guarded-by: _lock (writes)
+
+        def accessor(self):
+            {access}
+"""
+
+
+class TestLockGuardChecker:
+    def check(self, access):
+        findings, _ = lint_sources(
+            [LockGuardChecker()],
+            ("tputopo/k8s/fixture.py",
+             textwrap.dedent(_LOCK_FIXTURE).format(access=access)))
+        return findings
+
+    def test_unlocked_access_is_flagged(self):
+        findings = self.check('self._store["a"] = 1')
+        assert [f.rule for f in findings] == ["lock"]
+        assert "_store" in findings[0].message
+
+    def test_with_lock_and_condition_alias_are_clean(self):
+        assert self.check(
+            'with self._lock:\n'
+            '                self._store["a"] = 1') == []
+        assert self.check(
+            'with self._cond:\n'
+            '                self._store["a"] = 1') == []
+
+    def test_writes_only_mode(self):
+        assert self.check('return self._state') == []      # lock-free read
+        flagged = self.check('self._state = 2')            # serialized write
+        assert len(flagged) == 1 and "(write)" in flagged[0].message
+
+    def test_holds_lock_annotation_on_helper(self):
+        findings, _ = lint_sources(
+            [LockGuardChecker()],
+            ("tputopo/k8s/fixture.py", """\
+                import threading
+
+                class Store:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._store = {}  # guarded-by: _lock
+
+                    def _helper(self):  # holds-lock: _lock
+                        return self._store
+
+                    def caller(self):
+                        with self._lock:
+                            return self._helper()
+            """))
+        assert findings == []
+
+    def test_nested_function_drops_held_locks(self):
+        findings, _ = lint_sources(
+            [LockGuardChecker()],
+            ("tputopo/k8s/fixture.py", """\
+                import threading
+
+                class Store:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._store = {}  # guarded-by: _lock
+
+                    def spawn(self):
+                        with self._lock:
+                            def later():
+                                return self._store
+                            return later
+            """))
+        assert len(findings) == 1  # the closure runs after release
+
+
+# ---- single-def --------------------------------------------------------------
+
+_CANON = (("tputopo/canon.py", ("SCHEMA", "KEEP")),)
+
+
+class TestSingleDefChecker:
+    def test_duplicated_literal_and_shadow_name(self):
+        findings, _ = lint_sources(
+            [SingleDefChecker(canon=_CANON)],
+            ("tputopo/canon.py",
+             'SCHEMA = "x.sim/v9"\nKEEP = ("a", "b")\n'),
+            ("tputopo/emitter.py",
+             'def emit():\n    return {"schema": "x.sim/v9"}\n'),
+            ("tputopo/shadow.py", 'KEEP = ("a",)\n'))
+        rules = sorted((f.path, f.rule) for f in findings)
+        assert rules == [("tputopo/emitter.py", "single-def"),
+                         ("tputopo/shadow.py", "single-def")]
+
+    def test_importing_the_constant_is_clean(self):
+        findings, _ = lint_sources(
+            [SingleDefChecker(canon=_CANON)],
+            ("tputopo/canon.py", 'SCHEMA = "x.sim/v9"\n'),
+            ("tputopo/emitter.py",
+             "from tputopo.canon import SCHEMA\n"
+             "def emit():\n    return {'schema': SCHEMA}\n"))
+        assert findings == []
+
+    def test_real_repo_canon_resolves(self):
+        """The default canon must keep matching the real modules — if the
+        schema constants move, the checker config moves with them."""
+        checker = SingleDefChecker()
+        run = LintRun([checker])
+        report = REPO_ROOT / "tputopo/sim/report.py"
+        server = REPO_ROOT / "tputopo/extender/server.py"
+        run.add_path(report, "tputopo/sim/report.py")
+        run.add_path(server, "tputopo/extender/server.py")
+        # Seed one duplicate to prove values were extracted from the canon.
+        run.add_source("tputopo/dup.py", 's = "tputopo.sim/v4"\n')
+        findings = run.finish()
+        assert [f.path for f in findings] == ["tputopo/dup.py"]
+        assert "SCHEMA_CHAOS" in findings[0].message
+
+    def test_class_attribute_canon_value_is_extracted(self):
+        """``_PREFIX`` is a class attribute of the HTTP handler, not a
+        module-level constant — duplicating its value must still be a
+        finding (it was silently unchecked before)."""
+        checker = SingleDefChecker()
+        run = LintRun([checker])
+        run.add_path(REPO_ROOT / "tputopo/sim/report.py",
+                     "tputopo/sim/report.py")
+        run.add_path(REPO_ROOT / "tputopo/extender/server.py",
+                     "tputopo/extender/server.py")
+        run.add_source("tputopo/dup.py", 'p = "tputopo_extender"\n')
+        findings = run.finish()
+        assert [f.path for f in findings] == ["tputopo/dup.py"]
+        assert "_PREFIX" in findings[0].message
+
+
+# ---- waivers -----------------------------------------------------------------
+
+class TestWaivers:
+    def test_waiver_suppresses_its_rule_on_its_line(self):
+        findings, run = lint_sources(
+            default_checkers(),
+            ("tputopo/sim/fixture.py", """\
+                import time
+                t = time.time()  # tpulint: disable=determinism -- fixture telemetry
+            """))
+        assert findings == []
+        assert len(run.waived) == 1
+
+    def test_standalone_waiver_covers_next_line(self):
+        findings, _ = lint_sources(
+            default_checkers(),
+            ("tputopo/sim/fixture.py", """\
+                import time
+                # tpulint: disable=determinism -- fixture telemetry
+                t = time.time()
+            """))
+        assert findings == []
+
+    def test_missing_reason_is_rejected(self):
+        findings, _ = lint_sources(
+            default_checkers(),
+            ("tputopo/sim/fixture.py", """\
+                import time
+                t = time.time()  # tpulint: disable=determinism
+            """))
+        # the violation stays active AND the waiver itself is flagged
+        rules = sorted(f.rule for f in findings)
+        assert rules == ["determinism", WAIVER_RULE]
+        assert any("reason" in f.message for f in findings)
+
+    def test_unknown_rule_and_unused_waiver_are_flagged(self):
+        findings, _ = lint_sources(
+            default_checkers(),
+            ("tputopo/sim/fixture.py", """\
+                x = 1  # tpulint: disable=bogus-rule -- because
+                y = 2  # tpulint: disable=determinism -- suppresses nothing
+            """))
+        msgs = sorted(f.message for f in findings)
+        assert len(findings) == 2
+        assert any("unknown rule" in m for m in msgs)
+        assert any("unused waiver" in m for m in msgs)
+
+    def test_wrong_rule_waiver_does_not_suppress(self):
+        findings, _ = lint_sources(
+            default_checkers(),
+            ("tputopo/sim/fixture.py", """\
+                import time
+                t = time.time()  # tpulint: disable=nocopy -- wrong rule
+            """))
+        assert sorted(f.rule for f in findings) == ["determinism",
+                                                    WAIVER_RULE]
+
+    def test_selected_subset_keeps_other_rules_waivers_legal(self):
+        """Under --select, a waiver for a deselected rule is neither
+        unknown (the rule exists) nor unused (its checker never ran)."""
+        src = ("tputopo/sim/fixture.py", """\
+            import time
+            t = time.time()  # tpulint: disable=determinism -- telemetry
+        """)
+        all_rules = {c.rule for c in default_checkers()}
+        run = LintRun([NocopyChecker()], known_rules=all_rules)
+        run.add_source(src[0], textwrap.dedent(src[1]))
+        assert run.finish() == []
+
+
+# ---- CLI ---------------------------------------------------------------------
+
+def _cli(*args, cwd=REPO_ROOT):
+    return subprocess.run([sys.executable, "-m", "tputopo.lint", *args],
+                          cwd=cwd, capture_output=True, text=True,
+                          timeout=120)
+
+
+class TestCli:
+    def test_exit_0_on_clean_file(self, tmp_path):
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        res = _cli(str(clean))
+        assert res.returncode == 0, res.stdout + res.stderr
+
+    def test_exit_1_on_findings(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("x = 1  # tpulint: disable=nocopy\n")  # reasonless
+        res = _cli(str(bad))
+        assert res.returncode == 1
+        assert "waiver must carry a reason" in res.stdout
+
+    def test_exit_2_on_usage_error(self, tmp_path):
+        assert _cli("--select", "bogus").returncode == 2
+        assert _cli(str(tmp_path / "missing.py")).returncode == 2
+
+    def test_list_rules_names_all_five_checkers(self):
+        res = _cli("--list-rules")
+        assert res.returncode == 0
+        for rule in ("determinism", "clock", "nocopy", "lock",
+                     "single-def", "waiver"):
+            assert rule in res.stdout
+
+    def test_select_subset_runs_clean_on_repo(self):
+        """Scoped runs must not manufacture waiver findings for the
+        deselected rules' reasoned waivers (regression: `--select
+        nocopy,lock` flagged the determinism waivers as unknown)."""
+        res = _cli("--select", "nocopy,lock")
+        assert res.returncode == 0, res.stdout + res.stderr
+
+    def test_directory_outside_repo_root_is_linted_not_crashed(self, tmp_path):
+        (tmp_path / "sub").mkdir()
+        (tmp_path / "sub" / "ok.py").write_text("x = 1\n")
+        res = _cli(str(tmp_path / "sub"))
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "Traceback" not in res.stderr
+
+
+# ---- the contract ------------------------------------------------------------
+
+def test_whole_repo_runs_clean():
+    """``python -m tputopo.lint`` exits 0 on this tree: the standing
+    contract.  A future PR that trips a checker either fixes the
+    violation or waives it with a reason — never deletes this test."""
+    findings, run = run_lint(root=REPO_ROOT)
+    assert findings == [], "\n".join(f.render() for f in findings)
+    # the five project checkers were all active
+    assert {c.rule for c in run.checkers} == {
+        "determinism", "clock", "nocopy", "lock", "single-def"}
+    # every waiver in the tree carries a reason (reasonless ones would be
+    # active findings above; this pins the invariant explicitly)
+    for mod in run.modules:
+        for w in mod.waivers:
+            assert w.reason, f"{mod.relpath}:{w.line} waiver lacks a reason"

@@ -249,7 +249,6 @@ def test_find_within_hint_is_result_identical():
     """The ``within`` performance hint (the per-node candidate pruning the
     sort hot loop uses) must never change the result — including when the
     hint does not actually cover the free set (it is then ignored)."""
-    import itertools
     import random
 
     t = ChipTopology.build("v5p", (4, 4, 4))

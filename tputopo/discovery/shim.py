@@ -22,7 +22,7 @@ import math
 import os
 import re
 import subprocess
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from pathlib import Path
 
 from tputopo.topology.generations import GENERATIONS, get_generation

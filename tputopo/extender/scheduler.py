@@ -40,8 +40,7 @@ from tputopo.extender.state import (ClusterState, PodAssignment, SliceDomain,
                                     _assume_time_of)
 from tputopo.topology.model import ChipTopology, Coord
 from tputopo.topology.score import (_box_of, predict_allreduce_gbps,
-                                    predict_multidomain_allreduce_gbps,
-                                    score_chip_set)
+                                    predict_multidomain_allreduce_gbps)
 from tputopo.topology.slices import Allocator, Placement, enumerate_shapes
 
 # Gang metadata lives in labels (selectable) with annotation fallback.
@@ -254,8 +253,13 @@ class ExtenderScheduler:
         self._retry_call = bind_retry(self.retry, clock, self._retry_rng,
                                       inc=self.metrics.inc)
         self.decisions: list[dict] = []  # recent decision records (observability)
-        self._cached_state: ClusterState | None = None
-        self._cached_at: float = 0.0
+        # The published derived-state pair: reads are lock-free by design
+        # (token-first read order + idempotent re-folds tolerate torn READ
+        # pairings — see _delta_from_informer), writes serialize under
+        # _cache_lock so an old state can never pair with a newer token.
+        self._cached_state: ClusterState | None = None  # guarded-by: _cache_lock (writes)
+        self._cached_at: float = 0.0  # guarded-by: _cache_lock (writes)
+        # guarded-by: _cache_lock (writes)
         self._cached_informer_version: tuple[str, ...] | None = None
         # Serializes WRITES of the (state, token) pair: sorts are lock-free
         # readers, but two concurrent publishers (sort folds, binds) could
@@ -275,8 +279,8 @@ class ExtenderScheduler:
         # placement, so binds must fall back to the authoritative API sync
         # — otherwise a bind planned from the stale mirror could double-
         # book those chips (the per-pod CAS cannot catch cross-pod
-        # overlap).  Entries are (namespace, pod_name); guarded by _bind_lock.
-        self._unmirrored_binds: set[tuple[str, str]] = set()
+        # overlap).  Entries are (namespace, pod_name).
+        self._unmirrored_binds: set[tuple[str, str]] = set()  # guarded-by: _bind_lock
         # Cross-state gang plan carry: the per-state memo above dies with
         # each derived state, and bind re-syncs per member — so an N-member
         # gang used to re-plan from scratch N times (VERDICT r2 #5).  A
@@ -301,7 +305,8 @@ class ExtenderScheduler:
         by an external GC) — the config's "sole writer" rule is only
         satisfiable through this method or :meth:`apply_events` (the sim's
         engine is the model consumer)."""
-        self._cached_state = None
+        with self._cache_lock:
+            self._cached_state = None
 
     def apply_events(self, events) -> None:
         """Fold out-of-band cluster mutations the caller just made into the
@@ -319,7 +324,8 @@ class ExtenderScheduler:
             # Informer-coherent states advance only through the mirror's
             # version token (the _state delta path) — an out-of-band fold
             # here would fork them from the token; drop instead.
-            self._cached_state = None
+            with self._cache_lock:
+                self._cached_state = None
             return
         if not events:
             return  # nothing changed; the cached state is already exact
@@ -327,7 +333,8 @@ class ExtenderScheduler:
         new_state = state.with_events(events, reasons)
         if new_state is None:
             self._count_delta_fallback(reasons)
-            self._cached_state = None
+            with self._cache_lock:
+                self._cached_state = None
         else:
             self.metrics.inc("state_delta_applied")
             new_state = self._carry_state_memos(state, new_state)
@@ -1219,7 +1226,8 @@ class ExtenderScheduler:
         if released:
             self.metrics.inc("gang_assumptions_released", len(released))
             # The derived state still counts those chips as used.
-            self._cached_state = None
+            with self._cache_lock:
+                self._cached_state = None
         return released
 
     # ---- crash recovery ----------------------------------------------------
@@ -1250,7 +1258,8 @@ class ExtenderScheduler:
             self._cached_state = None
             self._cached_informer_version = None
         self._gang_plan_cache.clear()
-        self._unmirrored_binds.clear()
+        with self._bind_lock:
+            self._unmirrored_binds.clear()
         outcome: dict = {"completed": [], "released": [], "stranded": []}
         state = self._state(allow_cache=False)
         node_names = sorted(state._dom_by_node)
@@ -1366,7 +1375,7 @@ class ExtenderScheduler:
         with self._bind_lock:
             return self._bind_locked(pod_name, namespace, node_name)
 
-    def _repair_write_through(self) -> None:
+    def _repair_write_through(self) -> None:  # holds-lock: _bind_lock
         """Re-attempt the mirror write-through of binds whose read-back
         failed.  Success (or the pod being gone) closes the gap; anything
         still open keeps binds on the authoritative sync path.  Called
@@ -1423,7 +1432,7 @@ class ExtenderScheduler:
         with tr:
             return self._bind_spanned(pod_name, namespace, node_name, tr)
 
-    def _bind_spanned(self, pod_name: str, namespace: str, node_name: str,
+    def _bind_spanned(self, pod_name: str, namespace: str, node_name: str,  # holds-lock: _bind_lock
                       tr) -> dict:
         t0 = time.perf_counter()
         self.metrics.inc("bind_requests")
@@ -1677,7 +1686,8 @@ class ExtenderScheduler:
                 # with our bind), it stays: the next verb folds the journal
                 # tail — including this bind's own write-through — in
                 # O(events) instead of re-syncing O(pods).
-                self._cached_state = None
+                with self._cache_lock:
+                    self._cached_state = None
         elif self.config.bind_from_cache:
             # Informer-less assume cache (single-writer mode): apply our
             # own bind to the cached derived state so the next verb in the

@@ -19,7 +19,8 @@ import hashlib
 import json
 import threading
 import time
-from typing import Any, Callable, Iterable
+from bisect import bisect_left, insort
+from typing import Callable, Iterable
 
 
 class NotFound(KeyError):
@@ -168,12 +169,24 @@ class ObjectHandle:
 class FakeApiServer:
     def __init__(self) -> None:
         self._lock = threading.RLock()
+        # guarded-by: _lock|_watch_cond
         self._objects: dict[str, dict[tuple[str, str], dict]] = {
             "nodes": {},
             "pods": {},
         }
-        self._rv = 0
-        self.events: list[dict] = []
+        # Store keys per kind, maintained in sorted order (insort on
+        # create, bisect removal on delete): every LIST verb returns
+        # (namespace, name) order, and re-sorting the whole store per
+        # list_nocopy call was ~1.6 s cumulative on the standard sim
+        # trace (ROADMAP fleet-scale bottleneck 3).  The store key IS the
+        # sort key, so iteration order here matches the old sorted().
+        # guarded-by: _lock|_watch_cond
+        self._sorted_keys: dict[str, list[tuple[str, str]]] = {
+            "nodes": [],
+            "pods": [],
+        }
+        self._rv = 0  # guarded-by: _lock|_watch_cond
+        self.events: list[dict] = []  # guarded-by: _lock|_watch_cond
         # Watch machinery: a bounded per-server event log + a condition the
         # watchers block on.  Event = {"type": ADDED|MODIFIED|DELETED,
         # "kind": ..., "rv": int, "object": deepcopy-at-emit}.
@@ -185,30 +198,32 @@ class FakeApiServer:
         # never watches) pays zero emit copies; a watcher asking for a
         # resourceVersion older than the floor gets Gone and relists,
         # exactly as if the window had scrolled past it.
-        self._watch_log: list[dict] = []
+        self._watch_log: list[dict] = []  # guarded-by: _lock|_watch_cond
         self._watch_cond = threading.Condition(self._lock)
-        self._watch_attached = False
-        self._watch_floor = 0  # rv of the newest UNLOGGED event
+        self._watch_attached = False  # guarded-by: _lock|_watch_cond
+        # rv of the newest UNLOGGED event
+        self._watch_floor = 0  # guarded-by: _lock|_watch_cond
         # Nocopy mutation guard (debug mode, off by default): when enabled,
         # every nocopy read records (resourceVersion, content digest); a
         # later read or server write that finds the content changed at an
         # UNCHANGED resourceVersion can only mean a nocopy caller broke the
         # read-only contract — the server's own writes always bump the rv.
         self.nocopy_guard = False
+        # guarded-by: _lock|_watch_cond
         self._nocopy_digests: dict[tuple[str, str, str], tuple[str, str]] = {}
         # Meta equality index (shared MetaIndex structure with the
         # informer mirror).  Values are the STORED dicts (same objects as
         # the store), so in-place annotation patches stay visible;
         # maintained on every create/delete and on the two metadata patch
         # verbs.
-        self._meta_index = MetaIndex()
+        self._meta_index = MetaIndex()  # guarded-by: _lock|_watch_cond
 
     # ---- meta equality index ----------------------------------------------
 
-    def _index_obj(self, kind: str, key: tuple[str, str], obj: dict) -> None:
+    def _index_obj(self, kind: str, key: tuple[str, str], obj: dict) -> None:  # holds-lock: _lock
         self._meta_index.install(kind, key, obj)
 
-    def _unindex_obj(self, kind: str, key: tuple[str, str], obj: dict) -> None:
+    def _unindex_obj(self, kind: str, key: tuple[str, str], obj: dict) -> None:  # holds-lock: _lock
         self._meta_index.remove(kind, key, obj)
 
     def list_by_meta(self, kind: str, key: str, value: str,
@@ -237,7 +252,7 @@ class FakeApiServer:
         md = obj["metadata"]
         return (kind, md.get("namespace") or "", md["name"])
 
-    def _guard_check(self, kind: str, obj: dict) -> None:
+    def _guard_check(self, kind: str, obj: dict) -> None:  # holds-lock: _lock
         """Verify a stored object against its recorded nocopy digest.
         Called before every server-side mutation and on every nocopy read
         (guard mode only) — the moment an illegal caller mutation becomes
@@ -253,7 +268,7 @@ class FakeApiServer:
                 f" changed content at unmoved resourceVersion {rv} — a "
                 "get_nocopy/list_nocopy caller mutated a stored object")
 
-    def _guard_record(self, kind: str, obj: dict) -> None:
+    def _guard_record(self, kind: str, obj: dict) -> None:  # holds-lock: _lock
         self._nocopy_digests[self._guard_key(kind, obj)] = (
             obj["metadata"].get("resourceVersion"), _digest(obj))
 
@@ -269,11 +284,11 @@ class FakeApiServer:
 
     # ---- helpers ----------------------------------------------------------
 
-    def _bump(self, obj: dict) -> None:
+    def _bump(self, obj: dict) -> None:  # holds-lock: _lock
         self._rv += 1
         obj["metadata"]["resourceVersion"] = str(self._rv)
 
-    def _emit(self, type_: str, kind: str, obj: dict) -> None:
+    def _emit(self, type_: str, kind: str, obj: dict) -> None:  # holds-lock: _lock
         if not self._watch_attached:
             # No watcher has ever attached: nobody can be blocked on the
             # condition, and the event can never be replayed (floor rule in
@@ -293,8 +308,23 @@ class FakeApiServer:
         with self._lock:
             self._watch_attached = True
 
-    def _store(self, kind: str) -> dict[tuple[str, str], dict]:
+    def _store(self, kind: str) -> dict[tuple[str, str], dict]:  # holds-lock: _lock
         return self._objects[kind]
+
+    def _sorted_objects(self, kind: str) -> list[dict]:  # holds-lock: _lock
+        """Stored dicts in (namespace, name) order — the maintained
+        sorted-key list makes this a gather, not a sort."""
+        store = self._objects[kind]
+        return [store[k] for k in self._sorted_keys[kind]]
+
+    def _key_added(self, kind: str, k: tuple[str, str]) -> None:  # holds-lock: _lock
+        insort(self._sorted_keys[kind], k)
+
+    def _key_removed(self, kind: str, k: tuple[str, str]) -> None:  # holds-lock: _lock
+        keys = self._sorted_keys[kind]
+        i = bisect_left(keys, k)
+        if i < len(keys) and keys[i] == k:
+            del keys[i]
 
     # ---- CRUD -------------------------------------------------------------
 
@@ -317,6 +347,7 @@ class FakeApiServer:
             copy_ = copy.deepcopy(obj)
             self._bump(copy_)
             store[k] = copy_
+            self._key_added(kind, k)
             self._index_obj(kind, k, copy_)
             self._emit("ADDED", kind, copy_)
             if echo:
@@ -351,6 +382,7 @@ class FakeApiServer:
                 copy_ = copy.deepcopy(obj)
                 self._bump(copy_)
                 store[k] = copy_
+                self._key_added(kind, k)
                 self._index_obj(kind, k, copy_)
                 self._emit("ADDED", kind, copy_)
         return len(objs)
@@ -394,13 +426,12 @@ class FakeApiServer:
     def list(self, kind: str, selector: Callable[[dict], bool] | None = None,
              label_selector: dict[str, str] | None = None) -> list[dict]:
         with self._lock:
-            out = [copy.deepcopy(o) for o in self._store(kind).values()]
+            out = [copy.deepcopy(o) for o in self._sorted_objects(kind)]
         if label_selector:
             out = [o for o in out if matches_labels(o, label_selector)]
         if selector:
             out = [o for o in out if selector(o)]
-        return sorted(out, key=lambda o: (o["metadata"].get("namespace", ""),
-                                          o["metadata"]["name"]))
+        return out  # already in (namespace, name) order
 
     def list_nocopy(self, kind: str,
                     selector: Callable[[dict], bool] | None = None) -> list[dict]:
@@ -414,15 +445,14 @@ class FakeApiServer:
         dicts in place); the threaded extender stack keeps using
         :meth:`list`."""
         with self._lock:
-            out = list(self._store(kind).values())
+            out = self._sorted_objects(kind)
             if self.nocopy_guard:
                 for o in out:
                     self._guard_check(kind, o)
                     self._guard_record(kind, o)
         if selector:
             out = [o for o in out if selector(o)]
-        return sorted(out, key=lambda o: (o["metadata"].get("namespace", ""),
-                                          o["metadata"]["name"]))
+        return out  # already in (namespace, name) order
 
     def list_with_version(self, kind: str) -> tuple[list[dict], str]:
         """(items, list resourceVersion) — the informer's initial sync point:
@@ -432,10 +462,8 @@ class FakeApiServer:
         never gets a spurious Gone for the list-to-watch gap."""
         with self._lock:
             self._watch_attached = True
-            out = [copy.deepcopy(o) for o in self._store(kind).values()]
+            out = [copy.deepcopy(o) for o in self._sorted_objects(kind)]
             rv = str(self._rv)
-        out.sort(key=lambda o: (o["metadata"].get("namespace", ""),
-                                o["metadata"]["name"]))
         return out, rv
 
     def watch(self, kind: str, resource_version: str,
@@ -489,6 +517,7 @@ class FakeApiServer:
                 obj = self._store(kind).pop(_key(namespace, name))
             except KeyError:
                 raise NotFound(f"{kind} {namespace}/{name}") from None
+            self._key_removed(kind, _key(namespace, name))
             self._unindex_obj(kind, _key(namespace, name), obj)
             if self.nocopy_guard:
                 self._guard_check(kind, obj)

@@ -27,7 +27,6 @@ never stale in its own view (ExtenderConfig docstring).
 from __future__ import annotations
 
 import threading
-import time
 from collections import deque
 from typing import Callable
 
@@ -58,6 +57,7 @@ class Informer:
         self.kinds = kinds
         self.watch_timeout_s = watch_timeout_s
         self.relist_backoff_s = relist_backoff_s
+        # guarded-by: _lock
         self._store: dict[str, dict[tuple[str, str], dict]] = {
             k: {} for k in kinds}
         # Mirror-side meta equality index — the same MetaIndex structure
@@ -66,8 +66,8 @@ class Informer:
         # installed/removed (_relist / _apply / observe), so gang-member
         # lookup against the mirror is O(gang) instead of a filtered LIST
         # of every pod.
-        self._meta_index = MetaIndex()
-        self._rv: dict[str, str] = {}
+        self._meta_index = MetaIndex()  # guarded-by: _lock
+        self._rv: dict[str, str] = {}  # guarded-by: _lock
         # Content version: bumped ONLY when the mirror's content actually
         # changes (install of a new/newer object, a delete that removed
         # something, a relist).  The watch position (_rv) advances on every
@@ -77,13 +77,14 @@ class Informer:
         # extender's ClusterState) stays coherent and must not be
         # invalidated.  This is what lets bind apply its own delta instead
         # of paying an O(pods) re-sync per call (VERDICT r3 #1).
-        self._content = 0
+        self._content = 0  # guarded-by: _lock
         # Delta journal: one entry per content bump EXCEPT relists (which
         # bump content without an entry — the resulting gap is exactly what
         # tells events_since() that only a full rebuild is exact).  Entry =
         # (content_after, kind, event_type, stored_object).  Bounded: a
         # consumer whose token fell off the window falls back to a full
         # sync, same as after a relist.
+        # guarded-by: _lock
         self._journal: deque[tuple[int, str, str, dict]] = deque(maxlen=256)
         self._lock = threading.Lock()
         self._synced = {k: threading.Event() for k in kinds}
@@ -197,15 +198,15 @@ class Informer:
 
     # ---- meta index maintenance (call under self._lock) --------------------
 
-    def _index_install(self, kind: str, key: tuple[str, str],
+    def _index_install(self, kind: str, key: tuple[str, str],  # holds-lock: _lock
                        old: dict | None, new: dict) -> None:
         self._meta_index.install(kind, key, new, old=old)
 
-    def _index_remove(self, kind: str, key: tuple[str, str],
+    def _index_remove(self, kind: str, key: tuple[str, str],  # holds-lock: _lock
                       obj: dict) -> None:
         self._meta_index.remove(kind, key, obj)
 
-    def _index_rebuild(self, kind: str) -> None:
+    def _index_rebuild(self, kind: str) -> None:  # holds-lock: _lock
         self._meta_index.drop_kind(kind)
         for key, obj in self._store[kind].items():
             self._meta_index.install(kind, key, obj)
@@ -287,8 +288,13 @@ class Informer:
             try:
                 if not self._synced[kind].is_set():
                     self._relist(kind)
+                # Lint-driven fix: _rv is written by _apply/_relist under
+                # the mirror lock; snapshot the watch position under it
+                # too instead of the former bare cross-thread dict read.
+                with self._lock:
+                    watch_from = self._rv[kind]
                 for event in self.api.watch(
-                        kind, self._rv[kind],
+                        kind, watch_from,
                         timeout_s=self.watch_timeout_s):
                     self._apply(kind, event)
                     if self._stop.is_set():

@@ -1,0 +1,88 @@
+"""tputopo.lint — contract-enforcing static analysis for this repository.
+
+Run as ``python -m tputopo.lint``.  See :mod:`tputopo.lint.core` for the
+framework, and the README's "Static analysis & contracts" section for
+the rule table, waiver syntax, and how to add a checker.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Sequence
+
+from tputopo.lint.clocks import ClockDisciplineChecker, DeterminismChecker
+from tputopo.lint.core import (Checker, Finding, LintRun, Module,
+                               discover_files)
+from tputopo.lint.drift import SingleDefChecker
+from tputopo.lint.locks import LockGuardChecker
+from tputopo.lint.nocopy import NocopyChecker
+
+__all__ = [
+    "Checker", "Finding", "LintRun", "Module",
+    "DeterminismChecker", "ClockDisciplineChecker", "NocopyChecker",
+    "LockGuardChecker", "SingleDefChecker",
+    "default_checkers", "run_lint",
+]
+
+
+def default_checkers() -> list[Checker]:
+    """Fresh instances of every project checker (cross-module checkers
+    keep state, so runs must not share instances)."""
+    return [
+        DeterminismChecker(),
+        ClockDisciplineChecker(),
+        NocopyChecker(),
+        LockGuardChecker(),
+        SingleDefChecker(),
+    ]
+
+
+def find_repo_root(start: Path | None = None) -> Path:
+    """The directory holding the ``tputopo`` package — cwd when launched
+    from a checkout, else resolved from this file's location."""
+    if start is not None:
+        return start
+    cwd = Path.cwd()
+    if (cwd / "tputopo").is_dir():
+        return cwd
+    return Path(__file__).resolve().parents[2]
+
+
+def run_lint(root: Path | None = None,
+             paths: Sequence[str] | None = None,
+             checkers: Sequence[Checker] | None = None,
+             ) -> tuple[list[Finding], LintRun]:
+    """Lint the repository (or an explicit file list) and return the
+    active findings plus the run (for waived-finding introspection)."""
+    root = find_repo_root(root)
+    run = LintRun(default_checkers() if checkers is None else list(checkers),
+                  known_rules={c.rule for c in default_checkers()})
+    if paths:
+        files = []
+        for p in paths:
+            ap = (root / p) if not Path(p).is_absolute() else Path(p)
+            if ap.is_dir():
+                try:
+                    rel = ap.resolve().relative_to(root.resolve()).as_posix()
+                except ValueError:
+                    # Directory outside the repo root: lint its files
+                    # under dir-relative names (path-scoped rules then
+                    # don't apply, same as the out-of-root file branch).
+                    for sub in sorted(ap.rglob("*.py")):
+                        srel = sub.relative_to(ap).as_posix()
+                        if "__pycache__" in srel or srel.endswith("_pb2.py"):
+                            continue
+                        files.append((sub, srel))
+                    continue
+                files.extend(discover_files(root, (rel,)))
+            else:
+                try:
+                    rel = ap.resolve().relative_to(root.resolve()).as_posix()
+                except ValueError:
+                    rel = ap.name
+                files.append((ap, rel))
+    else:
+        files = discover_files(root)
+    for path, rel in files:
+        run.add_path(path, rel)
+    return run.finish(), run

@@ -1,0 +1,89 @@
+"""CLI for the contract linter.
+
+Usage::
+
+    python -m tputopo.lint [paths...] [--root DIR] [--select r1,r2]
+                           [--show-waived] [--list-rules]
+
+Exit codes: 0 = clean, 1 = findings, 2 = usage error.  With no paths the
+default file set is every ``.py`` under ``tputopo/`` and ``tests/``
+(excluding generated ``*_pb2.py``), which is also what the CI lint job
+runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+from tputopo.lint import default_checkers, find_repo_root, run_lint
+from tputopo.lint.core import PARSE_RULE, WAIVER_RULE
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tputopo.lint",
+        description="Project-contract static analysis "
+                    "(determinism / clock / nocopy / lock / single-def).")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories (default: tputopo/ "
+                             "and tests/ under the repo root)")
+    parser.add_argument("--root", type=Path, default=None,
+                        help="repository root (default: auto-detect)")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule ids to run "
+                             "(default: all)")
+    parser.add_argument("--show-waived", action="store_true",
+                        help="also print findings suppressed by waivers")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        # argparse exits 2 on usage errors and 0 on --help; keep both.
+        return int(e.code or 0)
+
+    checkers = default_checkers()
+    if args.list_rules:
+        meta = [(WAIVER_RULE, "waiver syntax: reason required, rules must "
+                              "exist, unused waivers flagged"),
+                (PARSE_RULE, "files must parse")]
+        for rule, desc in [(c.rule, c.description) for c in checkers] + meta:
+            print(f"{rule:12s} {desc}")
+        return 0
+    if args.select is not None:
+        wanted = {r.strip() for r in args.select.split(",") if r.strip()}
+        known = {c.rule for c in checkers}
+        unknown = wanted - known
+        if unknown:
+            print(f"error: unknown rule(s) {sorted(unknown)}; "
+                  f"known: {sorted(known)}", file=sys.stderr)
+            return 2
+        checkers = [c for c in checkers if c.rule in wanted]
+
+    root = find_repo_root(args.root)
+    for p in args.paths:
+        ap = (root / p) if not Path(p).is_absolute() else Path(p)
+        if not ap.exists():
+            print(f"error: no such path: {p}", file=sys.stderr)
+            return 2
+
+    t0 = time.perf_counter()
+    findings, run = run_lint(root=root, paths=args.paths, checkers=checkers)
+    dt = time.perf_counter() - t0
+    for f in findings:
+        print(f.render())
+    if args.show_waived:
+        for f in run.waived:
+            print(f"[waived] {f.render()}")
+    n_files = len(run.modules)
+    print(f"tputopo.lint: {len(findings)} finding(s), "
+          f"{len(run.waived)} waived, {n_files} files, {dt:.2f}s",
+          file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

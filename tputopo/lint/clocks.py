@@ -1,0 +1,152 @@
+"""Determinism and injected-clock checkers.
+
+Two rules police the project's virtual-time discipline:
+
+- ``determinism`` — deterministic modules (the sim, chaos, the defrag
+  planner, topology math, the flight recorder) must not *call* wall-clock
+  or ambient-entropy builtins.  Time flows through an injected ``clock``
+  and randomness through a seeded rng; the ``clock=time.time``
+  default-argument idiom is the allowed escape hatch and is recognized
+  structurally (a default is a *reference*, never a call).  Seeded rng
+  construction (``random.Random(0x7E7)``, ``np.random.SeedSequence`` /
+  ``Philox`` / ``Generator`` / ``default_rng(seed)``) is allowed — the
+  ban is on drawing entropy from the environment, not on owning an rng.
+- ``clock`` — any function that *takes* a ``clock`` parameter has
+  promised its caller virtual-time capability; calling a wall-clock
+  builtin in its body breaks that promise silently (the sim would run
+  fine and stop being deterministic).  Enforced package-wide.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tputopo.lint.core import Checker, Finding, Module, dotted_name
+
+#: Module paths whose event streams / reports are part of the
+#: byte-determinism contract (ROADMAP "standing evaluation discipline").
+#: The defrag *controller* is deliberately absent: it is the production
+#: loop and uses per-instance entropy for retry jitter by design.
+DETERMINISTIC_PREFIXES = (
+    "tputopo/sim/",
+    "tputopo/chaos/",
+    "tputopo/topology/",
+    "tputopo/obs/",
+)
+DETERMINISTIC_FILES = ("tputopo/defrag/planner.py",)
+
+#: Wall-clock / ambient-entropy callables, by static dotted name.
+WALL_CLOCK_CALLS = frozenset({
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.sleep",
+    "datetime.now", "datetime.utcnow", "datetime.today",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+})
+ENTROPY_CALLS = frozenset({
+    "os.urandom", "uuid.uuid1", "uuid.uuid4", "secrets.token_bytes",
+    "secrets.token_hex", "secrets.randbits",
+})
+
+#: numpy.random constructors that are deterministic *given a seed* —
+#: allowed even in deterministic modules (the trace generator is built
+#: on Philox streams).
+_NP_SEEDED_CTORS = frozenset({"SeedSequence", "Philox", "PCG64",
+                              "Generator", "BitGenerator"})
+
+
+def _is_seeded_rng_ctor(call: ast.Call, dotted: str) -> bool:
+    """``random.Random(<seed>)`` / ``np.random.default_rng(<seed>)`` /
+    any ``*.random.{SeedSequence,Philox,...}(...)`` — seeded, allowed."""
+    leaf = dotted.rsplit(".", 1)[-1]
+    if leaf in _NP_SEEDED_CTORS and ".random." in f".{dotted}":
+        return True
+    if dotted in ("random.Random", "np.random.default_rng",
+                  "numpy.random.default_rng"):
+        return bool(call.args or call.keywords)  # seedless -> OS entropy
+    return False
+
+
+def _banned_call(call: ast.Call) -> str | None:
+    dotted = dotted_name(call.func)
+    if dotted is None:
+        return None
+    if dotted in WALL_CLOCK_CALLS:
+        return f"wall-clock call {dotted}()"
+    if dotted in ENTROPY_CALLS:
+        return f"ambient-entropy call {dotted}()"
+    first = dotted.split(".", 1)[0]
+    if first in ("random",) or dotted.startswith(("np.random.",
+                                                  "numpy.random.")):
+        if not _is_seeded_rng_ctor(call, dotted):
+            return (f"unseeded/ambient rng call {dotted}() — construct a "
+                    "seeded generator and inject it")
+    return None
+
+
+class DeterminismChecker(Checker):
+    """No wall clock or ambient entropy in deterministic modules."""
+
+    rule = "determinism"
+    description = ("deterministic modules (sim/, chaos/, topology/, obs/, "
+                   "defrag/planner.py) must route time through an injected "
+                   "clock and randomness through a seeded rng")
+
+    def applies_to(self, relpath: str) -> bool:
+        return (relpath.startswith(DETERMINISTIC_PREFIXES)
+                or relpath in DETERMINISTIC_FILES)
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        for node in mod.nodes():
+            if isinstance(node, ast.Call):
+                why = _banned_call(node)
+                if why is not None:
+                    yield Finding(
+                        mod.relpath, node.lineno, node.col_offset, self.rule,
+                        f"{why} in a deterministic module; inject a clock= "
+                        "or seeded rng instead (the clock=time.time default "
+                        "argument is the allowed escape hatch)")
+
+
+class ClockDisciplineChecker(Checker):
+    """A function taking ``clock`` must not also read the wall clock."""
+
+    rule = "clock"
+    description = ("functions with a clock parameter must not call "
+                   "wall-clock builtins in their body")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("tputopo/")
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        for node in mod.nodes():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and self._takes_clock(node):
+                yield from self._check_body(mod, node)
+
+    @staticmethod
+    def _takes_clock(fn: ast.FunctionDef) -> bool:
+        a = fn.args
+        names = [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+        return "clock" in names
+
+    def _check_body(self, mod: Module, fn: ast.FunctionDef
+                    ) -> Iterable[Finding]:
+        stack: list[ast.AST] = list(fn.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if self._takes_clock(node):
+                    continue  # nested fn re-promises; checked on its own
+                stack.extend(ast.iter_child_nodes(node))
+                continue
+            if isinstance(node, ast.Call):
+                dotted = dotted_name(node.func)
+                if dotted in WALL_CLOCK_CALLS:
+                    yield Finding(
+                        mod.relpath, node.lineno, node.col_offset, self.rule,
+                        f"{dotted}() called inside {fn.name}(), which takes "
+                        "an injected clock — use the clock (or clock.sleep) "
+                        "so virtual-time callers stay deterministic")
+            stack.extend(ast.iter_child_nodes(node))

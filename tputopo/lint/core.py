@@ -1,0 +1,290 @@
+"""Framework core for :mod:`tputopo.lint` — the project-contract linter.
+
+The codebase carries load-bearing guarantees that ordinary tooling cannot
+see: byte-deterministic sim reports (no wall clock / ambient entropy in
+deterministic modules), injected-clock discipline, the ``list_nocopy`` /
+``get_nocopy`` no-mutation contract, lock-guarded shared attributes in the
+threaded extender, and single-definition contract literals (report schema
+versions, the Prometheus name prefix, the report counter keep-list).  Each
+of those is enforced here as an AST checker over the repository's own
+source — machine-checked at CI time, the way the nocopy digest guard made
+aliasing checkable at run time.
+
+Vocabulary:
+
+- A :class:`Module` is one parsed source file (AST + token-level comments).
+- A :class:`Checker` contributes :class:`Finding`\\ s for one rule id.
+- A **waiver** is an inline comment ``# tpulint: disable=<rule>[,<rule>]
+  -- <reason>`` suppressing that rule on its own line (trailing form) or
+  on the next line (standalone-comment form).  The reason is mandatory —
+  a waiver without one is itself a finding — and waivers that suppress
+  nothing are findings too, so stale escapes cannot accumulate.
+
+Stdlib-only by design (the same constraint as the scheduler core): the
+whole suite must run anywhere the package imports, in well under ~5 s.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Sequence
+
+#: Rule id of the waiver-syntax meta rule (missing reason, unknown rule,
+#: unused waiver).  Meta findings cannot themselves be waived.
+WAIVER_RULE = "waiver"
+
+#: Rule id reported for files that fail to parse/tokenize.
+PARSE_RULE = "parse"
+
+_WAIVER_RE = re.compile(
+    r"#\s*tpulint:\s*disable=(?P<rules>[A-Za-z0-9_,\- ]+?)"
+    r"(?:\s*--\s*(?P<reason>.*\S))?\s*$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One structured lint finding: ``path:line:col: rule: message``."""
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+
+@dataclass
+class Waiver:
+    """A parsed ``# tpulint: disable=...`` comment."""
+
+    line: int             # line the comment sits on
+    applies_to: int       # line whose findings it suppresses
+    rules: tuple[str, ...]
+    reason: str | None
+    used: bool = False
+
+
+@dataclass
+class Module:
+    """One source file, parsed once and shared by every checker."""
+
+    relpath: str                       # repo-relative, posix separators
+    source: str
+    tree: ast.AST = field(repr=False, default=None)
+    lines: list[str] = field(repr=False, default_factory=list)
+    comments: dict[int, str] = field(repr=False, default_factory=dict)
+    waivers: list[Waiver] = field(default_factory=list)
+    parse_error: Finding | None = None
+    _nodes: list = field(repr=False, default=None)
+
+    def nodes(self) -> list:
+        """Every AST node of the module, walked once and cached — the
+        checkers share this instead of re-walking the tree apiece."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
+
+    @classmethod
+    def parse(cls, relpath: str, source: str) -> "Module":
+        mod = cls(relpath=relpath, source=source,
+                  lines=source.splitlines())
+        try:
+            mod.tree = ast.parse(source)
+        except SyntaxError as e:
+            mod.parse_error = Finding(relpath, e.lineno or 1, e.offset or 0,
+                                      PARSE_RULE, f"syntax error: {e.msg}")
+            mod.tree = ast.Module(body=[], type_ignores=[])
+            return mod
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    mod.comments[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError):
+            pass  # AST parsed; comments are best-effort beyond that
+        mod._parse_waivers()
+        return mod
+
+    def _parse_waivers(self) -> None:
+        for line_no, text in sorted(self.comments.items()):
+            m = _WAIVER_RE.search(text)
+            if m is None:
+                continue
+            rules = tuple(r.strip() for r in m.group("rules").split(",")
+                          if r.strip())
+            src_line = (self.lines[line_no - 1]
+                        if line_no - 1 < len(self.lines) else "")
+            standalone = src_line.lstrip().startswith("#")
+            self.waivers.append(Waiver(
+                line=line_no,
+                applies_to=line_no + 1 if standalone else line_no,
+                rules=rules,
+                reason=m.group("reason")))
+
+    def comment_on_or_above(self, line: int) -> str:
+        """Trailing comment on ``line`` plus a standalone comment line
+        directly above — where annotation checkers look for markers."""
+        parts = []
+        above = self.comments.get(line - 1)
+        if above is not None and line - 2 < len(self.lines) and \
+                self.lines[line - 2].lstrip().startswith("#"):
+            parts.append(above)
+        own = self.comments.get(line)
+        if own is not None:
+            parts.append(own)
+        return "\n".join(parts)
+
+
+class Checker:
+    """Base class: one contract rule.
+
+    ``check_module`` runs per file (scoped by :meth:`applies_to`);
+    ``finalize`` runs once after every file was seen — cross-module rules
+    (single-definition drift) report there."""
+
+    rule = "abstract"
+    description = ""
+
+    def applies_to(self, relpath: str) -> bool:
+        return True
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        return ()
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for an Attribute/Name chain, else None (calls,
+    subscripts and other dynamic roots cannot be a static module path)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def subscript_root(node: ast.AST) -> ast.AST:
+    """The base object of a ``x[...][...].attr`` access chain."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    return node
+
+
+class LintRun:
+    """Parse files once, run every checker, apply waivers, report."""
+
+    def __init__(self, checkers: Sequence[Checker],
+                 known_rules: Iterable[str] | None = None) -> None:
+        self.checkers = list(checkers)
+        # The full rule universe for waiver validation.  A --select run
+        # executes a subset of checkers, but a waiver for a deselected
+        # rule is still legitimate — it must be judged against every rule
+        # that exists, not just the ones running now.
+        self.known_rules = (set(known_rules) if known_rules is not None
+                            else {c.rule for c in self.checkers})
+        self.modules: list[Module] = []
+        self._raw: list[Finding] = []
+        self.waived: list[Finding] = []
+
+    def add_module(self, mod: Module) -> None:
+        self.modules.append(mod)
+        if mod.parse_error is not None:
+            self._raw.append(mod.parse_error)
+            return
+        for checker in self.checkers:
+            if checker.applies_to(mod.relpath):
+                self._raw.extend(checker.check_module(mod))
+
+    def add_source(self, relpath: str, source: str) -> None:
+        self.add_module(Module.parse(relpath, source))
+
+    def add_path(self, path: Path, relpath: str) -> None:
+        self.add_source(relpath, path.read_text(encoding="utf-8"))
+
+    def finish(self) -> list[Finding]:
+        """Finalize cross-module checkers, apply waivers, and return the
+        ACTIVE findings (waived ones land in :attr:`waived`)."""
+        for checker in self.checkers:
+            self._raw.extend(checker.finalize())
+        by_module = {m.relpath: m for m in self.modules}
+        active: list[Finding] = []
+        for f in sorted(self._raw, key=lambda f: (f.path, f.line, f.col,
+                                                  f.rule, f.message)):
+            waiver = self._matching_waiver(by_module.get(f.path), f)
+            if waiver is not None:
+                waiver.used = True
+                self.waived.append(f)
+            else:
+                active.append(f)
+        active.extend(self._waiver_findings())
+        return sorted(active, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    @staticmethod
+    def _matching_waiver(mod: Module | None, f: Finding) -> Waiver | None:
+        if mod is None or f.rule in (WAIVER_RULE, PARSE_RULE):
+            return None
+        for w in mod.waivers:
+            # A reasonless waiver suppresses NOTHING: the violation stays
+            # active alongside the waiver-syntax finding, so fixing the
+            # comment cannot silently change what the run reports.
+            if w.reason and f.line in (w.applies_to, w.line) \
+                    and f.rule in w.rules:
+                return w
+        return None
+
+    def _waiver_findings(self) -> list[Finding]:
+        active = {c.rule for c in self.checkers}
+        known = self.known_rules | active | {WAIVER_RULE, PARSE_RULE}
+        out = []
+        for mod in self.modules:
+            for w in mod.waivers:
+                if not w.reason:
+                    out.append(Finding(
+                        mod.relpath, w.line, 0, WAIVER_RULE,
+                        "waiver must carry a reason: "
+                        "`# tpulint: disable=<rule> -- <why>`"))
+                    continue
+                unknown = [r for r in w.rules if r not in known]
+                if unknown:
+                    out.append(Finding(
+                        mod.relpath, w.line, 0, WAIVER_RULE,
+                        f"waiver names unknown rule(s) {unknown} "
+                        f"(known: {sorted(known)})"))
+                elif not w.used and all(r in active for r in w.rules):
+                    # Unused is only judgeable when every named rule's
+                    # checker actually ran — under --select, a waiver for
+                    # a deselected rule could not have been used.
+                    out.append(Finding(
+                        mod.relpath, w.line, 0, WAIVER_RULE,
+                        f"unused waiver for {list(w.rules)} — it suppresses "
+                        "nothing; remove it"))
+        return out
+
+
+def discover_files(root: Path, roots: Sequence[str] = ("tputopo", "tests"),
+                   ) -> list[tuple[Path, str]]:
+    """All ``.py`` files under ``root/<r>`` for each requested subtree,
+    as (absolute path, repo-relative posix path), deterministically
+    ordered.  Generated protobuf stubs are excluded (not ours to lint)."""
+    out: list[tuple[Path, str]] = []
+    for sub in roots:
+        base = root / sub
+        if not base.is_dir():
+            continue
+        for p in sorted(base.rglob("*.py")):
+            rel = p.relative_to(root).as_posix()
+            if "__pycache__" in rel or rel.endswith("_pb2.py"):
+                continue
+            out.append((p, rel))
+    return out

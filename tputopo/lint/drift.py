@@ -1,0 +1,140 @@
+"""The ``single-def`` checker: contract literals live in exactly one place.
+
+Report schema version strings (``tputopo.sim/v2..v4``), the sim report's
+scheduler-counter keep-list, and the Prometheus metric-name prefix are
+*contracts*: consumers diff reports and scrape metrics against them, and
+a second copy of the literal is a drift bomb — edit one and the other
+silently keeps emitting/asserting the old value.  This checker enforces
+single definition two ways, both configured by a canon of
+``(module, constant-name)`` pairs whose values are read from the
+canonical module's own AST (so the checker never duplicates the literal
+either — it is cross-referenced by construction):
+
+- any *other* ``tputopo/`` module containing a string literal exactly
+  equal to a canonical scalar value is a finding (import the constant
+  instead);
+- any *other* module assigning a module-level constant of the same NAME
+  (a shadow keep-list, say) is a finding.
+
+Tests are deliberately out of scope: a test that pins the literal value
+is pinning the contract on purpose.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Sequence
+
+from tputopo.lint.core import Checker, Finding, Module
+
+#: The repository's contract constants: (canonical module, constant names).
+DEFAULT_CANON: tuple[tuple[str, tuple[str, ...]], ...] = (
+    ("tputopo/sim/report.py",
+     ("SCHEMA", "SCHEMA_DEFRAG", "SCHEMA_CHAOS", "SCHEDULER_COUNTER_KEEP")),
+    ("tputopo/extender/server.py", ("_PREFIX",)),
+)
+
+
+def _module_constants(tree: ast.AST, names: Sequence[str]) -> dict[str, object]:
+    """Values of ``NAME = <literal>`` assignments for the requested names
+    (strings, or tuples/lists/sets of strings), at module level or as
+    class attributes (the Prometheus ``_PREFIX`` lives on the HTTP
+    handler class, not at module scope)."""
+    out: dict[str, object] = {}
+    body = list(getattr(tree, "body", []))
+    while body:
+        node = body.pop(0)
+        if isinstance(node, ast.ClassDef):
+            body.extend(node.body)
+            continue
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and isinstance(node.target, ast.Name):
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        for t in targets:
+            if t.id in names:
+                try:
+                    out[t.id] = ast.literal_eval(value)
+                except (ValueError, SyntaxError):
+                    pass
+    return out
+
+
+class SingleDefChecker(Checker):
+    rule = "single-def"
+    description = ("contract literals (report schema versions, counter "
+                   "keep-list, Prometheus prefix) must be defined once and "
+                   "imported everywhere else")
+
+    def __init__(self, canon=DEFAULT_CANON, scope: str = "tputopo/") -> None:
+        self.canon = tuple(canon)
+        self.scope = scope
+        self._mods: list[Module] = []
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(self.scope)
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        self._mods.append(mod)
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        mods, self._mods = self._mods, []
+        canon_names: dict[str, str] = {}     # constant name -> canonical mod
+        scalar_values: dict[str, tuple[str, str]] = {}  # literal -> (mod, name)
+        by_path = {m.relpath: m for m in mods}
+        for canon_path, names in self.canon:
+            canon_mod = by_path.get(canon_path)
+            if canon_mod is None:
+                continue  # canonical module not in this run's file set
+            consts = _module_constants(canon_mod.tree, names)
+            for name in names:
+                canon_names[name] = canon_path
+            for name, value in consts.items():
+                if isinstance(value, str):
+                    scalar_values[value] = (canon_path, name)
+        if not canon_names and not scalar_values:
+            return
+        canon_paths = {path for path, _ in self.canon}
+        for mod in mods:
+            if mod.relpath in canon_paths:
+                continue
+            yield from self._check_against(mod, canon_names, scalar_values)
+
+    def _check_against(self, mod: Module, canon_names: dict[str, str],
+                       scalar_values: dict[str, tuple[str, str]],
+                       ) -> Iterable[Finding]:
+        # Shadow definitions of a canonical constant NAME.
+        for node in getattr(mod.tree, "body", []):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = [t for t in node.targets
+                           if isinstance(t, ast.Name)]
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                targets = [node.target]
+            for t in targets:
+                if t.id in canon_names:
+                    yield Finding(
+                        mod.relpath, node.lineno, node.col_offset, self.rule,
+                        f"shadow definition of contract constant {t.id} — "
+                        f"the single definition lives in "
+                        f"{canon_names[t.id]}; import it")
+        # Duplicated scalar literals (docstrings that merely mention a
+        # value inside longer prose do not match — equality is exact).
+        for node in mod.nodes():
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                hit = scalar_values.get(node.value)
+                if hit is not None:
+                    path, name = hit
+                    yield Finding(
+                        mod.relpath, node.lineno, node.col_offset, self.rule,
+                        f"duplicated contract literal {node.value!r} — "
+                        f"import {name} from {path} instead")

@@ -1,0 +1,189 @@
+"""The ``lock`` checker: annotated attributes stay inside their lock.
+
+The threaded modules (the fake API server, the informer, the extender
+scheduler) guard shared attributes with explicit locks, but nothing
+stopped a new method from touching ``self._store`` without taking
+``self._lock``.  The discipline is declared where the attribute is born
+and enforced everywhere it is used:
+
+- ``self._store = {}  # guarded-by: _lock`` on an ``__init__`` assignment
+  declares the attribute guarded.  Several acceptable locks may be given
+  separated by ``|`` (e.g. ``_lock|_watch_cond`` — a Condition built on
+  the same lock), and a ``(writes)`` suffix restricts enforcement to
+  stores (the scheduler's published-pair pattern: lock-free readers,
+  serialized writers).
+- Every *other* method of the class must access the attribute inside a
+  ``with self.<lock>:`` block for one of its declared locks, or carry a
+  ``# holds-lock: <lock>`` annotation on its ``def`` line (the
+  caller-holds-the-lock convention for private helpers — the static
+  analogue of Clang's ``REQUIRES()``).
+- ``__init__`` itself is exempt (the object is not yet shared).
+
+Annotations live in comments, so declaring them costs nothing at run
+time; the checker reads them token-level and matches accesses purely
+lexically (nested functions conservatively drop held locks — a closure
+runs later, when the lock may no longer be held).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from tputopo.lint.core import Checker, Finding, Module
+
+_GUARDED_RE = re.compile(
+    r"#\s*guarded-by:\s*(?P<locks>[\w|]+)\s*(?:\((?P<mode>writes)\))?")
+_HOLDS_RE = re.compile(r"#\s*holds-lock:\s*(?P<locks>[\w|]+)")
+
+_NESTED_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class _GuardDecl:
+    __slots__ = ("locks", "writes_only", "line")
+
+    def __init__(self, locks: frozenset[str], writes_only: bool,
+                 line: int) -> None:
+        self.locks = locks
+        self.writes_only = writes_only
+        self.line = line
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class LockGuardChecker(Checker):
+    rule = "lock"
+    description = ("attributes declared `# guarded-by: <lock>` on their "
+                   "__init__ assignment must be accessed under `with "
+                   "self.<lock>:` (or in a `# holds-lock:` helper)")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("tputopo/")
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if "guarded-by" not in mod.source:
+            return
+        for node in mod.nodes():
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(mod, node)
+
+    # -- declarations --------------------------------------------------------
+
+    def _declared_guards(self, mod: Module,
+                         cls: ast.ClassDef) -> dict[str, _GuardDecl]:
+        init = next((n for n in cls.body
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name == "__init__"), None)
+        if init is None:
+            return {}
+        guards: dict[str, _GuardDecl] = {}
+        for node in ast.walk(init):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                m = _GUARDED_RE.search(mod.comment_on_or_above(t.lineno))
+                if m is not None:
+                    guards[attr] = _GuardDecl(
+                        frozenset(m.group("locks").split("|")),
+                        m.group("mode") == "writes", t.lineno)
+        return guards
+
+    def _held_by_annotation(self, mod: Module,
+                            fn: ast.AST) -> frozenset[str]:
+        lineno = getattr(fn, "lineno", None)
+        if lineno is None:
+            return frozenset()
+        m = _HOLDS_RE.search(mod.comment_on_or_above(lineno))
+        if m is not None:
+            return frozenset(m.group("locks").split("|"))
+        return frozenset()
+
+    # -- enforcement ---------------------------------------------------------
+
+    def _check_class(self, mod: Module,
+                     cls: ast.ClassDef) -> Iterable[Finding]:
+        guards = self._declared_guards(mod, cls)
+        if not guards:
+            return
+        for fn in cls.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and fn.name != "__init__":
+                held = self._held_by_annotation(mod, fn)
+                findings: list[Finding] = []
+                for stmt in fn.body:
+                    self._visit_stmt(mod, guards, stmt, held, findings)
+                yield from findings
+
+    def _visit_stmt(self, mod: Module, guards: dict[str, _GuardDecl],
+                    node: ast.AST, held: frozenset[str],
+                    out: list[Finding]) -> None:
+        if isinstance(node, _NESTED_SCOPES):
+            # A nested function may run after the lock is released —
+            # conservatively drop held locks inside (a holds-lock
+            # annotation on the nested def restores them).
+            nested_held = self._held_by_annotation(mod, node)
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt):
+                    self._visit_stmt(mod, guards, child, nested_held, out)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = set()
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None:
+                    acquired.add(attr)
+                # the with-item expression itself evaluates un-acquired
+                self._check_expr(mod, guards, item.context_expr, held, out)
+                if item.optional_vars is not None:
+                    self._check_expr(mod, guards, item.optional_vars,
+                                     held, out)
+            inner = held | acquired
+            for stmt in node.body:
+                self._visit_stmt(mod, guards, stmt, inner, out)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._check_expr(mod, guards, child, held, out)
+            elif isinstance(child, (ast.stmt, ast.excepthandler)):
+                self._visit_stmt(mod, guards, child, held, out)
+
+    def _check_expr(self, mod: Module, guards: dict[str, _GuardDecl],
+                    expr: ast.AST, held: frozenset[str],
+                    out: list[Finding]) -> None:
+        if isinstance(expr, _NESTED_SCOPES):
+            # lambda inside an expression: same drop-held rule
+            for child in ast.iter_child_nodes(expr):
+                if isinstance(child, (ast.expr, ast.stmt)):
+                    self._check_expr(mod, guards, child, frozenset(), out)
+            return
+        attr = _self_attr(expr)
+        if attr is not None and attr in guards:
+            decl = guards[attr]
+            is_store = isinstance(expr.ctx, (ast.Store, ast.Del))
+            if (is_store or not decl.writes_only) \
+                    and not (held & decl.locks):
+                locks = "|".join(sorted(decl.locks))
+                out.append(Finding(
+                    mod.relpath, expr.lineno, expr.col_offset, self.rule,
+                    f"self.{attr} ({'write' if is_store else 'read'}) "
+                    f"outside `with self.{locks}:` — declared guarded-by "
+                    f"{locks} at {mod.relpath}:{decl.line}; wrap the access "
+                    "or annotate the helper with `# holds-lock:`"))
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, (ast.expr, ast.stmt, ast.excepthandler)):
+                self._check_expr(mod, guards, child, held, out)
+            elif isinstance(child, ast.comprehension):
+                for sub in ast.iter_child_nodes(child):
+                    self._check_expr(mod, guards, sub, held, out)

@@ -1,0 +1,203 @@
+"""The ``nocopy`` checker: copy-free read results must stay read-only.
+
+``FakeApiServer.list_nocopy`` / ``get_nocopy`` / ``ObjectHandle.fetch``
+(and their informer mirrors) return the *stored* dicts — the contract is
+single-threaded readers that NEVER mutate the result (PR 3's perf win
+rests on it; the runtime digest guard catches violations only in guarded
+test runs).  This checker makes the contract static: within each
+function it taints names bound from nocopy calls and flags
+
+- mutation through the taint (subscript/attribute stores, ``del``,
+  augmented assignment, mutating method calls like ``.update()``), and
+  direct mutation of an unnamed call result
+  (``api.get_nocopy(...)["x"] = 1``);
+- storing a tainted object onto ``self`` (aliasing beyond the read);
+- returning a tainted object (escape), outside the allowlisted *owner*
+  modules that legitimately hand nocopy views onward.
+
+Taint is propagated through assignment aliases, ``for`` targets over a
+tainted list, and subscript loads (an element of a nocopy list is a
+stored dict too).  The analysis is per-function and name-based — it is
+a contract linter, not an escape analysis; cross-function flows stay
+the runtime guard's job.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tputopo.lint.core import Checker, Finding, Module, subscript_root
+
+#: Method names whose call results carry the nocopy contract.
+NOCOPY_SOURCES = frozenset({"list_nocopy", "get_nocopy", "fetch"})
+
+#: Modules that own the copy-free surfaces and may return/hold nocopy
+#: views as part of their documented contract: the fake API server and
+#: informer (they ARE the stores) and the sim engine (the single-threaded
+#: copy-free facade over them).  Mutation is still flagged even here.
+OWNER_MODULES = frozenset({
+    "tputopo/k8s/fakeapi.py",
+    "tputopo/k8s/informer.py",
+    "tputopo/sim/engine.py",
+})
+
+_MUTATING_METHODS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "setdefault", "sort", "reverse", "add", "discard",
+})
+
+
+def _is_nocopy_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in NOCOPY_SOURCES)
+
+
+class _FunctionScan:
+    def __init__(self, checker: "NocopyChecker", mod: Module,
+                 fn: ast.AST) -> None:
+        self.checker = checker
+        self.mod = mod
+        self.fn = fn
+        self.tainted: set[str] = set()
+        self.findings: list[Finding] = []
+
+    # -- taint bookkeeping ---------------------------------------------------
+
+    def _value_tainted(self, node: ast.AST) -> bool:
+        """Does evaluating ``node`` yield a nocopy-contract object?"""
+        if _is_nocopy_call(node):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Subscript):
+            return self._value_tainted(node.value)  # element of tainted list
+        if isinstance(node, ast.IfExp):
+            return (self._value_tainted(node.body)
+                    or self._value_tainted(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._value_tainted(e) for e in node.elts)
+        return False
+
+    def _bind(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, tainted)
+
+    # -- violations ----------------------------------------------------------
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.findings.append(Finding(
+            self.mod.relpath, node.lineno, node.col_offset,
+            self.checker.rule,
+            f"{what} — list_nocopy/get_nocopy/handle().fetch() results are "
+            "read-only stored objects (copy first, or go through the "
+            "copying API)"))
+
+    def _check_store_target(self, target: ast.AST) -> None:
+        """Subscript/attribute stores whose base chain roots at a tainted
+        object are mutations of a stored dict."""
+        if isinstance(target, (ast.Subscript, ast.Attribute)):
+            root = subscript_root(target)
+            if self._value_tainted(root) or _is_nocopy_call(root):
+                self._flag(target, "mutation of a nocopy result")
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._check_store_target(e)
+
+    # -- walk ----------------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        body = self.fn.body if hasattr(self.fn, "body") else []
+        for stmt in body:
+            self._walk(stmt)
+        return self.findings
+
+    def _walk(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return  # nested scopes are scanned as their own functions
+        handler = getattr(self, f"_visit_{type(node).__name__}", None)
+        if handler is not None:
+            handler(node)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child)
+
+    def _visit_Assign(self, node: ast.Assign) -> None:
+        tainted = self._value_tainted(node.value)
+        for target in node.targets:
+            self._check_store_target(target)
+            if isinstance(target, ast.Attribute) and tainted \
+                    and isinstance(target.value, ast.Name) \
+                    and target.value.id == "self" \
+                    and not self.checker.is_owner(self.mod.relpath):
+                self._flag(node, "nocopy result stored onto self")
+            self._bind(target, tainted)
+
+    def _visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is None:
+            return
+        tainted = self._value_tainted(node.value)
+        self._check_store_target(node.target)
+        self._bind(node.target, tainted)
+
+    def _visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_store_target(node.target)
+        if isinstance(node.target, ast.Name) \
+                and node.target.id in self.tainted:
+            self._flag(node, "augmented assignment to a nocopy result")
+
+    def _visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            self._check_store_target(target)
+
+    def _visit_For(self, node: ast.For) -> None:
+        self._bind(node.target, self._value_tainted(node.iter))
+
+    def _visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATING_METHODS:
+            base = node.func.value
+            if self._value_tainted(base):
+                self._flag(node, f"mutating call .{node.func.attr}() "
+                                 "on a nocopy result")
+
+    def _visit_Return(self, node: ast.Return) -> None:
+        if node.value is not None and self._value_tainted(node.value) \
+                and not self.checker.is_owner(self.mod.relpath):
+            self._flag(node, "nocopy result escapes via return")
+
+
+class NocopyChecker(Checker):
+    rule = "nocopy"
+    description = ("results of list_nocopy/get_nocopy/handle().fetch() must "
+                   "not be mutated, stored onto self, or returned outside "
+                   "owner modules")
+
+    def __init__(self, owners: frozenset[str] = OWNER_MODULES) -> None:
+        self.owners = owners
+
+    def is_owner(self, relpath: str) -> bool:
+        return relpath in self.owners
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(("tputopo/", "tests/"))
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        # Cheap pre-filter: a module that never names a nocopy source
+        # cannot have a finding, and most modules never do.
+        if not any(name in mod.source for name in NOCOPY_SOURCES):
+            return ()
+        findings: list[Finding] = []
+        # Module level plus every function/method, each its own scope.
+        findings.extend(_FunctionScan(self, mod, mod.tree).run())
+        for node in mod.nodes():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(_FunctionScan(self, mod, node).run())
+        return findings

@@ -1028,6 +1028,7 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
     resolved knobs land under ``engine.chaos``, and the schema becomes
     ``tputopo.sim/v4``.  Off (the default) leaves report bytes exactly
     as before."""
+    # tpulint: disable=determinism -- throughput.wall_s is the documented wall-clock exception
     t0 = time.perf_counter()
     defrag_knobs = ({**DEFAULT_DEFRAG, **defrag}
                     if defrag is not None else None)
@@ -1058,6 +1059,7 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
             first_divergence(states[0], rs)
         for rs in states[1:]
     }
+    # tpulint: disable=determinism -- throughput.wall_s is the documented wall-clock exception
     wall_s = time.perf_counter() - t0
     events = sum(rs.events_processed for rs in states)
     engine_params = {"assume_ttl_s": assume_ttl_s,

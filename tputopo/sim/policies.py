@@ -33,6 +33,7 @@ from tputopo.k8s import objects as ko
 from tputopo.k8s.fakeapi import Conflict, FakeApiServer, NotFound
 from tputopo.k8s.retry import (ApiTimeout, ApiUnavailable, RetryPolicy,
                                bind_retry)
+from tputopo.sim.report import SCHEDULER_COUNTER_KEEP
 from tputopo.sim.trace import JobSpec
 from tputopo.topology.baselines import BASELINE_PICKERS
 from tputopo.topology.score import _box_of, score_chip_set
@@ -304,16 +305,9 @@ class IciAwarePolicy(PlacementPolicy):
 
     def counters(self) -> dict:
         c = self._merged_counters()
-        keep = ("sort_requests", "bind_requests", "bind_success",
-                "bind_gang_infeasible", "gang_assumptions_released",
-                "gang_plan_reuse_hits", "gang_multislice_plans",
-                "score_memo_hits",
-                # State-maintenance economics: how often the derived state
-                # was folded forward vs rebuilt from scratch — the
-                # rebuild-avoidance rate is reported, not inferred.
-                "state_delta_applied", "state_full_rebuilds",
-                "state_delta_fallbacks")
-        out = {k: c[k] for k in keep if k in c}
+        # The keep-list is the report's contract — defined once next to
+        # the schema constants (tputopo.sim.report), imported here.
+        out = {k: c[k] for k in SCHEDULER_COUNTER_KEEP if k in c}
         # The per-reason fallback split (state_delta_fallback_node_churn /
         # _journal_gap / _conflict / _overlap / _other): reported so a
         # rebuild storm is attributable from the report alone.

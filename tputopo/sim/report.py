@@ -74,6 +74,23 @@ SCHEMA_DEFRAG = "tputopo.sim/v3"
 #: wall-clock exception.
 SCHEMA_CHAOS = "tputopo.sim/v4"
 
+#: The extender counters the report's per-policy ``scheduler`` block
+#: keeps (the ici policy filters its merged Metrics through this — plus
+#: the dynamic ``state_delta_fallback_*`` / chaos-prefix families).  One
+#: definition, here with the rest of the report schema; ``tputopo.lint``'s
+#: single-def rule flags any shadow copy.
+SCHEDULER_COUNTER_KEEP = (
+    "sort_requests", "bind_requests", "bind_success",
+    "bind_gang_infeasible", "gang_assumptions_released",
+    "gang_plan_reuse_hits", "gang_multislice_plans",
+    "score_memo_hits",
+    # State-maintenance economics: how often the derived state was folded
+    # forward vs rebuilt from scratch — the rebuild-avoidance rate is
+    # reported, not inferred.
+    "state_delta_applied", "state_full_rebuilds",
+    "state_delta_fallbacks",
+)
+
 
 def _r(x: float, nd: int = 6) -> float:
     """Stable rounding: every float in the report passes through here, so

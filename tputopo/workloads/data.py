@@ -25,7 +25,6 @@ for the flagship LM (SURVEY §1 L5).
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass
 
 import numpy as np

@@ -57,8 +57,6 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-import numpy as np
-
 from tputopo.workloads.decode import KVCache, _block_step, _constrain_cache
 from tputopo.workloads.model import ModelConfig, _rope_tables
 from tputopo.workloads.serving import (DecodeState, ServingEngine,
