@@ -138,6 +138,13 @@ def test_parent_process_never_initializes_a_backend():
         assert "skipped" in out["extras"][sub], out["extras"][sub]
 
 
+from jax_features import requires_num_cpu_devices  # noqa: E402
+
+
+# dryrun_multichip forces virtual CPU devices via the
+# jax_num_cpu_devices config option; without it the subprocess cannot
+# start on this JAX.
+@requires_num_cpu_devices
 def test_dryrun_multichip_is_cpu_only_and_hang_immune():
     """MULTICHIP_r04 died because dryrun_multichip touched the default
     backend before forcing CPU.  Pin the fix: under a default platform that

@@ -98,6 +98,12 @@ print("RESULT", g.process_id, int(val), jax.device_count())
 """
 
 
+from jax_features import requires_num_cpu_devices  # noqa: E402
+
+
+# The _WORKER subprocess relies on the jax_num_cpu_devices config
+# option; without it the rendezvous leg cannot run on this JAX.
+@requires_num_cpu_devices
 def test_two_process_cpu_rendezvous():
     """Two actual processes rendezvous through jax.distributed on CPU:
     process/device counts span both, and a broadcast from rank 0 reaches

@@ -8,6 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from jax_features import requires_shard_map
 from tputopo.workloads.lora import (init_lora, lora_view, merge_lora,
                                     make_sharded_lora_state,
                                     make_sharded_lora_train_step)
@@ -43,6 +44,7 @@ def test_invalid_targets_are_loud():
         lora_view(init_params(CFG, jax.random.key(0)), lora)
 
 
+@requires_shard_map
 def test_sharded_training_reduces_loss_and_freezes_base():
     base = init_params(CFG, jax.random.key(0))
     base0 = jax.tree.map(lambda a: np.asarray(a).copy(), base)

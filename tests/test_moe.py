@@ -12,6 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from jax_features import requires_shard_map
 from tputopo.workloads.model import ModelConfig, forward_with_aux, init_params
 from tputopo.workloads.moe import MoEConfig, moe_mlp, moe_mlp_reference
 from tputopo.workloads.sharding import build_mesh
@@ -102,6 +103,7 @@ def test_moe_forward_aux_positive_and_bounded():
     assert np.isfinite(float(aux))
 
 
+@requires_shard_map
 def test_moe_sharded_ep_matches_unsharded():
     """dp=2 x ep=2 x tp=2 sharded MoE train step == single-device step:
     expert parallelism is layout, not math (modulo bf16-free f32 path)."""

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from jax_features import requires_shard_map
 from tputopo.workloads.model import ModelConfig, forward_with_aux, init_params
 from tputopo.workloads.moe import MoEConfig
 from tputopo.workloads.pipeline import pipelined_forward_with_aux
@@ -28,6 +29,7 @@ def _toks(batch=4, seq=32, seed=0):
         np.random.default_rng(seed).integers(0, 128, (batch, seq)))
 
 
+@requires_shard_map
 def test_pipelined_forward_matches_plain_forward():
     plan = build_mesh({"pp": 2, "dp": 2, "tp": 2})
     params = init_params(TINY, jax.random.key(0))
@@ -42,6 +44,7 @@ def test_pipelined_forward_matches_plain_forward():
     assert float(aux) == pytest.approx(float(ref_aux), abs=1e-6)
 
 
+@requires_shard_map
 def test_pipelined_forward_more_microbatches():
     """M > pp shrinks the bubble; the math must not notice."""
     plan = build_mesh({"pp": 4, "dp": 1, "tp": 2})
@@ -69,6 +72,7 @@ def test_pipeline_shape_validation():
                                    _toks(), odd, plan)
 
 
+@requires_shard_map
 def test_pipelined_train_step_matches_unsharded():
     """Full train step through the pipeline (grads flow through ppermute,
     the banked output buffer, and the masked psum) == plain step."""
@@ -125,6 +129,7 @@ def test_pipeline_composed_with_moe_ep():
     assert float(loss) < float(first)
 
 
+@requires_shard_map
 def test_flash_attention_composes_with_pipeline():
     """The Pallas dispatch's inner shard_map must nest inside the
     pipeline's manual-pp region (it targets the context abstract mesh and
@@ -146,6 +151,7 @@ def test_flash_attention_composes_with_pipeline():
                                rtol=2e-4, atol=2e-4)
 
 
+@requires_shard_map
 def test_ring_attention_composes_with_pipeline():
     """Context parallelism inside pipeline stages: pp x sp x tp."""
     plan = build_mesh({"pp": 2, "sp": 2, "tp": 2})

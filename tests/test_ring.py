@@ -5,6 +5,13 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# tputopo.workloads.ring imports jax.shard_map at module level (jax >=
+# 0.8); on an older JAX this is a clean module-wide skip, not a
+# collection error.
+pytest.importorskip(
+    "tputopo.workloads.ring", exc_type=ImportError,
+    reason="tputopo.workloads.ring needs jax >= 0.8 (jax.shard_map)")
+
 from tputopo.workloads.attention import reference_attention
 from tputopo.workloads.model import ModelConfig, forward, init_params
 from tputopo.workloads.ring import ring_attention

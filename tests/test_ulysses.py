@@ -8,6 +8,13 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# tputopo.workloads.ulysses imports jax.shard_map at module level (jax
+# >= 0.8); on an older JAX this is a clean module-wide skip, not a
+# collection error.
+pytest.importorskip(
+    "tputopo.workloads.ulysses", exc_type=ImportError,
+    reason="tputopo.workloads.ulysses needs jax >= 0.8 (jax.shard_map)")
+
 from tputopo.workloads.attention import reference_attention
 from tputopo.workloads.model import ModelConfig, forward, init_params
 from tputopo.workloads.sharding import activate, build_mesh

@@ -95,6 +95,7 @@ def list_pods_nocopy(api) -> list[dict]:
     hint (informer mirror / fake API nocopy) — the shared shim for every
     defrag consumer (controller demand derivation, /debug/defrag)."""
     try:
+        # tpulint: disable=nocopy-flow -- THE documented copy-free shim: every defrag consumer (demand derivation, /debug/defrag) reads the listing and keeps nothing
         return api.list("pods", copy=False)
     except TypeError:  # reader without a copy kwarg (fake/REST client)
         return api.list("pods")

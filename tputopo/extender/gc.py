@@ -27,10 +27,17 @@ from tputopo.extender.state import ClusterState
 
 class AssumptionGC:
     def __init__(self, api_server: FakeApiServer, assume_ttl_s: float = 60.0,
-                 clock=time.time, metrics=None) -> None:
+                 clock=time.time, metrics=None,
+                 wall=time.perf_counter) -> None:
         self.api = api_server
         self.assume_ttl_s = assume_ttl_s
         self.clock = clock
+        # Sweep-latency telemetry rides an injectable wall hook (the
+        # clock=time.time default-arg idiom): it feeds the "gc" latency
+        # series only — never expiry judgement, which is the injected
+        # clock's — so the sim's use of the GC stays wall-clock-free
+        # (clock-flow lint rule).
+        self._wall = wall
         # Optional extender Metrics: sweeps were invisible to /metrics
         # scrapers (a wedged or slow GC could strand reservations silently)
         # — when wired, each pass records gc_sweeps/gc_assumptions_released
@@ -44,7 +51,7 @@ class AssumptionGC:
     def sweep(self) -> list[str]:
         """One pass: clear assignments for expired assumptions (and their
         whole gangs).  Returns the pod names released this pass."""
-        t0 = time.perf_counter()
+        t0 = self._wall()
         state = ClusterState(self.api, assume_ttl_s=self.assume_ttl_s,
                              clock=self.clock).sync()
         victims: dict[tuple[str, str], None] = {}
@@ -92,6 +99,5 @@ class AssumptionGC:
         if self.metrics is not None:
             self.metrics.inc("gc_sweeps")
             self.metrics.inc("gc_assumptions_released", len(released))
-            self.metrics.observe_ms("gc",
-                                    (time.perf_counter() - t0) * 1e3)
+            self.metrics.observe_ms("gc", (self._wall() - t0) * 1e3)
         return released

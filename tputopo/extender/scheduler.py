@@ -29,6 +29,10 @@ import random
 import threading
 import time
 from dataclasses import dataclass, field
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # annotation-only: informer is an optional dependency
+    from tputopo.k8s.informer import Informer
 
 from tputopo.k8s import objects as ko
 from tputopo.k8s.fakeapi import Conflict, FakeApiServer, NotFound
@@ -209,14 +213,30 @@ def _gang_of(pod: dict) -> tuple[str, str, int] | None:
     return md.get("namespace", "default"), gid, size
 
 
+# Canonical lock order (outermost first) — enforced whole-program by the
+# lock-order lint rule: acquiring a lock to the LEFT of one already held
+# is a finding, and any cycle in the derived acquisition graph is a
+# potential deadlock.  The bind verb is the outermost critical section;
+# it publishes through the cache pair, writes through to the informer
+# mirror, and commits via the API server's own lock.
+# lock-order: ExtenderScheduler._bind_lock > ExtenderScheduler._cache_lock > Informer._lock > FakeApiServer._lock
+
+
 class ExtenderScheduler:
     def __init__(self, api_server: FakeApiServer,
                  config: ExtenderConfig | None = None,
-                 clock=time.time, informer=None, tracer=None,
-                 retry: RetryPolicy | None = None, retry_rng=None) -> None:
+                 clock=time.time, informer: "Informer | None" = None,
+                 tracer=None, retry: RetryPolicy | None = None,
+                 retry_rng=None, wall=time.perf_counter) -> None:
         self.api = api_server
         self.config = config or ExtenderConfig()
         self.clock = clock
+        # Verb-latency telemetry rides an injectable wall hook (the
+        # clock=time.time default-arg idiom, obs.Tracer style): the
+        # values feed observe_ms/histograms only — never a decision — and
+        # the indirection keeps the transitive wall-clock effect out of
+        # the sim's reach (clock-flow lint rule), pinnable in tests.
+        self._wall = wall
         # Shared retry discipline (tputopo.k8s.retry) for the API calls the
         # verbs make: transient 5xx/timeouts back off and retry instead of
         # surfacing as hard verb failures.  Sleep rides the clock when it
@@ -585,7 +605,7 @@ class ExtenderScheduler:
         Traced: phase spans (state / gang_plan / score) plus an explain
         record with the per-node score-or-rejection breakdown.
         """
-        t0 = time.perf_counter()
+        t0 = self._wall()
         self.metrics.inc("sort_requests")
         md = pod.get("metadata", {})
         tr = self.tracer.start(
@@ -593,7 +613,7 @@ class ExtenderScheduler:
             pod=f"{md.get('namespace', 'default')}/{md.get('name', '?')}")
         with tr:
             out = self._sort_spanned(pod, node_names, tr)
-        self.metrics.observe_ms("sort", (time.perf_counter() - t0) * 1e3)
+        self.metrics.observe_ms("sort", (self._wall() - t0) * 1e3)
         return out
 
     def _sort_spanned(self, pod: dict, node_names: list[str],
@@ -794,6 +814,7 @@ class ExtenderScheduler:
             # every consumer of a member list is read-only, and the deepcopy
             # of the whole pod population per gang evaluation dominated the
             # bind path at fleet scale.
+            # tpulint: disable=nocopy-flow -- documented read-only member lists (the comment above); the runtime digest guard enforces the contract in guarded runs
             return src.list("pods", is_member, copy=False)
         except TypeError:  # reader without a copy kwarg (fake/REST client)
             return src.list("pods", is_member)
@@ -1221,7 +1242,7 @@ class ExtenderScheduler:
                     self.informer.observe(
                         "pods", self.api.get("pods", md["name"],
                                              md.get("namespace", "default")))
-                except Exception:
+                except (NotFound, ApiUnavailable):
                     pass  # watch delivers the authoritative event shortly
         if released:
             self.metrics.inc("gang_assumptions_released", len(released))
@@ -1386,7 +1407,7 @@ class ExtenderScheduler:
                 self.informer.observe("pods", self.api.get("pods", name, ns))
             except NotFound:
                 pass  # deleted — its assignment no longer exists anywhere
-            except Exception:
+            except ApiUnavailable:
                 continue  # still unreachable; stay authoritative
             self._unmirrored_binds.discard(key)
             self.metrics.inc("bind_write_through_repaired")
@@ -1416,7 +1437,7 @@ class ExtenderScheduler:
         try:
             cur = self._api_call("get", self.api.get, "pods", pod_name,
                                  namespace)
-        except Exception:
+        except (NotFound, ApiUnavailable):
             return None
         if bound_as_planned(cur, node_name, anns[ko.ANN_GROUP]):
             self.metrics.inc("bind_ambiguous_recovered")
@@ -1434,7 +1455,7 @@ class ExtenderScheduler:
 
     def _bind_spanned(self, pod_name: str, namespace: str, node_name: str,  # holds-lock: _bind_lock
                       tr) -> dict:
-        t0 = time.perf_counter()
+        t0 = self._wall()
         self.metrics.inc("bind_requests")
         memo_base = self._memo_counter_snapshot() if tr.enabled else None
         try:
@@ -1634,6 +1655,7 @@ class ExtenderScheduler:
                         and bound_obj.get("metadata", {}).get("resourceVersion")):
                     bound_obj = self.api.get("pods", pod_name, namespace)
                 new_token = self.informer.observe("pods", bound_obj)
+            # tpulint: disable=except-contract -- deliberate boundary: the bind is already committed; ANY read-back/mirror failure must become an unmirrored-bind gap (repaired later), never a bind error
             except Exception:
                 # The bind itself already succeeded, so a failed read-back
                 # (deleted pod, transient 5xx, network) must not surface as
@@ -1720,7 +1742,7 @@ class ExtenderScheduler:
             tr.explain(self._bind_explain(
                 state, decision, k, gang, gang_ctx, memo_base))
         self.metrics.inc("bind_success")
-        self.metrics.observe_ms("bind", (time.perf_counter() - t0) * 1e3)
+        self.metrics.observe_ms("bind", (self._wall() - t0) * 1e3)
         return decision
 
     def _bind_explain(self, state: ClusterState, decision: dict, k: int,

@@ -180,6 +180,7 @@ class ClusterState:
         of its own are what it keeps), so copy-free readers (the informer
         mirror) are asked not to deepcopy."""
         try:
+            # tpulint: disable=nocopy-flow -- sync's documented read-only listing: it parses objects into tuples/sets of its own and keeps none of the stored dicts
             return self.api.list(kind, copy=False)
         except TypeError:  # reader without a copy kwarg (fake/REST client)
             return self.api.list(kind)

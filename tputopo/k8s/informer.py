@@ -303,6 +303,7 @@ class Informer:
             except Gone:
                 self.metrics["relists"] += 1
                 self._synced[kind].clear()
+            # tpulint: disable=except-contract -- deliberate thread-main-loop boundary: any transport exception class (REST client hangups included) must degrade to backoff+relist, counted as watch_errors, never kill the watch thread
             except Exception:
                 if self._stop.is_set():
                     return

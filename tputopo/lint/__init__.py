@@ -11,29 +11,43 @@ from pathlib import Path
 from typing import Sequence
 
 from tputopo.lint.clocks import ClockDisciplineChecker, DeterminismChecker
+from tputopo.lint.clockflow import ClockFlowChecker
 from tputopo.lint.core import (Checker, Finding, LintRun, Module,
                                discover_files)
+from tputopo.lint.counters import CounterDriftChecker
 from tputopo.lint.drift import SingleDefChecker
+from tputopo.lint.excepts import ExceptContractChecker
+from tputopo.lint.lockorder import LockOrderChecker
 from tputopo.lint.locks import LockGuardChecker
 from tputopo.lint.nocopy import NocopyChecker
+from tputopo.lint.nocopyflow import NocopyFlowChecker
 
 __all__ = [
     "Checker", "Finding", "LintRun", "Module",
     "DeterminismChecker", "ClockDisciplineChecker", "NocopyChecker",
     "LockGuardChecker", "SingleDefChecker",
+    "ClockFlowChecker", "CounterDriftChecker", "ExceptContractChecker",
+    "LockOrderChecker", "NocopyFlowChecker",
     "default_checkers", "run_lint",
 ]
 
 
 def default_checkers() -> list[Checker]:
     """Fresh instances of every project checker (cross-module checkers
-    keep state, so runs must not share instances)."""
+    keep state, so runs must not share instances).  The first five are
+    the per-function rules from PR 7; the last five are the whole-program
+    rules rebased on the shared call graph (lint/callgraph.py)."""
     return [
         DeterminismChecker(),
         ClockDisciplineChecker(),
         NocopyChecker(),
         LockGuardChecker(),
         SingleDefChecker(),
+        LockOrderChecker(),
+        ClockFlowChecker(),
+        NocopyFlowChecker(),
+        ExceptContractChecker(),
+        CounterDriftChecker(),
     ]
 
 

@@ -3,30 +3,96 @@
 Usage::
 
     python -m tputopo.lint [paths...] [--root DIR] [--select r1,r2]
+                           [--output text|json|github] [--changed-only]
                            [--show-waived] [--list-rules]
 
 Exit codes: 0 = clean, 1 = findings, 2 = usage error.  With no paths the
 default file set is every ``.py`` under ``tputopo/`` and ``tests/``
 (excluding generated ``*_pb2.py``), which is also what the CI lint job
 runs.
+
+``--output json`` emits one stable, sorted JSON document (the CI lint
+job uploads it as an artifact and asserts ``count == 0``); ``--output
+github`` emits GitHub workflow annotations (``::error file=...``) so
+findings land inline on the PR diff.
+
+``--changed-only`` filters *findings* to files changed vs. git HEAD
+(unstaged + staged + untracked) for fast local iteration.  The whole
+tree is still parsed — the graph-backed rules are whole-program, so a
+sound finding needs full context either way; only the reporting narrows.
+Outside a git repo (or if git fails) it degrades to the full run.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import subprocess
 import sys
 import time
 from pathlib import Path
 
 from tputopo.lint import default_checkers, find_repo_root, run_lint
-from tputopo.lint.core import PARSE_RULE, WAIVER_RULE
+from tputopo.lint.core import PARSE_RULE, WAIVER_RULE, Finding
+
+
+def changed_files(root: Path) -> set[str] | None:
+    """Repo-relative posix paths changed vs. HEAD (worktree + index +
+    untracked), or None when git is unavailable — caller falls back to
+    the full run."""
+    out: set[str] = set()
+    try:
+        # --relative: diff paths come back relative to the -C directory
+        # (the lint root), matching Finding.path even when the checkout
+        # is nested inside a larger git repo; ls-files --others is
+        # already cwd-relative.
+        for args in (["diff", "--name-only", "--relative", "HEAD"],
+                     ["ls-files", "--others", "--exclude-standard"]):
+            proc = subprocess.run(
+                ["git", "-C", str(root), *args],
+                capture_output=True, text=True, timeout=30)
+            if proc.returncode != 0:
+                return None
+            out.update(line.strip() for line in proc.stdout.splitlines()
+                       if line.strip())
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return out
+
+
+def _as_json(findings: list[Finding], waived: list[Finding],
+             n_files: int, rules: list[str], dt: float) -> str:
+    def rec(f: Finding) -> dict:
+        return {"path": f.path, "line": f.line, "col": f.col,
+                "rule": f.rule, "message": f.message}
+
+    doc = {
+        "schema": "tputopo.lint/v1",
+        "count": len(findings),
+        "findings": [rec(f) for f in findings],   # already stably sorted
+        "waived": [rec(f) for f in waived],
+        "files": n_files,
+        "rules": sorted(rules),
+        "duration_s": round(dt, 3),
+    }
+    return json.dumps(doc, indent=1, sort_keys=True)
+
+
+def _github_annotation(f: Finding) -> str:
+    # %, CR and LF must be escaped in workflow-command message data.
+    msg = (f.message.replace("%", "%25").replace("\r", "%0D")
+           .replace("\n", "%0A"))
+    return (f"::error file={f.path},line={f.line},col={max(1, f.col)},"
+            f"title=tputopo.lint {f.rule}::{msg}")
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tputopo.lint",
         description="Project-contract static analysis "
-                    "(determinism / clock / nocopy / lock / single-def).")
+                    "(determinism / clock / nocopy / lock / single-def + "
+                    "whole-program lock-order / clock-flow / nocopy-flow "
+                    "/ except-contract / counter-drift).")
     parser.add_argument("paths", nargs="*",
                         help="files or directories (default: tputopo/ "
                              "and tests/ under the repo root)")
@@ -35,6 +101,15 @@ def main(argv=None) -> int:
     parser.add_argument("--select", default=None,
                         help="comma-separated rule ids to run "
                              "(default: all)")
+    parser.add_argument("--output", choices=("text", "json", "github"),
+                        default="text",
+                        help="finding format: human text (default), one "
+                             "stable JSON document, or GitHub workflow "
+                             "annotations")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="report findings only in files changed vs. "
+                             "git HEAD (full parse either way; falls "
+                             "back to a full report outside a repo)")
     parser.add_argument("--show-waived", action="store_true",
                         help="also print findings suppressed by waivers")
     parser.add_argument("--list-rules", action="store_true",
@@ -51,7 +126,7 @@ def main(argv=None) -> int:
                               "exist, unused waivers flagged"),
                 (PARSE_RULE, "files must parse")]
         for rule, desc in [(c.rule, c.description) for c in checkers] + meta:
-            print(f"{rule:12s} {desc}")
+            print(f"{rule:16s} {desc}")
         return 0
     if args.select is not None:
         wanted = {r.strip() for r in args.select.split(",") if r.strip()}
@@ -72,15 +147,33 @@ def main(argv=None) -> int:
 
     t0 = time.perf_counter()
     findings, run = run_lint(root=root, paths=args.paths, checkers=checkers)
+    waived = run.waived
+    scope_note = ""
+    if args.changed_only:
+        changed = changed_files(root)
+        if changed is None:
+            scope_note = " (--changed-only: no git, full report)"
+        else:
+            findings = [f for f in findings if f.path in changed]
+            waived = [f for f in waived if f.path in changed]
+            scope_note = f" (--changed-only: {len(changed)} changed files)"
     dt = time.perf_counter() - t0
-    for f in findings:
-        print(f.render())
-    if args.show_waived:
-        for f in run.waived:
-            print(f"[waived] {f.render()}")
+
+    if args.output == "json":
+        print(_as_json(findings, waived, len(run.modules),
+                       [c.rule for c in run.checkers], dt))
+    elif args.output == "github":
+        for f in findings:
+            print(_github_annotation(f))
+    else:
+        for f in findings:
+            print(f.render())
+        if args.show_waived:
+            for f in waived:
+                print(f"[waived] {f.render()}")
     n_files = len(run.modules)
     print(f"tputopo.lint: {len(findings)} finding(s), "
-          f"{len(run.waived)} waived, {n_files} files, {dt:.2f}s",
+          f"{len(waived)} waived, {n_files} files, {dt:.2f}s{scope_note}",
           file=sys.stderr)
     return 1 if findings else 0
 

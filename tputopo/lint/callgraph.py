@@ -1,0 +1,579 @@
+"""Whole-program call graph over the repository's ASTs.
+
+PR 7's checkers are per-function: every contract that crosses a call
+boundary — a helper reading the wall clock on behalf of a ``clock``-taking
+caller, a nocopy view laundered through a return value, two locks taken in
+opposite orders on different paths — was invisible to them.  This module
+builds the shared interprocedural substrate the graph-backed checkers
+(:mod:`lockorder`, :mod:`clockflow`, :mod:`nocopyflow`, :mod:`excepts`,
+:mod:`counters`) rebase on:
+
+- **Definitions**: module-level functions, class methods (nested classes
+  included), and nested functions, each a :class:`FunctionInfo` keyed by
+  ``(relpath, qualname)``.
+- **Import resolution**: ``from tputopo.x.y import A as B`` and
+  ``import tputopo.x.y as m`` aliases resolve to the defining module's
+  own definitions (re-export chains followed, cycle-safe).
+- **Method resolution**: ``self.m()`` / ``cls.m()`` resolve through the
+  class hierarchy (bases resolved across modules, C3-ish linearization);
+  ``super().m()`` searches the bases only; ``Class.m()`` and
+  ``Class()`` (constructor -> ``__init__``) resolve by name.
+- **Attribute-type inference**: ``self.x = <param annotated T>`` /
+  ``self.x = T(...)`` in a method body gives ``self.x.m()`` a resolution
+  target when every assignment agrees on one repo class — how the
+  scheduler's calls into ``self.api`` (a :class:`FakeApiServer`) become
+  real edges.
+- **Decorator passthrough**: a decorated ``def`` is still itself; calls
+  to the name reach the underlying function whatever the wrapper.
+
+Everything else — dynamic attributes, callables in containers, results
+of calls — is a **conservatively unresolved** edge: :meth:`CallGraph.
+resolve` returns ``None``, the call site is still listed (checkers can
+apply name heuristics), and no checker may crash or silently widen a
+guarantee because of one.
+
+The graph is built once per lint run and shared: every graph-backed
+checker funnels through :func:`graph_for`, which memoizes on the
+identity of the module list (one entry — runs don't interleave).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterable
+
+from tputopo.lint.core import Module, dotted_name
+
+__all__ = ["FunctionInfo", "ClassInfo", "CallSite", "CallGraph",
+           "graph_for"]
+
+
+@dataclass
+class FunctionInfo:
+    """One function or method definition."""
+
+    relpath: str
+    qualname: str                       # "f", "Cls.m", "f.<locals>.g"
+    node: ast.AST = field(repr=False)
+    cls: "ClassInfo | None" = None      # enclosing class for methods
+    parent: "FunctionInfo | None" = None  # enclosing function (nested defs)
+    takes_clock: bool = False
+    _locals: dict = field(default_factory=dict, repr=False)  # nested defs
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.relpath, self.qualname)
+
+    @property
+    def display(self) -> str:
+        return f"{self.relpath}::{self.qualname}"
+
+    def param_names(self) -> list[str]:
+        a = self.node.args
+        return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+@dataclass
+class ClassInfo:
+    """One class definition (nested classes carry dotted qualnames)."""
+
+    relpath: str
+    qualname: str
+    node: ast.AST = field(repr=False)
+    base_exprs: list = field(default_factory=list, repr=False)
+    bases: list["ClassInfo"] = field(default_factory=list, repr=False)
+    methods: dict[str, FunctionInfo] = field(default_factory=dict,
+                                             repr=False)
+    #: self.<attr> -> ClassInfo inferred from assignments; the
+    #: ``_CONFLICT`` sentinel blocks resolution when assignments disagree.
+    attr_types: dict[str, "ClassInfo | None"] = field(default_factory=dict,
+                                                      repr=False)
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.relpath, self.qualname)
+
+    @property
+    def display(self) -> str:
+        return f"{self.qualname}"
+
+    def mro(self) -> list["ClassInfo"]:
+        """Depth-first linearization, self first, duplicates dropped —
+        close enough to C3 for method lookup in this codebase."""
+        out, seen = [], set()
+        stack = [self]
+        while stack:
+            c = stack.pop(0)
+            if c.key in seen:
+                continue
+            seen.add(c.key)
+            out.append(c)
+            stack = list(c.bases) + stack
+        return out
+
+    def find_method(self, name: str) -> FunctionInfo | None:
+        for c in self.mro():
+            m = c.methods.get(name)
+            if m is not None:
+                return m
+        return None
+
+
+_CONFLICT = object()  # attr_types sentinel: assignments disagree
+
+
+@dataclass
+class CallSite:
+    """One call expression inside a function, resolved when possible."""
+
+    node: ast.Call = field(repr=False)
+    caller: FunctionInfo
+    callee: FunctionInfo | None         # None = conservatively unresolved
+    dotted: str | None                  # static name text, for heuristics
+
+
+def _module_dotted(relpath: str) -> str:
+    """``tputopo/sim/engine.py`` -> ``tputopo.sim.engine``;
+    ``tputopo/k8s/__init__.py`` -> ``tputopo.k8s``."""
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    parts = p.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class _ModuleScope:
+    """Per-module namespace: imports, top-level defs, classes."""
+
+    def __init__(self, mod: Module) -> None:
+        self.mod = mod
+        self.module_aliases: dict[str, str] = {}   # name -> dotted module
+        self.object_aliases: dict[str, tuple[str, str]] = {}  # name ->
+        #   (dotted module, original name)
+        self.functions: dict[str, FunctionInfo] = {}  # top-level name
+        self.classes: dict[str, ClassInfo] = {}       # top-level + nested
+
+    def collect_imports(self) -> None:
+        # Walk the whole tree: imports inside functions or TYPE_CHECKING
+        # blocks still name real modules and still resolve.
+        for node in self.mod.nodes():
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.module_aliases[a.asname] = a.name
+                    else:
+                        # ``import a.b.c`` binds ``a``; dotted call text
+                        # is matched by longest-module-prefix later.
+                        root = a.name.split(".", 1)[0]
+                        self.module_aliases.setdefault(root, root)
+            elif isinstance(node, ast.ImportFrom) and node.level == 0 \
+                    and node.module:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.object_aliases[a.asname or a.name] = (node.module,
+                                                               a.name)
+
+
+class CallGraph:
+    """The whole-program view.  Build with :meth:`build`, query with
+    :meth:`resolve` / :meth:`callees` / :meth:`functions_under`."""
+
+    def __init__(self) -> None:
+        self.functions: dict[tuple[str, str], FunctionInfo] = {}
+        self.classes: dict[tuple[str, str], ClassInfo] = {}
+        self.scopes: dict[str, _ModuleScope] = {}        # by relpath
+        self.by_dotted: dict[str, str] = {}              # dotted -> relpath
+        self._callsites: dict[tuple[str, str], list[CallSite]] = {}
+        self._callers: dict[tuple[str, str], list[CallSite]] | None = None
+        self._resolve_memo: dict[tuple, FunctionInfo | None] = {}
+
+    # ---- construction ------------------------------------------------------
+
+    @classmethod
+    def build(cls, modules: Iterable[Module]) -> "CallGraph":
+        g = cls()
+        mods = [m for m in modules if m.parse_error is None]
+        for m in mods:
+            g.by_dotted[_module_dotted(m.relpath)] = m.relpath
+        for m in mods:
+            scope = _ModuleScope(m)
+            scope.collect_imports()
+            g.scopes[m.relpath] = scope
+            g._collect_defs(scope, m.tree.body, cls_info=None, parent=None)
+        g._resolve_bases()
+        g._infer_attr_types()
+        return g
+
+    def _collect_defs(self, scope: _ModuleScope, body, cls_info, parent,
+                      prefix: str = "") -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = prefix + node.name
+                fn = FunctionInfo(
+                    relpath=scope.mod.relpath, qualname=qual, node=node,
+                    cls=cls_info, parent=parent,
+                    takes_clock="clock" in [
+                        p.arg for p in (*node.args.posonlyargs,
+                                        *node.args.args,
+                                        *node.args.kwonlyargs)])
+                self.functions[fn.key] = fn
+                if cls_info is not None and parent is None:
+                    cls_info.methods[node.name] = fn
+                elif parent is not None:
+                    parent._locals[node.name] = fn
+                else:
+                    scope.functions[node.name] = fn
+                # Nested defs: their own FunctionInfos, parent-linked.
+                self._collect_defs(scope, node.body, cls_info=cls_info,
+                                   parent=fn,
+                                   prefix=qual + ".<locals>.")
+            elif isinstance(node, ast.ClassDef):
+                qual = prefix + node.name
+                ci = ClassInfo(relpath=scope.mod.relpath, qualname=qual,
+                               node=node, base_exprs=list(node.bases))
+                self.classes[ci.key] = ci
+                # Top-level AND nested classes land in the module scope by
+                # their dotted qualname; plain name for top-level.
+                scope.classes[qual] = ci
+                if prefix == "":
+                    scope.classes[node.name] = ci
+                self._collect_defs(scope, node.body, cls_info=ci,
+                                   parent=parent, prefix=qual + ".")
+            elif isinstance(node, (ast.If, ast.Try)):
+                # Defs under guards (TYPE_CHECKING, version forks) still
+                # exist; collect through one structural level.
+                for sub in ast.iter_child_nodes(node):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef,
+                                        ast.ClassDef)):
+                        self._collect_defs(scope, [sub], cls_info, parent,
+                                           prefix)
+
+    def _resolve_bases(self) -> None:
+        for ci in self.classes.values():
+            scope = self.scopes[ci.relpath]
+            for b in ci.base_exprs:
+                target = self._resolve_class_expr(b, scope)
+                if target is not None:
+                    ci.bases.append(target)
+
+    # ---- name/object resolution --------------------------------------------
+
+    def _exported(self, relpath: str, name: str,
+                  _seen: frozenset = frozenset()):
+        """A (FunctionInfo | ClassInfo) named ``name`` in module
+        ``relpath``, following re-export chains (``from x import name``)
+        cycle-safely."""
+        if (relpath, name) in _seen:
+            return None
+        scope = self.scopes.get(relpath)
+        if scope is None:
+            return None
+        got = scope.functions.get(name) or scope.classes.get(name)
+        if got is not None:
+            return got
+        chain = scope.object_aliases.get(name)
+        if chain is not None:
+            src_rel = self.by_dotted.get(chain[0])
+            if src_rel is not None:
+                return self._exported(src_rel, chain[1],
+                                      _seen | {(relpath, name)})
+        return None
+
+    def _resolve_class_expr(self, expr: ast.AST,
+                            scope: _ModuleScope) -> ClassInfo | None:
+        """A class reference in an expression (base list, annotation)."""
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            # String annotation: parse and retry.
+            try:
+                expr = ast.parse(expr.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.BitOr):
+            # ``T | None`` — the one non-None side that resolves wins.
+            got = [self._resolve_class_expr(s, scope)
+                   for s in (expr.left, expr.right)]
+            got = [g for g in got if g is not None]
+            return got[0] if len(got) == 1 else None
+        if isinstance(expr, ast.Subscript):  # Optional[T] / list[T] -> T?
+            if (d := dotted_name(expr.value)) and \
+                    d.rsplit(".", 1)[-1] == "Optional":
+                return self._resolve_class_expr(expr.slice, scope)
+            return None
+        dotted = dotted_name(expr)
+        if dotted is None:
+            return None
+        return self._resolve_dotted_object(dotted, scope, want_class=True)
+
+    def _resolve_dotted_object(self, dotted: str, scope: _ModuleScope,
+                               want_class: bool = False):
+        """``name`` / ``alias.attr`` / ``mod.Class.method`` ->
+        FunctionInfo | ClassInfo | None."""
+        parts = dotted.split(".")
+        head = parts[0]
+        # Local/imported object by bare name.
+        if len(parts) == 1:
+            got = self._exported(scope.mod.relpath, head)
+            if got is None:
+                return None
+            if want_class:
+                return got if isinstance(got, ClassInfo) else None
+            return got
+        # Module alias prefix (``ko.make_pod``, ``m.Class.method``) —
+        # longest dotted-module match wins.
+        if head in scope.module_aliases:
+            base = scope.module_aliases[head]
+            full = ".".join([base] + parts[1:])
+            for cut in range(len(full.split(".")), 0, -1):
+                mod_dotted = ".".join(full.split(".")[:cut])
+                rel = self.by_dotted.get(mod_dotted)
+                if rel is None:
+                    continue
+                rest = full.split(".")[cut:]
+                return self._member_of_module(rel, rest, want_class)
+        # ``Class.method`` / ``Class.Inner`` via a local or imported class.
+        got = self._exported(scope.mod.relpath, head)
+        if isinstance(got, ClassInfo):
+            return self._member_of_class(got, parts[1:], want_class)
+        return None
+
+    def _member_of_module(self, relpath: str, rest: list[str],
+                          want_class: bool):
+        if not rest:
+            return None
+        got = self._exported(relpath, rest[0])
+        if len(rest) == 1:
+            if want_class:
+                return got if isinstance(got, ClassInfo) else None
+            return got
+        if isinstance(got, ClassInfo):
+            return self._member_of_class(got, rest[1:], want_class)
+        return None
+
+    def _member_of_class(self, ci: ClassInfo, rest: list[str],
+                         want_class: bool):
+        if len(rest) != 1:
+            return None
+        if want_class:
+            inner = self.classes.get((ci.relpath,
+                                      f"{ci.qualname}.{rest[0]}"))
+            return inner
+        return ci.find_method(rest[0])
+
+    # ---- attribute-type inference ------------------------------------------
+
+    def _infer_attr_types(self) -> None:
+        for ci in self.classes.values():
+            scope = self.scopes[ci.relpath]
+            for meth in ci.methods.values():
+                ann_of = self._param_annotations(meth, scope)
+                for node in ast.walk(meth.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for t in node.targets:
+                        if not (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            continue
+                        inferred = self._value_class(node.value, scope,
+                                                     ann_of, cls=ci)
+                        prev = ci.attr_types.get(t.attr)
+                        if inferred is None:
+                            # An un-inferable assignment poisons the attr:
+                            # resolving through it could be wrong.
+                            ci.attr_types[t.attr] = _CONFLICT
+                        elif prev is None:
+                            ci.attr_types[t.attr] = inferred
+                        elif prev is not inferred:
+                            ci.attr_types[t.attr] = _CONFLICT
+
+    def _param_annotations(self, fn: FunctionInfo,
+                           scope: _ModuleScope) -> dict[str, ClassInfo]:
+        out = {}
+        a = fn.node.args
+        for p in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+            if p.annotation is not None:
+                got = self._resolve_class_expr(p.annotation, scope)
+                if got is not None:
+                    out[p.arg] = got
+        return out
+
+    def _value_class(self, expr: ast.AST, scope: _ModuleScope,
+                     ann_of: dict[str, ClassInfo],
+                     cls: ClassInfo | None = None) -> ClassInfo | None:
+        """The repo class an assigned value is an instance of, if a
+        single candidate is certain: a constructor call, an annotated
+        parameter, or a call to a function whose return annotation
+        resolves (``self.sched = self._make_scheduler()``)."""
+        if isinstance(expr, ast.Name):
+            return ann_of.get(expr.id)
+        if isinstance(expr, ast.Call):
+            d = dotted_name(expr.func)
+            if d is None:
+                return None
+            got = self._resolve_dotted_object(d, scope, want_class=True)
+            if got is not None:
+                return got
+            # Factory call: resolve the callee and use its return
+            # annotation.  ``self.m()`` resolves through the class.
+            parts = d.split(".")
+            callee = None
+            if parts[0] in ("self", "cls") and cls is not None \
+                    and len(parts) == 2:
+                callee = cls.find_method(parts[1])
+            else:
+                obj = self._resolve_dotted_object(d, scope)
+                if isinstance(obj, FunctionInfo):
+                    callee = obj
+            if callee is not None and \
+                    getattr(callee.node, "returns", None) is not None:
+                return self._resolve_class_expr(
+                    callee.node.returns, self.scopes[callee.relpath])
+            return None
+        if isinstance(expr, ast.IfExp):
+            cands = {c.key: c for c in
+                     (self._value_class(s, scope, ann_of, cls=cls)
+                      for s in (expr.body, expr.orelse)) if c is not None}
+            return next(iter(cands.values())) if len(cands) == 1 else None
+        if isinstance(expr, ast.BoolOp):
+            cands = {c.key: c for c in
+                     (self._value_class(s, scope, ann_of, cls=cls)
+                      for s in expr.values) if c is not None}
+            return next(iter(cands.values())) if len(cands) == 1 else None
+        return None
+
+    def attr_type(self, ci: ClassInfo, attr: str) -> ClassInfo | None:
+        got = None
+        for c in ci.mro():
+            got = c.attr_types.get(attr)
+            if got is not None:
+                break
+        return None if got is _CONFLICT else got
+
+    # ---- call resolution ---------------------------------------------------
+
+    def resolve(self, call: ast.Call,
+                fn: FunctionInfo) -> FunctionInfo | None:
+        """The FunctionInfo a call lands in, or None (unresolved).
+        Memoized per (function, call node) — several checkers resolve
+        the same sites."""
+        memo_key = (fn.key, id(call))
+        if memo_key in self._resolve_memo:
+            return self._resolve_memo[memo_key]
+        got = self._resolve_target(call.func, fn)
+        if isinstance(got, ClassInfo):            # constructor call
+            got = got.find_method("__init__")
+        if not isinstance(got, FunctionInfo):
+            got = None
+        self._resolve_memo[memo_key] = got
+        return got
+
+    def _resolve_target(self, func: ast.AST, fn: FunctionInfo):
+        scope = self.scopes[fn.relpath]
+        # super().m()
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Call)
+                and isinstance(func.value.func, ast.Name)
+                and func.value.func.id == "super" and fn.cls is not None):
+            for base in fn.cls.mro()[1:]:
+                m = base.methods.get(func.attr)
+                if m is not None:
+                    return m
+            return None
+        dotted = dotted_name(func)
+        if dotted is None:
+            return None
+        parts = dotted.split(".")
+        # Nested function visible in the enclosing def chain.
+        if len(parts) == 1:
+            p = fn
+            while p is not None:
+                local = p._locals.get(parts[0])
+                if local is not None:
+                    return local
+                p = p.parent
+        # self.m() / cls.m() / self.attr.m()
+        if parts[0] in ("self", "cls") and fn.cls is not None:
+            if len(parts) == 2:
+                return fn.cls.find_method(parts[1])
+            if len(parts) == 3:
+                target_cls = self.attr_type(fn.cls, parts[1])
+                if target_cls is not None:
+                    return target_cls.find_method(parts[2])
+            return None
+        return self._resolve_dotted_object(dotted, scope)
+
+    def callees(self, fn: FunctionInfo) -> list[CallSite]:
+        """Every call expression in ``fn``'s own body (nested defs are
+        their own functions), resolved where possible.  Cached."""
+        got = self._callsites.get(fn.key)
+        if got is not None:
+            return got
+        sites: list[CallSite] = []
+        stack = list(getattr(fn.node, "body", []))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate FunctionInfo / class scope
+            if isinstance(node, ast.Call):
+                sites.append(CallSite(node=node, caller=fn,
+                                      callee=self.resolve(node, fn),
+                                      dotted=dotted_name(node.func)))
+            stack.extend(ast.iter_child_nodes(node))
+        sites.sort(key=lambda s: (s.node.lineno, s.node.col_offset))
+        self._callsites[fn.key] = sites
+        return sites
+
+    def callers_of(self, fn: FunctionInfo) -> list[CallSite]:
+        if self._callers is None:
+            self._callers = {}
+            for f in list(self.functions.values()):
+                for site in self.callees(f):
+                    if site.callee is not None:
+                        self._callers.setdefault(site.callee.key,
+                                                 []).append(site)
+        return self._callers.get(fn.key, [])
+
+    def functions_under(self, *prefixes: str,
+                        files: tuple[str, ...] = ()) -> list[FunctionInfo]:
+        return [f for f in self.functions.values()
+                if f.relpath.startswith(prefixes) or f.relpath in files]
+
+    def fixpoint(self, seed: set[tuple[str, str]],
+                 stop=None) -> set[tuple[str, str]]:
+        """Backward closure: keys of functions that (transitively) call a
+        seed function.  ``stop(fn)`` prunes propagation through a caller
+        (the caller itself is still included — its own call is direct)."""
+        out = set(seed)
+        work = list(seed)
+        while work:
+            key = work.pop()
+            fn = self.functions.get(key)
+            if fn is None:
+                continue
+            for site in self.callers_of(fn):
+                ck = site.caller.key
+                if ck in out:
+                    continue
+                out.add(ck)
+                if stop is None or not stop(site.caller):
+                    work.append(ck)
+        return out
+
+
+#: One-entry build cache: every graph-backed checker in a run sees the
+#: same module list, so the first ``finalize`` builds and the rest reuse.
+_CACHE: tuple[tuple[int, ...], CallGraph] | None = None
+
+
+def graph_for(modules: list[Module]) -> CallGraph:
+    global _CACHE
+    key = tuple(id(m) for m in modules)
+    if _CACHE is not None and _CACHE[0] == key:
+        return _CACHE[1]
+    graph = CallGraph.build(modules)
+    _CACHE = (key, graph)
+    return graph

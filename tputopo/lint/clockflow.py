@@ -1,0 +1,132 @@
+"""The ``clock-flow`` checker: transitive wall-clock effect analysis.
+
+The per-function rules (``determinism``, ``clock``) see a wall-clock
+call only in the body that makes it.  A helper that calls
+``time.perf_counter()`` on behalf of the sim engine — or of any function
+that took an injected ``clock`` — was a blind spot: the run stays green
+and quietly stops being virtual-time-pure.  This rule closes it with the
+call graph: compute which functions *root* a wall-clock effect, then
+flag every such root that is reachable from
+
+- any function defined in a deterministic module (``sim/``, ``chaos/``,
+  ``topology/``, ``obs/``, ``defrag/planner.py``), or
+- any ``clock``-taking function anywhere in the package,
+
+via call paths whose interior hops are ordinary helpers.  Propagation
+stops at ``clock``-taking functions and deterministic-module functions:
+each of those re-promises virtual time and is an entry in its own right,
+so its body is judged by the direct rules (and by this rule's own
+treatment of it as an entry) — never double-reported through a caller.
+
+Findings attach at the **wall-clock call site** (the root), naming one
+example entry path — one fix (or one reasoned waiver) covers every path
+that reaches it.  Wall sites *inside* deterministic modules or
+``clock``-taking functions are the direct rules' findings and are
+skipped here.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tputopo.lint.callgraph import CallGraph, FunctionInfo, graph_for
+from tputopo.lint.clocks import (DETERMINISTIC_FILES, DETERMINISTIC_PREFIXES,
+                                 WALL_CLOCK_CALLS)
+from tputopo.lint.core import Checker, Finding, Module, dotted_name
+
+#: Entry-path hops shown in a finding before eliding.
+_PATH_HOPS = 4
+
+
+def _in_deterministic_scope(relpath: str) -> bool:
+    return (relpath.startswith(DETERMINISTIC_PREFIXES)
+            or relpath in DETERMINISTIC_FILES)
+
+
+class ClockFlowChecker(Checker):
+    rule = "clock-flow"
+    description = ("wall-clock calls must not be transitively reachable "
+                   "from deterministic modules or clock-taking functions "
+                   "through helper call chains")
+
+    def __init__(self) -> None:
+        self._mods: list[Module] = []
+
+    def applies_to(self, relpath: str) -> bool:
+        # Whole-program module set, shared with the other graph-backed
+        # checkers (one cached build); findings are scoped below.
+        return relpath.startswith(("tputopo/", "tests/"))
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        self._mods.append(mod)
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        mods, self._mods = self._mods, []
+        graph = graph_for(mods)
+
+        def is_entry(fn: FunctionInfo) -> bool:
+            return (fn.takes_clock and fn.relpath.startswith("tputopo/")) \
+                or _in_deterministic_scope(fn.relpath)
+
+        for fn in sorted(graph.functions.values(), key=lambda f: f.key):
+            if not fn.relpath.startswith("tputopo/"):
+                continue  # wall clocks in tests are not the contract
+            if is_entry(fn):
+                continue  # direct rules own this body
+            wall_sites = self._wall_sites(fn)
+            if not wall_sites:
+                continue
+            path = self._entry_path(graph, fn, is_entry)
+            if path is None:
+                continue  # not reachable from virtual-time territory
+            via = " -> ".join(p.display for p in path[:_PATH_HOPS])
+            if len(path) > _PATH_HOPS:
+                via += " -> ..."
+            for node, dotted in wall_sites:
+                yield Finding(
+                    fn.relpath, node.lineno, node.col_offset, self.rule,
+                    f"{dotted}() in {fn.qualname}() is transitively "
+                    f"reachable from virtual-time code ({via}) — take an "
+                    "injectable wall hook (the clock=time.time default-arg "
+                    "idiom) or waive with a reason")
+
+    @staticmethod
+    def _wall_sites(fn: FunctionInfo) -> list[tuple[ast.Call, str]]:
+        out = []
+        stack = list(getattr(fn.node, "body", []))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate scope, judged on its own
+            if isinstance(node, ast.Call):
+                dotted = dotted_name(node.func)
+                if dotted in WALL_CLOCK_CALLS:
+                    out.append((node, dotted))
+            stack.extend(ast.iter_child_nodes(node))
+        out.sort(key=lambda pair: (pair[0].lineno, pair[0].col_offset))
+        return out
+
+    @staticmethod
+    def _entry_path(graph: CallGraph, fn: FunctionInfo,
+                    is_entry) -> list[FunctionInfo] | None:
+        """Shortest caller chain entry -> ... -> fn whose interior hops
+        are non-entries (an interior entry re-promises virtual time and
+        would be its own entry), or None."""
+        seen = {fn.key}
+        frontier: list[list[FunctionInfo]] = [[fn]]
+        while frontier:
+            nxt: list[list[FunctionInfo]] = []
+            for chain in frontier:
+                for site in graph.callers_of(chain[0]):
+                    caller = site.caller
+                    if caller.key in seen:
+                        continue
+                    seen.add(caller.key)
+                    if is_entry(caller):
+                        return [caller] + chain
+                    nxt.append([caller] + chain)
+            frontier = nxt
+        return None

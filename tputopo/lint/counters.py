@@ -1,0 +1,242 @@
+"""The ``counter-drift`` checker: increments round-trip with the registry.
+
+Counter names are contracts three ways: ``/metrics`` exports them as
+Prometheus series, the sim report's ``scheduler`` block filters them
+through ``SCHEDULER_COUNTER_KEEP``, and the defrag block is pre-zeroed
+from ``DefragController.COUNTER_KEYS``.  None of those could see a typo'd
+increment (a fresh series forks silently) or a dead registration (the
+name outlives its last increment site).  This rule closes the loop:
+
+- every **literal** name incremented via ``Metrics.inc`` / ``inc_chaos``
+  (and the plain ``inc(...)`` hook in ``count_retries``) must be
+  registered in :data:`tputopo.obs.counters.COUNTERS`;
+- **f-string** increments must carry a literal prefix matching a
+  :data:`~tputopo.obs.counters.COUNTER_PREFIXES` family;
+- defrag ``_count`` literals must be in ``DefragController.
+  COUNTER_KEYS`` or :data:`~tputopo.obs.counters.DEFRAG_LAZY_COUNTERS`;
+- **dead registrations** are findings too: every registry name, prefix
+  family, lazy key, keep-list entry, and ``COUNTER_KEYS`` entry must
+  still have at least one increment site, and ``SCHEDULER_COUNTER_KEEP``
+  must be a subset of the registry.
+
+Fully dynamic sinks (a bare variable — the engine's ``inc_chaos`` relay,
+the ici policy's counter bridge) are conservatively skipped; they only
+forward names that originate at literal sites elsewhere, which this rule
+already covers.  All canonical vocabularies are read from their defining
+modules' own ASTs — the checker holds no second copy of any name.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tputopo.lint.core import Checker, Finding, Module
+from tputopo.lint.drift import _module_constants
+
+#: Canonical vocabularies: (module, constant name) read from the AST.
+REGISTRY_MODULE = "tputopo/obs/counters.py"
+KEEP_MODULE = "tputopo/sim/report.py"
+DEFRAG_MODULE = "tputopo/defrag/controller.py"
+
+#: Attribute sink names whose first argument is a counter name.
+_ATTR_SINKS = frozenset({"inc", "inc_chaos"})
+_DEFRAG_SINK = "_count"
+#: Bare-name sink: ``count_retries`` calls its injected ``inc(...)``.
+_NAME_SINK = "inc"
+
+
+def _literal_names(arg: ast.AST) -> list[str]:
+    """Constant-string counter names an argument can evaluate to
+    (IfExp / BoolOp branches included)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return [arg.value]
+    if isinstance(arg, ast.IfExp):
+        return _literal_names(arg.body) + _literal_names(arg.orelse)
+    if isinstance(arg, ast.BoolOp):
+        out = []
+        for v in arg.values:
+            out.extend(_literal_names(v))
+        return out
+    return []
+
+
+def _fstring_prefix(arg: ast.AST) -> str | None:
+    if isinstance(arg, ast.JoinedStr) and arg.values \
+            and isinstance(arg.values[0], ast.Constant) \
+            and isinstance(arg.values[0].value, str):
+        return arg.values[0].value
+    return None
+
+
+class CounterDriftChecker(Checker):
+    rule = "counter-drift"
+    description = ("counter names incremented via Metrics.inc/inc_chaos/"
+                   "defrag _count must round-trip with the registry "
+                   "(obs/counters.py), SCHEDULER_COUNTER_KEEP, and "
+                   "DefragController.COUNTER_KEYS — unregistered "
+                   "increments and dead registrations both flagged")
+
+    def __init__(self) -> None:
+        self._mods: list[Module] = []
+
+    def applies_to(self, relpath: str) -> bool:
+        # Package code only: tests increment ad-hoc fakes on purpose.
+        return relpath.startswith("tputopo/")
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        self._mods.append(mod)
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        mods, self._mods = self._mods, []
+        by_path = {m.relpath: m for m in mods}
+        reg_mod = by_path.get(REGISTRY_MODULE)
+        if reg_mod is None:
+            return  # partial run without the registry — nothing to check
+        reg = _module_constants(reg_mod.tree,
+                                ("COUNTERS", "COUNTER_PREFIXES",
+                                 "DEFRAG_LAZY_COUNTERS"))
+        counters = set(reg.get("COUNTERS", ()))
+        prefixes = tuple(reg.get("COUNTER_PREFIXES", ()))
+        lazy = set(reg.get("DEFRAG_LAZY_COUNTERS", ()))
+        keep: set[str] = set()
+        if (m := by_path.get(KEEP_MODULE)) is not None:
+            keep = set(_module_constants(
+                m.tree, ("SCHEDULER_COUNTER_KEEP",)).get(
+                    "SCHEDULER_COUNTER_KEEP", ()))
+        defrag_keys: set[str] = set()
+        if (m := by_path.get(DEFRAG_MODULE)) is not None:
+            defrag_keys = set(_module_constants(
+                m.tree, ("COUNTER_KEYS",)).get("COUNTER_KEYS", ()))
+
+        inc_names: set[str] = set()        # literal inc/inc_chaos names
+        fstr_prefixes_seen: set[str] = set()
+        defrag_names: set[str] = set()     # literal _count names
+        findings: list[Finding] = []
+
+        for mod in mods:
+            if mod.relpath == REGISTRY_MODULE:
+                continue
+            for node in mod.nodes():
+                if not (isinstance(node, ast.Call) and node.args):
+                    continue
+                sink = None
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _ATTR_SINKS | {_DEFRAG_SINK}:
+                    sink = node.func.attr
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id == _NAME_SINK:
+                    sink = _NAME_SINK
+                if sink is None:
+                    continue
+                arg = node.args[0]
+                names = _literal_names(arg)
+                prefix = _fstring_prefix(arg)
+                if sink == _DEFRAG_SINK:
+                    for name in names:
+                        defrag_names.add(name)
+                        if name not in defrag_keys | lazy:
+                            findings.append(Finding(
+                                mod.relpath, node.lineno, node.col_offset,
+                                self.rule,
+                                f"defrag counter {name!r} is not in "
+                                "DefragController.COUNTER_KEYS or "
+                                "DEFRAG_LAZY_COUNTERS — register it or "
+                                "fix the name"))
+                    continue
+                for name in names:
+                    inc_names.add(name)
+                    if name not in counters \
+                            and not name.startswith(prefixes):
+                        findings.append(Finding(
+                            mod.relpath, node.lineno, node.col_offset,
+                            self.rule,
+                            f"counter {name!r} is not registered in "
+                            f"{REGISTRY_MODULE} COUNTERS — register it "
+                            "or fix the name"))
+                if prefix is not None:
+                    fstr_prefixes_seen.add(prefix)
+                    if not prefix.startswith(prefixes):
+                        findings.append(Finding(
+                            mod.relpath, node.lineno, node.col_offset,
+                            self.rule,
+                            f"dynamic counter family {prefix!r}... has no "
+                            f"registered prefix in {REGISTRY_MODULE} "
+                            "COUNTER_PREFIXES"))
+                # Anything else (a forwarding variable, an expression we
+                # cannot see through) is conservatively skipped — such
+                # relays only forward names that originate at literal
+                # sites, which this rule already covers.
+
+        yield from findings
+        yield from self._dead_findings(
+            reg_mod, by_path, counters, prefixes, lazy, keep, defrag_keys,
+            inc_names, fstr_prefixes_seen, defrag_names)
+
+    def _dead_findings(self, reg_mod, by_path, counters, prefixes, lazy,
+                       keep, defrag_keys, inc_names, fstr_seen,
+                       defrag_names) -> Iterable[Finding]:
+        def const_line(mod: Module, const: str, member: str) -> int:
+            """Line of ``member`` inside the ``const`` literal (falling
+            back to the assignment line) — so a dead entry's finding
+            points at the entry itself."""
+            for node in mod.nodes():
+                if isinstance(node, ast.Assign) \
+                        and any(isinstance(t, ast.Name) and t.id == const
+                                for t in node.targets):
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Constant) \
+                                and sub.value == member:
+                            return sub.lineno
+                    return node.lineno
+            return 1
+
+        for name in sorted(counters - inc_names):
+            yield Finding(
+                reg_mod.relpath, const_line(reg_mod, "COUNTERS", name), 0,
+                self.rule,
+                f"dead registered counter {name!r}: no inc/inc_chaos "
+                "site increments it — remove it or restore the "
+                "increment")
+        for prefix in sorted(set(prefixes)):
+            if not any(seen.startswith(prefix) or prefix.startswith(seen)
+                       for seen in fstr_seen):
+                yield Finding(
+                    reg_mod.relpath,
+                    const_line(reg_mod, "COUNTER_PREFIXES", prefix), 0,
+                    self.rule,
+                    f"dead counter-family prefix {prefix!r}: no f-string "
+                    "increment uses it")
+        for name in sorted(lazy - defrag_names):
+            yield Finding(
+                reg_mod.relpath,
+                const_line(reg_mod, "DEFRAG_LAZY_COUNTERS", name), 0,
+                self.rule,
+                f"dead lazy defrag counter {name!r}: no _count site "
+                "increments it")
+        keep_mod = by_path.get(KEEP_MODULE)
+        if keep_mod is not None:
+            for name in sorted(keep - inc_names):
+                yield Finding(
+                    keep_mod.relpath,
+                    const_line(keep_mod, "SCHEDULER_COUNTER_KEEP", name),
+                    0, self.rule,
+                    f"SCHEDULER_COUNTER_KEEP entry {name!r} is never "
+                    "incremented — the report would carry a dead key")
+            for name in sorted(keep - counters):
+                yield Finding(
+                    keep_mod.relpath,
+                    const_line(keep_mod, "SCHEDULER_COUNTER_KEEP", name),
+                    0, self.rule,
+                    f"SCHEDULER_COUNTER_KEEP entry {name!r} is not in "
+                    f"the registry ({REGISTRY_MODULE})")
+        defrag_mod = by_path.get(DEFRAG_MODULE)
+        if defrag_mod is not None:
+            for name in sorted(defrag_keys - defrag_names):
+                yield Finding(
+                    defrag_mod.relpath,
+                    const_line(defrag_mod, "COUNTER_KEYS", name), 0,
+                    self.rule,
+                    f"DefragController.COUNTER_KEYS entry {name!r} is "
+                    "never incremented — dead report key")

@@ -1,0 +1,179 @@
+"""The ``except-contract`` checker: control-plane catches stay classified.
+
+The chaos harness (PR 6) classified every transient control-plane fault
+into a closed vocabulary — :class:`ApiUnavailable` / :class:`ApiTimeout`
+(k8s/retry.py), :class:`Conflict` / :class:`NotFound` / :class:`Gone`
+(k8s/fakeapi.py), :class:`BindError` (extender/scheduler.py) — and the
+retry/bind/GC/defrag legs were hardened to catch exactly those.  Nothing
+enforced it: a ``except Exception:`` on a retry leg silently swallows
+the next genuine bug (an AttributeError in a fault handler reads as "a
+transient, carry on") and un-classifies the fault taxonomy the chaos
+report's attribution rests on.
+
+This rule flags **over-broad handlers** — bare ``except:``,
+``except BaseException``, ``except Exception``, ``except RuntimeError``
+(the common ancestor of the classified types: catching it catches them
+all plus everything else) — in control-plane modules, but only when the
+guarded ``try`` body can actually meet a classified fault: a call that
+resolves (via the call graph) to a function that transitively raises
+one, or an unresolved call whose method name is an API verb (the
+conservative fallback — an unresolved edge must not silently drop a
+finding).  Deliberate boundary catch-alls (thread main loops, HTTP
+handler edges) take the standard reasoned waiver.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tputopo.lint.callgraph import graph_for
+from tputopo.lint.core import Checker, Finding, Module, dotted_name
+
+#: The classified fault vocabulary (k8s/retry.py, k8s/fakeapi.py,
+#: extender/scheduler.py).
+CLASSIFIED_FAULTS = frozenset({
+    "ApiUnavailable", "ApiTimeout", "Conflict", "NotFound", "Gone",
+    "BindError",
+})
+
+#: Catching any of these (or nothing) on a control-plane path is a
+#: finding: each subsumes the classified vocabulary.
+OVER_BROAD = frozenset({"Exception", "BaseException", "RuntimeError"})
+
+#: Modules whose except clauses are under the contract: the scheduler's
+#: verbs and recovery, the GC, the defrag loop, the API/retry/informer
+#: plumbing, and the sim policies that drive the same bind legs.
+CONTROL_PLANE_PREFIXES = ("tputopo/extender/", "tputopo/defrag/",
+                          "tputopo/k8s/")
+CONTROL_PLANE_FILES = ("tputopo/sim/policies.py",)
+
+#: API verb names: an unresolved ``something.<verb>(...)`` in a try body
+#: is conservatively assumed able to raise a classified fault.
+_API_VERBS = frozenset({
+    "get", "list", "list_with_version", "list_by_meta", "create",
+    "create_many", "delete", "patch_annotations", "patch_labels",
+    "bind_pod", "watch", "observe", "fetch", "request",
+})
+
+
+class ExceptContractChecker(Checker):
+    rule = "except-contract"
+    description = ("control-plane except clauses must name classified "
+                   "fault types (ApiUnavailable/ApiTimeout/Conflict/"
+                   "BindError/NotFound/Gone), not bare/Exception/"
+                   "RuntimeError catch-alls")
+
+    def __init__(self) -> None:
+        self._mods: list[Module] = []
+
+    def applies_to(self, relpath: str) -> bool:
+        # Whole-program module set, shared with the other graph-backed
+        # checkers (one cached build); findings are scoped to the
+        # control-plane modules below.
+        return relpath.startswith(("tputopo/", "tests/"))
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        self._mods.append(mod)
+        return ()
+
+    @staticmethod
+    def _in_scope(relpath: str) -> bool:
+        return (relpath.startswith(CONTROL_PLANE_PREFIXES)
+                or relpath in CONTROL_PLANE_FILES)
+
+    @staticmethod
+    def _over_broad_names(handler: ast.excepthandler) -> list[str]:
+        if handler.type is None:
+            return ["<bare>"]
+        exprs = (handler.type.elts
+                 if isinstance(handler.type, ast.Tuple)
+                 else [handler.type])
+        out = []
+        for e in exprs:
+            d = dotted_name(e)
+            if d is not None and d.rsplit(".", 1)[-1] in OVER_BROAD:
+                out.append(d)
+        return out
+
+    def finalize(self) -> Iterable[Finding]:
+        mods, self._mods = self._mods, []
+        graph = graph_for(mods)
+
+        # Which functions may (transitively) raise a classified fault:
+        # seed on direct ``raise <Classified>`` sites, close backward
+        # over call edges.  (A module whose source never says "raise"
+        # cannot hold a seed — skip its functions' walks.)
+        raising_paths = {m.relpath for m in mods if "raise" in m.source}
+        seeds = set()
+        for fn in graph.functions.values():
+            if fn.relpath not in raising_paths:
+                continue
+            stack = list(getattr(fn.node, "body", []))
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if isinstance(node, ast.Raise) and node.exc is not None:
+                    exc = node.exc
+                    if isinstance(exc, ast.Call):
+                        exc = exc.func
+                    d = dotted_name(exc)
+                    if d is not None \
+                            and d.rsplit(".", 1)[-1] in CLASSIFIED_FAULTS:
+                        seeds.add(fn.key)
+                stack.extend(ast.iter_child_nodes(node))
+        may_raise = graph.fixpoint(seeds)
+
+        def try_body_reaches_fault(fn, body) -> bool:
+            stack = list(body)
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if isinstance(node, ast.Call):
+                    callee = graph.resolve(node, fn)
+                    if callee is not None:
+                        if callee.key in may_raise:
+                            return True
+                    elif isinstance(node.func, ast.Attribute) \
+                            and node.func.attr in _API_VERBS:
+                        return True  # conservative: unresolved API verb
+                    # Retry-wrapper idiom: the verb travels as a function
+                    # REFERENCE argument (``self._api_call("get",
+                    # self.api.get, ...)``) — the wrapper call is
+                    # unresolvable through the stored closure, but the
+                    # referenced verb still classifies the try body.
+                    for arg in node.args:
+                        if isinstance(arg, ast.Attribute) \
+                                and arg.attr in _API_VERBS:
+                            return True
+                stack.extend(ast.iter_child_nodes(node))
+            return False
+
+        for fn in sorted(graph.functions.values(), key=lambda f: f.key):
+            if not self._in_scope(fn.relpath):
+                continue
+            stack = list(getattr(fn.node, "body", []))
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if isinstance(node, (ast.Try, *(
+                        (ast.TryStar,) if hasattr(ast, "TryStar") else ()))):
+                    for handler in node.handlers:
+                        broad = self._over_broad_names(handler)
+                        if broad and try_body_reaches_fault(fn, node.body):
+                            yield Finding(
+                                fn.relpath, handler.lineno,
+                                handler.col_offset, self.rule,
+                                f"over-broad catch ({', '.join(broad)}) on "
+                                "a control-plane path that can raise "
+                                "classified faults — name the fault types "
+                                "(ApiUnavailable/ApiTimeout/Conflict/"
+                                "BindError/NotFound/Gone) or waive with a "
+                                "reason")
+                stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,413 @@
+"""The ``lock-order`` checker: no deadlockable acquisition cycles.
+
+The ``lock`` rule (locks.py) proves every guarded attribute is touched
+under *a* lock; it says nothing about taking two locks in opposite
+orders on different paths — the classic deadlock nobody reproduces in a
+test.  This rule derives the **lock-acquisition graph** whole-program:
+
+- Locks are discovered where they are born: ``self._x =
+  threading.Lock()`` / ``RLock()`` in ``__init__``.  A ``threading.
+  Condition(self._x)`` is an *alias* of ``_x`` (same underlying lock —
+  the fake API's ``_watch_cond`` pattern), inferred, not annotated.
+- Acquisitions are ``with self._x:`` blocks; ``# holds-lock: _x`` on a
+  ``def`` line seeds the entry held-set (the caller-holds convention the
+  ``lock`` rule already uses).
+- Held-lock sets propagate through **call edges**: a call made while
+  holding K reaches every lock the callee (transitively) acquires, so
+  ``K -> L`` edges appear even when the two ``with`` blocks live in
+  different classes and files.  Unresolved calls propagate nothing
+  (conservative).
+
+Findings:
+
+- any cycle in the acquisition graph (potential deadlock), reported once
+  per cycle with one example site per edge;
+- re-acquisition of a non-reentrant ``Lock`` while already held (direct
+  or through a call) — self-deadlock;
+- any acquisition violating the declared canonical order: a module
+  directive comment ``# lock-order: A._x > B._y > C._z`` (outermost
+  first) pins the legal nesting; acquiring an earlier lock while holding
+  a later one is a finding even before it closes into a cycle.
+  Directives merge across modules; contradictions are findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from tputopo.lint.callgraph import (CallGraph, ClassInfo, FunctionInfo,
+                                    graph_for)
+from tputopo.lint.core import Checker, Finding, Module
+
+_ORDER_RE = re.compile(r"#\s*lock-order:\s*(?P<order>[\w.\s>]+)")
+_HOLDS_RE = re.compile(r"#\s*holds-lock:\s*(?P<locks>[\w|]+)")
+
+#: (class-key, attr) -> display text
+LockKey = tuple[tuple[str, str], str]
+
+
+class _LockDecl:
+    __slots__ = ("cls", "attr", "kind", "line")  # line: declaration site
+
+    def __init__(self, cls: ClassInfo, attr: str, kind: str,
+                 line: int) -> None:
+        self.cls = cls
+        self.attr = attr
+        self.kind = kind  # "Lock" | "RLock" | "Condition"
+        self.line = line
+
+    @property
+    def key(self) -> LockKey:
+        return (self.cls.key, self.attr)
+
+    @property
+    def display(self) -> str:
+        return f"{self.cls.qualname}.{self.attr}"
+
+    @property
+    def reentrant(self) -> bool:
+        # A Condition aliases its (usually R)Lock; aliases canonicalize
+        # to the base attr before this is consulted.
+        return self.kind == "RLock"
+
+
+class LockOrderChecker(Checker):
+    rule = "lock-order"
+    description = ("lock acquisitions (with self.<lock>:, held sets "
+                   "propagated through call edges) must be acyclic and "
+                   "respect the declared `# lock-order:` canonical order")
+
+    def __init__(self) -> None:
+        self._mods: list[Module] = []
+
+    def applies_to(self, relpath: str) -> bool:
+        # Whole-program module set, shared with the other graph-backed
+        # checkers (one cached build); findings are scoped to tputopo/.
+        return relpath.startswith(("tputopo/", "tests/"))
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        self._mods.append(mod)
+        return ()
+
+    # ---- discovery ---------------------------------------------------------
+
+    def _discover_locks(self, graph: CallGraph) -> tuple[
+            dict[LockKey, _LockDecl], dict[tuple, dict[str, str]]]:
+        """All declared locks, plus per-class alias maps
+        (attr -> canonical attr, identity included)."""
+        locks: dict[LockKey, _LockDecl] = {}
+        aliases: dict[tuple, dict[str, str]] = {}
+        for ci in graph.classes.values():
+            init = ci.methods.get("__init__")
+            if init is None:
+                continue
+            amap: dict[str, str] = {}
+            for node in ast.walk(init.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                target = node.targets[0] if len(node.targets) == 1 else None
+                if not (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"):
+                    continue
+                call = node.value
+                if not (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Attribute)
+                        and isinstance(call.func.value, ast.Name)
+                        and call.func.value.id == "threading"):
+                    continue
+                kind = call.func.attr
+                if kind in ("Lock", "RLock"):
+                    decl = _LockDecl(ci, target.attr, kind, node.lineno)
+                    locks[decl.key] = decl
+                    amap[target.attr] = target.attr
+                elif kind == "Condition":
+                    base = None
+                    if call.args and isinstance(call.args[0], ast.Attribute) \
+                            and isinstance(call.args[0].value, ast.Name) \
+                            and call.args[0].value.id == "self":
+                        base = call.args[0].attr
+                    if base is not None and base in amap:
+                        amap[target.attr] = amap[base]  # alias, same lock
+                    else:
+                        decl = _LockDecl(ci, target.attr, "Condition",
+                                         node.lineno)
+                        locks[decl.key] = decl  # Condition owns its lock
+                        amap[target.attr] = target.attr
+            if amap:
+                aliases[ci.key] = amap
+        return locks, aliases
+
+    def _canonical(self, fn: FunctionInfo, attr: str,
+                   locks: dict[LockKey, _LockDecl],
+                   aliases: dict) -> _LockDecl | None:
+        if fn.cls is None:
+            return None
+        for c in fn.cls.mro():
+            amap = aliases.get(c.key)
+            if amap and attr in amap:
+                return locks.get((c.key, amap[attr]))
+        return None
+
+    def _entry_held(self, mod: Module, fn: FunctionInfo,
+                    locks, aliases) -> frozenset[LockKey]:
+        m = _HOLDS_RE.search(mod.comment_on_or_above(fn.node.lineno))
+        if m is None:
+            return frozenset()
+        held = set()
+        for attr in m.group("locks").split("|"):
+            decl = self._canonical(fn, attr, locks, aliases)
+            if decl is not None:
+                held.add(decl.key)
+        return frozenset(held)
+
+    # ---- per-function scan -------------------------------------------------
+
+    def _scan(self, fn: FunctionInfo, graph: CallGraph, locks, aliases,
+              entry_held: frozenset[LockKey]):
+        """(acquisitions, calls): each acquisition is (lock-key, held-
+        before, node); each call is (callee, held, node)."""
+        acqs: list[tuple[LockKey, frozenset, ast.AST]] = []
+        calls: list[tuple[FunctionInfo, frozenset, ast.AST]] = []
+
+        def visit(node: ast.AST, held: frozenset) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return  # separate scope; held conservatively dropped
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = held
+                for item in node.items:
+                    e = item.context_expr
+                    if isinstance(e, ast.Attribute) \
+                            and isinstance(e.value, ast.Name) \
+                            and e.value.id == "self":
+                        decl = self._canonical(fn, e.attr, locks, aliases)
+                        if decl is not None:
+                            acqs.append((decl.key, inner, e))
+                            inner = inner | {decl.key}
+                    visit(e, held)
+                for stmt in node.body:
+                    visit(stmt, inner)
+                return
+            if isinstance(node, ast.Call):
+                callee = graph.resolve(node, fn)
+                if callee is not None:
+                    calls.append((callee, held, node))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in getattr(fn.node, "body", []):
+            visit(stmt, entry_held)
+        return acqs, calls
+
+    # ---- the analysis ------------------------------------------------------
+
+    def finalize(self) -> Iterable[Finding]:
+        mods, self._mods = self._mods, []
+        graph = graph_for(mods)
+        by_path = {m.relpath: m for m in mods}
+        locks, aliases = self._discover_locks(graph)
+        if not locks:
+            return
+
+        scans: dict[tuple, tuple] = {}
+        for fn in graph.functions.values():
+            if not fn.relpath.startswith("tputopo/"):
+                continue  # test-local locks are not the contract
+            mod = by_path.get(fn.relpath)
+            if mod is None:
+                continue
+            if "with self." not in mod.source \
+                    and "holds-lock" not in mod.source:
+                # No acquisition can originate in this module (an
+                # acquisition is literally ``with self.<lock>:``); the
+                # function still forwards transitive acquisitions, so
+                # its calls come from the shared cached walk, all with
+                # an empty held set.
+                scans[fn.key] = ([], [(s.callee, frozenset(), s.node)
+                                      for s in graph.callees(fn)
+                                      if s.callee is not None])
+                continue
+            entry = self._entry_held(mod, fn, locks, aliases)
+            scans[fn.key] = self._scan(fn, graph, locks, aliases, entry)
+
+        # Transitive acquisition sets per function (worklist fixpoint —
+        # recursion-safe).
+        all_acq: dict[tuple, frozenset[LockKey]] = {
+            key: frozenset(a for a, _, _ in scan[0])
+            for key, scan in scans.items()}
+        changed = True
+        while changed:
+            changed = False
+            for key, (_, calls) in scans.items():
+                merged = all_acq[key]
+                for callee, _, _ in calls:
+                    merged = merged | all_acq.get(callee.key, frozenset())
+                if merged != all_acq[key]:
+                    all_acq[key] = merged
+                    changed = True
+
+        # Edges K -> L with one example site each; plus direct findings.
+        edges: dict[LockKey, dict[LockKey, tuple[str, ast.AST]]] = {}
+        findings: list[Finding] = []
+
+        def add_edge(k: LockKey, l: LockKey, relpath: str,
+                     node: ast.AST) -> None:
+            edges.setdefault(k, {}).setdefault(l, (relpath, node))
+
+        for key, (acqs, calls) in sorted(scans.items()):
+            fn = graph.functions[key]
+            for lock_key, held, node in acqs:
+                if lock_key in held:
+                    if not locks[lock_key].reentrant:
+                        findings.append(Finding(
+                            fn.relpath, node.lineno, node.col_offset,
+                            self.rule,
+                            f"re-acquisition of non-reentrant lock "
+                            f"{locks[lock_key].display} while already "
+                            "held — self-deadlock"))
+                    continue
+                for held_key in held:
+                    add_edge(held_key, lock_key, fn.relpath, node)
+            for callee, held, node in calls:
+                if not held:
+                    continue
+                for lock_key in all_acq.get(callee.key, ()):
+                    if lock_key in held:
+                        if not locks[lock_key].reentrant:
+                            findings.append(Finding(
+                                fn.relpath, node.lineno, node.col_offset,
+                                self.rule,
+                                f"call into {callee.qualname}() while "
+                                f"holding {locks[lock_key].display}, which "
+                                "it re-acquires — self-deadlock on a "
+                                "non-reentrant lock"))
+                        continue
+                    for held_key in held:
+                        add_edge(held_key, lock_key, fn.relpath, node)
+
+        findings.extend(self._cycle_findings(edges, locks))
+        findings.extend(self._order_findings(mods, edges, locks))
+        yield from findings
+
+    def _cycle_findings(self, edges, locks) -> Iterable[Finding]:
+        # Tarjan over the lock graph; any SCC with >1 lock is a
+        # potential-deadlock cycle.
+        index: dict[LockKey, int] = {}
+        low: dict[LockKey, int] = {}
+        on: set[LockKey] = set()
+        stack: list[LockKey] = []
+        sccs: list[list[LockKey]] = []
+        counter = [0]
+        nodes = sorted(set(edges) | {l for m in edges.values() for l in m})
+
+        def strongconnect(v: LockKey) -> None:
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            for w in sorted(edges.get(v, {})):
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    sccs.append(comp)
+
+        for v in nodes:
+            if v not in index:
+                strongconnect(v)
+        for comp in sccs:
+            comp = sorted(comp)
+            names = " <-> ".join(locks[k].display for k in comp)
+            sites = []
+            for k in comp:
+                for l, (relpath, node) in sorted(edges.get(k, {}).items()):
+                    if l in comp:
+                        sites.append(f"{locks[k].display}->"
+                                     f"{locks[l].display} at "
+                                     f"{relpath}:{node.lineno}")
+            relpath, node = next(iter(edges[comp[0]].values()))
+            yield Finding(
+                relpath, node.lineno, node.col_offset, self.rule,
+                f"lock-acquisition cycle (potential deadlock): {names} "
+                f"[{'; '.join(sites)}]")
+
+    def _order_findings(self, mods, edges, locks) -> Iterable[Finding]:
+        order, directive_findings = self._collect_order(mods, locks)
+        yield from directive_findings
+        if not order:
+            return
+        pos = {key: i for i, key in enumerate(order)}
+        for k, targets in sorted(edges.items()):
+            for l, (relpath, node) in sorted(targets.items()):
+                if k in pos and l in pos and pos[l] < pos[k]:
+                    yield Finding(
+                        relpath, node.lineno, node.col_offset, self.rule,
+                        f"acquires {locks[l].display} while holding "
+                        f"{locks[k].display} — the declared lock-order "
+                        f"puts {locks[l].display} first (outermost); "
+                        "invert the nesting or fix the directive")
+
+    def _collect_order(self, mods, locks) -> tuple[list[LockKey],
+                                                   list[Finding]]:
+        """Merge every module's ``# lock-order:`` directive into one
+        order; contradictions and unknown lock names are findings."""
+        by_display = {d.display: d.key for d in locks.values()}
+        order: list[LockKey] = []
+        findings: list[Finding] = []
+        for mod in mods:
+            for line_no, text in sorted(mod.comments.items()):
+                m = _ORDER_RE.search(text)
+                if m is None:
+                    continue
+                names = [n.strip() for n in m.group("order").split(">")
+                         if n.strip()]
+                keys = []
+                for name in names:
+                    key = by_display.get(name)
+                    if key is None:
+                        findings.append(Finding(
+                            mod.relpath, line_no, 0, self.rule,
+                            f"lock-order directive names unknown lock "
+                            f"{name!r} (known: "
+                            f"{sorted(by_display)})"))
+                    else:
+                        keys.append(key)
+                # Merge: the new sequence must be consistent with the
+                # accumulated order on shared locks.
+                shared = [k for k in keys if k in order]
+                if shared != [k for k in order if k in keys]:
+                    findings.append(Finding(
+                        mod.relpath, line_no, 0, self.rule,
+                        "lock-order directive contradicts an earlier "
+                        "directive's relative order"))
+                    continue
+                merged: list[LockKey] = []
+                oi = ki = 0
+                while oi < len(order) or ki < len(keys):
+                    if oi < len(order) and order[oi] not in keys:
+                        merged.append(order[oi])
+                        oi += 1
+                    elif ki < len(keys) and keys[ki] not in order:
+                        merged.append(keys[ki])
+                        ki += 1
+                    elif oi < len(order):
+                        merged.append(order[oi])
+                        oi += 1
+                        ki += 1
+                    else:
+                        break
+                order = merged
+        return order, findings

@@ -1,0 +1,350 @@
+"""The ``nocopy-flow`` checker: interprocedural nocopy taint.
+
+The per-function ``nocopy`` rule stops at the function boundary, so a
+helper could launder a stored dict out of sight: ``def members(api):
+return api.list_nocopy("pods")`` in a non-owner module hands every
+caller a mutable view of the store and the base rule never connects the
+dots.  This rule propagates the taint through the call graph:
+
+- **Summaries** (fixpoint): a function *returns nocopy* when any return
+  value is tainted — directly from a nocopy source, from a summarized
+  callee's result, or by passing through a parameter that a caller
+  taints (identity helpers).  A function *mutates a parameter* when it
+  stores through / ``del``s / calls a mutating method on it (directly or
+  by forwarding it into another mutator).
+- **Sources**, beyond the base rule's ``list_nocopy`` / ``get_nocopy``
+  / ``fetch``: the ``copy=False`` read family (``.list(...,
+  copy=False)``, ``.list_by_meta(..., copy=False)``) — same stored-dict
+  contract, previously invisible to the linter — and any call to a
+  returns-nocopy function.
+- **Findings** (per calling function): mutation of flow-tainted values,
+  passing a tainted value into a parameter the callee mutates, storing a
+  flow-tainted value onto ``self``, and returning one outside the owner
+  modules.  Findings whose taint is visible to the base rule (a direct
+  source in the same function, excluding the ``copy=False`` family) are
+  left to it — no double report.
+
+Unresolved calls contribute no taint and no mutation — conservative by
+construction, per the project's rule that an unresolved edge may never
+crash the checker or silently widen a guarantee.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tputopo.lint.callgraph import CallGraph, FunctionInfo, graph_for
+from tputopo.lint.core import Checker, Finding, Module, subscript_root
+from tputopo.lint.nocopy import (NOCOPY_SOURCES, OWNER_MODULES,
+                                 _MUTATING_METHODS)
+
+#: Method names whose call result carries the stored-dict contract when
+#: called with ``copy=False``.
+COPYFREE_KWARG_SOURCES = frozenset({"list", "list_by_meta"})
+
+
+def _is_copyfree_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in COPYFREE_KWARG_SOURCES
+            and any(kw.arg == "copy"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False
+                    for kw in node.keywords))
+
+
+def _is_direct_source(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in NOCOPY_SOURCES)
+
+
+class _Summary:
+    __slots__ = ("returns_nocopy", "returns_params", "mutates_params")
+
+    def __init__(self) -> None:
+        self.returns_nocopy = False
+        self.returns_params: set[str] = set()   # identity passthrough
+        self.mutates_params: set[str] = set()
+
+
+class _FlowScan:
+    """One pass over a function body under the current summary map.
+    ``collect`` mode updates the function's summary; ``report`` mode
+    emits findings."""
+
+    def __init__(self, checker: "NocopyFlowChecker", graph: CallGraph,
+                 fn: FunctionInfo, summaries: dict, report: bool) -> None:
+        self.checker = checker
+        self.graph = graph
+        self.fn = fn
+        self.summaries = summaries
+        self.report = report
+        self.params = set(fn.param_names()) - {"self", "cls"}
+        # name -> "flow" (interprocedural/copy=False taint — ours) or
+        # "direct" (base rule's territory) or "param"
+        self.taint: dict[str, str] = {}
+        self.summary = summaries.setdefault(fn.key, _Summary())
+        self.findings: list[Finding] = []
+        self.changed = False
+
+    # ---- taint evaluation --------------------------------------------------
+
+    def _value_taint(self, node: ast.AST) -> str | None:
+        if _is_direct_source(node):
+            return "direct"
+        if _is_copyfree_call(node):
+            return "flow"
+        if isinstance(node, ast.Call):
+            callee = self.graph.resolve(node, self.fn)
+            if callee is not None:
+                s = self.summaries.get(callee.key)
+                if s is not None:
+                    if s.returns_nocopy:
+                        return "flow"
+                    if s.returns_params:
+                        # Identity helper: result taint follows the arg,
+                        # and the pass through a call boundary makes it
+                        # THIS rule's taint (the base rule cannot see
+                        # through the helper).
+                        for i, arg in enumerate(node.args):
+                            names = callee.param_names()
+                            if names[:1] in (["self"], ["cls"]):
+                                names = names[1:]
+                            if i < len(names) \
+                                    and names[i] in s.returns_params \
+                                    and self._value_taint(arg) in (
+                                        "flow", "direct"):
+                                return "flow"
+            return None
+        if isinstance(node, ast.Name):
+            if node.id in self.taint:
+                return self.taint[node.id]
+            if node.id in self.params:
+                return "param"
+            return None
+        if isinstance(node, ast.Subscript):
+            return self._value_taint(node.value)
+        if isinstance(node, ast.IfExp):
+            return (self._value_taint(node.body)
+                    or self._value_taint(node.orelse))
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                t = self._value_taint(v)
+                if t:
+                    return t
+            return None
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                t = self._value_taint(e)
+                if t:
+                    return t
+            return None
+        return None
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        if self.report:
+            self.findings.append(Finding(
+                self.fn.relpath, node.lineno, node.col_offset,
+                self.checker.rule,
+                f"{what} — nocopy/copy=False results are the stored "
+                "objects; copy first, go through the copying API, or "
+                "waive with a reason"))
+
+    # ---- walk --------------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        for stmt in getattr(self.fn.node, "body", []):
+            self._walk(stmt)
+        return self.findings
+
+    #: Node-type dispatch, resolved once (the getattr-per-node spelling
+    #: dominated the whole-tree scan).
+    _DISPATCH: dict[type, str] = {
+        ast.Assign: "_visit_Assign", ast.AnnAssign: "_visit_AnnAssign",
+        ast.AugAssign: "_visit_AugAssign", ast.Delete: "_visit_Delete",
+        ast.For: "_visit_For", ast.Call: "_visit_Call",
+        ast.Return: "_visit_Return",
+    }
+
+    def _walk(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return  # separate scopes, scanned as their own functions
+        name = self._DISPATCH.get(type(node))
+        if name is not None:
+            getattr(self, name)(node)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child)
+
+    def _bind(self, target: ast.AST, taint: str | None) -> None:
+        if isinstance(target, ast.Name):
+            if taint in ("flow", "direct"):
+                self.taint[target.id] = taint
+            else:
+                self.taint.pop(target.id, None)
+                self.params.discard(target.id)  # rebound, no longer param
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, taint)
+
+    def _mutation_target(self, target: ast.AST) -> None:
+        """A store through a subscript/attribute chain mutates its root."""
+        if isinstance(target, (ast.Subscript, ast.Attribute)):
+            root = subscript_root(target)
+            t = self._value_taint(root)
+            if t == "flow":
+                self._flag(target, "mutation of a copy-free result")
+            elif t == "param" and isinstance(root, ast.Name):
+                if root.id not in self.summary.mutates_params:
+                    self.summary.mutates_params.add(root.id)
+                    self.changed = True
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._mutation_target(e)
+
+    def _visit_Assign(self, node: ast.Assign) -> None:
+        taint = self._value_taint(node.value)
+        for target in node.targets:
+            self._mutation_target(target)
+            if isinstance(target, ast.Attribute) \
+                    and isinstance(target.value, ast.Name) \
+                    and target.value.id == "self" \
+                    and taint == "flow" \
+                    and not self.checker.is_owner(self.fn.relpath):
+                self._flag(node, "copy-free result stored onto self")
+            self._bind(target, taint)
+
+    def _visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is None:
+            return
+        self._mutation_target(node.target)
+        self._bind(node.target, self._value_taint(node.value))
+
+    def _visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._mutation_target(node.target)
+
+    def _visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            self._mutation_target(target)
+
+    def _visit_For(self, node: ast.For) -> None:
+        self._bind(node.target, self._value_taint(node.iter))
+
+    def _visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATING_METHODS:
+            base = node.func.value
+            t = self._value_taint(base)
+            if t == "flow":
+                self._flag(node, f"mutating call .{node.func.attr}() on a "
+                                 "copy-free result")
+            elif t == "param":
+                root = subscript_root(base)
+                if isinstance(root, ast.Name) \
+                        and root.id in self.params \
+                        and root.id not in self.summary.mutates_params:
+                    self.summary.mutates_params.add(root.id)
+                    self.changed = True
+        # Tainted argument into a parameter the callee mutates.
+        callee = self.graph.resolve(node, self.fn)
+        if callee is None:
+            return
+        s = self.summaries.get(callee.key)
+        if s is None or not s.mutates_params:
+            return
+        names = callee.param_names()
+        if names[:1] in (["self"], ["cls"]):
+            names = names[1:]
+        for i, arg in enumerate(node.args):
+            if i < len(names) and names[i] in s.mutates_params \
+                    and self._value_taint(arg) in ("flow", "direct"):
+                self._flag(node, f"nocopy result passed into "
+                                 f"{callee.qualname}(), which mutates its "
+                                 f"{names[i]!r} parameter")
+        for kw in node.keywords:
+            if kw.arg in s.mutates_params \
+                    and self._value_taint(kw.value) in ("flow", "direct"):
+                self._flag(node, f"nocopy result passed into "
+                                 f"{callee.qualname}(), which mutates its "
+                                 f"{kw.arg!r} parameter")
+
+    def _visit_Return(self, node: ast.Return) -> None:
+        if node.value is None:
+            return
+        t = self._value_taint(node.value)
+        if t in ("flow", "direct"):
+            if not self.summary.returns_nocopy:
+                self.summary.returns_nocopy = True
+                self.changed = True
+            if t == "flow" and not self.checker.is_owner(self.fn.relpath):
+                self._flag(node, "copy-free result escapes via return "
+                                 "outside the owner modules")
+        elif isinstance(node.value, ast.Name) \
+                and node.value.id in self.params:
+            if node.value.id not in self.summary.returns_params:
+                self.summary.returns_params.add(node.value.id)
+                self.changed = True
+
+
+class NocopyFlowChecker(Checker):
+    rule = "nocopy-flow"
+    description = ("interprocedural nocopy taint: helpers must not "
+                   "launder list_nocopy/get_nocopy/copy=False results "
+                   "past the owner-module boundary, and tainted values "
+                   "must not reach parameter-mutating callees")
+
+    def __init__(self, owners: frozenset[str] = OWNER_MODULES) -> None:
+        self.owners = owners
+        self._mods: list[Module] = []
+
+    def is_owner(self, relpath: str) -> bool:
+        return relpath in self.owners
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(("tputopo/", "tests/"))
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        self._mods.append(mod)
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        mods, self._mods = self._mods, []
+        graph = graph_for(mods)
+        summaries: dict = {}
+        # Package functions are always scanned (summaries must cover
+        # every cross-module flow); test modules only when they touch a
+        # nocopy surface at all — a test file that never names one can
+        # neither launder nor mutate a stored dict.
+        touchy = {m.relpath for m in mods
+                  if not m.relpath.startswith("tests/")
+                  or "nocopy" in m.source or ".fetch(" in m.source
+                  or "copy=False" in m.source}
+        fns = sorted((f for f in graph.functions.values()
+                      if f.relpath in touchy), key=lambda f: f.key)
+        # One full pass, then worklist propagation: when a function's
+        # summary changes, only its CALLERS can see different taint, so
+        # only they are rescanned (a naive fixpoint re-walked every AST
+        # per round).  Each scan reports findings; a rescan REPLACES the
+        # function's findings, so the final map equals what a fresh pass
+        # under the stable summaries would emit.
+        findings_by_fn: dict[tuple, list[Finding]] = {}
+        work: list[FunctionInfo] = []
+        for fn in fns:
+            scan = _FlowScan(self, graph, fn, summaries, report=True)
+            findings_by_fn[fn.key] = scan.run()
+            if scan.changed:
+                work.append(fn)
+        budget = 20 * len(fns)  # termination backstop, far above need
+        while work and budget > 0:
+            fn = work.pop()
+            for site in graph.callers_of(fn):
+                budget -= 1
+                scan = _FlowScan(self, graph, site.caller, summaries,
+                                 report=True)
+                findings_by_fn[site.caller.key] = scan.run()
+                if scan.changed:
+                    work.append(site.caller)
+        for fn in fns:
+            yield from findings_by_fn.get(fn.key, ())

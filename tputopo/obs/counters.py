@@ -1,0 +1,99 @@
+"""The scheduler-counter registry: every counter name, declared once.
+
+The extender's :class:`~tputopo.extender.scheduler.Metrics` counters are
+created on first increment and exported wholesale (`/metrics` iterates
+``counters.items()``), which made the counter *vocabulary* invisible: a
+typo'd increment silently forked a new series, and a counter whose last
+increment site was refactored away kept its name alive in dashboards and
+keep-lists forever.  This module is the canonical registry the
+``counter-drift`` lint rule (:mod:`tputopo.lint.counters`) round-trips
+against:
+
+- every string literal incremented through ``Metrics.inc`` /
+  ``inc_chaos`` must appear in :data:`COUNTERS` (or match a
+  :data:`COUNTER_PREFIXES` family), and every registered name must still
+  have an increment site — both directions checked at lint time;
+- dynamic (f-string) increments must carry a registered family prefix;
+- the sim report's ``SCHEDULER_COUNTER_KEEP`` (tputopo/sim/report.py)
+  and the defrag controller's ``COUNTER_KEYS`` are cross-checked the
+  same way, so a keep-list entry can never outlive its counter.
+
+Purely declarative — nothing imports this at runtime except tooling; the
+lint rule reads the literals from this module's own AST (the same
+no-second-copy trick the single-def rule uses).
+"""
+
+from __future__ import annotations
+
+#: Every exact counter name incremented via ``Metrics.inc`` /
+#: ``inc_chaos`` anywhere in the package.  Grouped by subsystem; keep
+#: sorted within each group — the lint rule flags unregistered
+#: increments AND dead registrations.
+COUNTERS = (
+    # HTTP server (extender/server.py)
+    "api_errors",
+    "bad_requests",
+    "http_client_errors",
+    "http_internal_errors",
+    # sort / state maintenance (extender/scheduler.py)
+    "score_memo_carried",
+    "score_memo_hits",
+    "sort_requests",
+    "state_cache_hits",
+    "state_delta_applied",
+    "state_delta_fallbacks",
+    "state_from_informer",
+    "state_full_rebuilds",
+    # gang planning
+    "gang_assumptions_released",
+    "gang_candidate_memo_hits",
+    "gang_ctx_memo_hits",
+    "gang_multislice_compositions_considered",
+    "gang_multislice_plans",
+    "gang_plan_reuse_hits",
+    # bind verb
+    "bind_ambiguous_recovered",
+    "bind_conflicts",
+    "bind_errors",
+    "bind_gang_already_bound",
+    "bind_gang_infeasible",
+    "bind_gang_wrong_node",
+    "bind_idempotent_replays",
+    "bind_observe_errors",
+    "bind_requests",
+    "bind_state_delta",
+    "bind_success",
+    "bind_unavailable",
+    "bind_write_through_repaired",
+    # release / crash recovery
+    "crash_gangs_completed",
+    "crash_gangs_released",
+    "crash_recoveries",
+    "release_conflict_resolved",
+    "release_unavailable",
+    # retry attribution (k8s/retry.py count_retries)
+    "retry_api_timeout",
+    "retry_api_unavailable",
+    # assumption GC (extender/gc.py)
+    "gc_assumptions_released",
+    "gc_release_errors",
+    "gc_sweeps",
+)
+
+#: Dynamic counter families: an f-string increment's literal prefix must
+#: start with one of these.  ``state_delta_fallback_<reason>`` carries
+#: the fallback attribution split; ``defrag_<key>`` mirrors the defrag
+#: controller's deterministic counters into Prometheus.
+COUNTER_PREFIXES = (
+    "defrag_",
+    "state_delta_fallback_",
+)
+
+#: Defrag-controller counter keys that appear lazily (fault paths only)
+#: and are therefore NOT in ``DefragController.COUNTER_KEYS`` — the
+#: pre-zeroed report vocabulary must not grow for them (fault-free
+#: report bytes are pinned), but they are still registered counters.
+DEFRAG_LAZY_COUNTERS = (
+    "evict_errors",
+    "verify_replans",
+)
