@@ -491,6 +491,30 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0) -> dict:
             "scheduled": p["jobs"]["scheduled"],
             "ghost_reclaimed": p["jobs"]["ghost_reclaimed"],
         }
+    # Mixed serving+training scenario (tputopo.priority): one preempt-on
+    # replay of the mixed trace class, recording per-tier SLO attainment
+    # and the preemption counters next to the standing events_per_s
+    # figure — the "millions of users" axis future priority/fairness PRs
+    # diff against.
+    mixed = run_trace(
+        TraceConfig(seed=seed, nodes=nodes, arrivals=arrivals,
+                    workload="mixed"),
+        ["ici"], flight_trace=False, preempt={})
+    mp = mixed["policies"]["ici"]
+    out["mixed"] = {
+        "events_per_s": mixed["throughput"]["events_per_s"],
+        "preempt": mp["preempt"],
+        "tiers": {
+            tname: {
+                "queue_wait_p95_s": rec["queue_wait_s"]["p95"],
+                "slo_attainment": rec.get("slo", {}).get("attainment"),
+                "jobs_preempted": rec["preemption_disruption"]
+                                     ["jobs_preempted"],
+                "lost_virtual_s": rec["preemption_disruption"]
+                                     ["lost_virtual_s"],
+            } for tname, rec in mp["tiers"].items()
+        },
+    }
     return out
 
 

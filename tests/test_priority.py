@@ -1,0 +1,573 @@
+"""tputopo.priority: tier parsing/validation, the tpu.dev/priority meta
+index (fake API + informer mirror, mirroring the gang-id index tests),
+admission ordering, the planner's priority victim filter, the backfill
+gate, the /debug/preempt dry-run surface, and the sim-integrated
+preemption -> requeue -> re-place chain (deterministic, byte-stable,
+--jobs 2 included)."""
+
+import json
+
+import pytest
+
+from tests.cluster import build_cluster
+from tputopo.defrag.planner import plan_migration
+from tputopo.extender.scheduler import ExtenderScheduler
+from tputopo.extender.state import ClusterState
+from tputopo.k8s import objects as ko
+from tputopo.k8s.fakeapi import FakeApiServer
+from tputopo.k8s.informer import Informer
+from tputopo.priority import (admission_order, backfill_ok, plan_preemption,
+                              victim_priorities)
+from tputopo.sim.engine import SimEngine, finalize_run_state, run_trace
+from tputopo.sim.report import SCHEMA, SCHEMA_PRIORITY
+from tputopo.sim.trace import JobSpec, Trace, TraceConfig, generate_trace
+
+CLOCK = lambda: 1000.0  # noqa: E731 — staged occupancy stamps this time
+
+PRIO_KEY = ko.LABEL_PRIORITY
+
+
+def occupy(api, name, node, chips, gang=None, priority=None):
+    """Stage one bound pod holding ``chips`` on ``node`` (the extender's
+    annotation handshake), optionally tier-labeled."""
+    labels = {}
+    if gang is not None:
+        labels["tpu.dev/gang-id"] = gang[0]
+        labels["tpu.dev/gang-size"] = str(gang[1])
+    if priority is not None:
+        labels[PRIO_KEY] = str(priority)
+    api.create("pods", ko.make_pod(name, chips=len(chips), labels=labels))
+    anns = {
+        ko.ANN_GROUP: ko.coords_to_ann(chips),
+        ko.ANN_ASSUME_TIME: "1000.0",
+        ko.ANN_ASSIGNED: "true",
+    }
+    if gang is not None:
+        anns[ko.ANN_GANG_ID] = gang[0]
+    api.patch_annotations("pods", name, anns, "default")
+    api.bind_pod(name, node, "default")
+
+
+def synced_state(api):
+    return ClusterState(api, clock=CLOCK).sync()
+
+
+@pytest.fixture()
+def cluster():
+    """One v5p:2x2x4 domain over 4 hosts (4 chips per host)."""
+    api, _ = build_cluster()
+    state = synced_state(api)
+    dom = next(iter(state.domains.values()))
+    nodes = [dom.node_by_host[h] for h in sorted(dom.node_by_host)]
+    chips = {n: list(dom.chips_by_node[n]) for n in nodes}
+    return api, nodes, chips
+
+
+# ---- tier model (k8s/objects.py) --------------------------------------------
+
+
+def test_parse_priority_names_ints_and_rejects():
+    assert ko.parse_priority(None) == 0
+    assert ko.parse_priority("serving") == 100
+    assert ko.parse_priority("prod") == 50
+    assert ko.parse_priority("batch") == 0
+    assert ko.parse_priority("75") == 75
+    assert ko.parse_priority(100) == 100
+    for bad in ("platinum", "-1", "1001", "1e3", ""):
+        with pytest.raises(ValueError):
+            ko.parse_priority(bad)
+
+
+def test_pod_priority_merged_meta_and_lenient():
+    pod = ko.make_pod("p", annotations={PRIO_KEY: "serving"})
+    assert ko.pod_priority(pod) == 100
+    # Labels shadow annotations (the gang-reader precedence).
+    pod = ko.make_pod("p", labels={PRIO_KEY: "50"},
+                      annotations={PRIO_KEY: "serving"})
+    assert ko.pod_priority(pod) == 50
+    # A malformed STORED value degrades to batch instead of wedging reads.
+    assert ko.pod_priority(ko.make_pod("p", labels={PRIO_KEY: "junk"})) == 0
+    assert ko.pod_priority(ko.make_pod("p")) == 0
+
+
+def test_tier_names():
+    assert ko.tier_name(100) == "serving"
+    assert ko.tier_name(50) == "prod"
+    assert ko.tier_name(0) == "batch"
+    assert ko.tier_name(75) == "tier-75"
+
+
+# ---- tpu.dev/priority meta index (mirrors the gang-id index tests) ----------
+
+
+def _filtered_by_prio(api, value):
+    return api.list("pods", lambda p: (
+        {**p["metadata"].get("annotations", {}),
+         **p["metadata"].get("labels", {})}).get(PRIO_KEY) == value)
+
+
+def test_priority_meta_index_tracks_create_patch_delete_recreate():
+    api = FakeApiServer()
+    names = lambda objs: [o["metadata"]["name"] for o in objs]  # noqa: E731
+    api.create("pods", ko.make_pod("s-0", labels={PRIO_KEY: "100"}))
+    api.create("pods", ko.make_pod("s-1", labels={PRIO_KEY: "100"}))
+    api.create("pods", ko.make_pod("b-0"))  # unlabeled: not in any bucket
+    assert names(api.list_by_meta("pods", PRIO_KEY, "100")) == \
+        names(_filtered_by_prio(api, "100")) == ["s-0", "s-1"]
+    # Annotation-set priority joins the index too.
+    api.patch_annotations("pods", "b-0", {PRIO_KEY: "100"}, "default")
+    assert names(api.list_by_meta("pods", PRIO_KEY, "100")) == \
+        ["b-0", "s-0", "s-1"]
+    # A label patch MOVES the pod between tier buckets.
+    api.patch_labels("pods", "s-1", {PRIO_KEY: "50"}, "default")
+    assert names(api.list_by_meta("pods", PRIO_KEY, "100")) == ["b-0", "s-0"]
+    assert names(api.list_by_meta("pods", PRIO_KEY, "50")) == ["s-1"]
+    # Labels shadow annotations (merged-meta precedence).
+    api.patch_labels("pods", "b-0", {PRIO_KEY: "0"}, "default")
+    assert names(api.list_by_meta("pods", PRIO_KEY, "100")) == ["s-0"]
+    # Delete/recreate cycles stay exact.
+    api.delete("pods", "s-0", "default")
+    assert api.list_by_meta("pods", PRIO_KEY, "100") == []
+    api.create("pods", ko.make_pod("s-0", labels={PRIO_KEY: "100"}))
+    assert names(api.list_by_meta("pods", PRIO_KEY, "100")) == ["s-0"]
+    # Aliases share one bucket: a NAMED tier label lands in the integer
+    # bucket, and lookups by either spelling answer identically.
+    api.create("pods", ko.make_pod("s-named", labels={PRIO_KEY: "serving"}))
+    assert names(api.list_by_meta("pods", PRIO_KEY, "100")) == \
+        names(api.list_by_meta("pods", PRIO_KEY, "serving")) == \
+        ["s-0", "s-named"]
+    # A malformed priority indexes nowhere (lenient reads call it batch,
+    # and unlabeled batch pods are not bucketed either).
+    api.create("pods", ko.make_pod("junk", labels={PRIO_KEY: "platinum"}))
+    assert api.list_by_meta("pods", PRIO_KEY, "platinum") == []
+
+
+def test_priority_index_in_informer_mirror():
+    import time
+
+    def wait_until(cond, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            time.sleep(0.01)
+        return cond()
+
+    api = FakeApiServer()
+    api.create("nodes", ko.make_node("n1", chips=4))
+    inf = Informer(api, watch_timeout_s=0.5).start()
+    try:
+        assert inf.wait_synced(10)
+        api.create("pods", ko.make_pod("s-0", labels={PRIO_KEY: "100"}))
+        api.create("pods", ko.make_pod("s-1", labels={PRIO_KEY: "100"}))
+        assert wait_until(lambda: len(
+            inf.list_by_meta("pods", PRIO_KEY, "100")) == 2)
+        api.patch_labels("pods", "s-1", {PRIO_KEY: "0"}, "default")
+        assert wait_until(lambda: len(
+            inf.list_by_meta("pods", PRIO_KEY, "100")) == 1)
+        api.delete("pods", "s-0", "default")
+        assert wait_until(
+            lambda: inf.list_by_meta("pods", PRIO_KEY, "100") == [])
+    finally:
+        inf.stop()
+
+
+# ---- admission order + backfill gate ----------------------------------------
+
+
+def test_admission_order_tier_then_fifo():
+    pods = [ko.make_pod("b-early"),
+            ko.make_pod("s-late", labels={PRIO_KEY: "serving"}),
+            ko.make_pod("p-mid", labels={PRIO_KEY: "50"}),
+            ko.make_pod("s-early", labels={PRIO_KEY: "100"})]
+    # Creation order via resourceVersion, like the API server stamps.
+    for rv, p in enumerate(pods):
+        p["metadata"]["resourceVersion"] = str(rv + 1)
+    got = [p["metadata"]["name"] for p in admission_order(pods)]
+    assert got == ["s-late", "s-early", "p-mid", "b-early"][0:1] + \
+        got[1:]  # serving first
+    assert got == ["s-late", "s-early", "p-mid", "b-early"]
+    # The scheduler exposes the same rule (one definition).
+    assert [p["metadata"]["name"]
+            for p in ExtenderScheduler.admission_order(pods)] == got
+    # Unlabeled-only input: pure FIFO — the pre-priority order.
+    plain = [ko.make_pod(f"p{i}") for i in range(3)]
+    for rv, p in enumerate(plain):
+        p["metadata"]["resourceVersion"] = str(rv + 1)
+    assert [p["metadata"]["name"] for p in admission_order(plain)] == \
+        ["p0", "p1", "p2"]
+
+
+def test_backfill_rule():
+    # Equal/higher tiers always pass (they never delay the blocked tier).
+    assert backfill_ok(100, 1e9, 100, 180.0)
+    assert backfill_ok(50, 1e9, 50, 180.0)
+    # Lower tiers pass only when short.
+    assert backfill_ok(0, 120.0, 100, 180.0)
+    assert not backfill_ok(0, 600.0, 100, 180.0)
+
+
+# ---- preemption planner: the priority victim filter -------------------------
+
+
+def test_victim_priorities_gang_takes_max():
+    # Gang identity reads the ANN_GANG_ID *annotation* bind stamps —
+    # the exact field the planner's victim index keys by.
+    pods = [ko.make_pod("g-0", annotations={ko.ANN_GANG_ID: "g"},
+                        labels={"tpu.dev/gang-id": "g",
+                                "tpu.dev/gang-size": "2"}),
+            ko.make_pod("g-1", annotations={ko.ANN_GANG_ID: "g"},
+                        labels={"tpu.dev/gang-id": "g",
+                                "tpu.dev/gang-size": "2",
+                                PRIO_KEY: "100"}),
+            ko.make_pod("lone", labels={PRIO_KEY: "50"})]
+    prio = victim_priorities(pods)
+    # One serving member protects the whole (atomic) gang.
+    assert prio == {"default/g": 100, "default/lone": 50}
+
+
+def test_preempt_only_strictly_lower_tiers(cluster):
+    api, nodes, chips = cluster
+    # Checkerboard: host 0 holds a SERVING quad, host 2 a batch quad;
+    # hosts 1/3 free but not adjacent — a (2,4) gang is blocked.
+    occupy(api, "serve-0", nodes[0], chips[nodes[0]], priority=100)
+    occupy(api, "batch-0", nodes[2], chips[nodes[2]])
+    state = synced_state(api)
+    pods = api.list("pods")
+    # A prod (50) demand may evict ONLY the batch quad.
+    plan = plan_preemption(state, (2, 4), 50, pods)
+    assert plan is not None
+    assert [v.key for v in plan.victims] == ["default/batch-0"]
+    assert plan.chips_moved == 4
+    # A serving-tier victim universe protects everything equal or above:
+    # a prod demand facing two serving quads gets no plan.
+    api2, _ = build_cluster()
+    occupy(api2, "serve-a", nodes[0], chips[nodes[0]], priority=100)
+    occupy(api2, "serve-b", nodes[2], chips[nodes[2]], priority=100)
+    assert plan_preemption(synced_state(api2), (2, 4), 50,
+                           api2.list("pods")) is None
+
+
+def test_preempt_equal_tier_protected(cluster):
+    api, nodes, chips = cluster
+    occupy(api, "serve-a", nodes[0], chips[nodes[0]], priority=100)
+    occupy(api, "serve-b", nodes[2], chips[nodes[2]], priority=100)
+    state = synced_state(api)
+    assert plan_preemption(state, (2, 4), 100, api.list("pods")) is None
+
+
+def test_preempt_bottom_tier_never_preempts(cluster):
+    api, nodes, chips = cluster
+    occupy(api, "batch-0", nodes[0], chips[nodes[0]])
+    occupy(api, "batch-1", nodes[2], chips[nodes[2]])
+    state = synced_state(api)
+    assert plan_preemption(state, (2, 4), 0, api.list("pods")) is None
+
+
+def test_preempt_keeps_net_gain_rule(cluster):
+    api, nodes, chips = cluster
+    # Full cluster of batch quads: any 2-host box frees 8 chips by
+    # moving 8 — the net-gain rule refuses, whatever the tier gap.
+    for i, n in enumerate(nodes):
+        occupy(api, f"batch-{i}", n, chips[n])
+    state = synced_state(api)
+    assert plan_preemption(state, (2, 4), 100, api.list("pods"),
+                           max_moves=4, max_chips_moved=64) is None
+    # And a 1-chip serving demand can never preempt at all (volume 1).
+    assert plan_preemption(state, (1, 1), 100, api.list("pods")) is None
+
+
+def test_preempt_does_not_require_free_capacity(cluster):
+    api, nodes, chips = cluster
+    # Every host holds a 3-chip batch solo: 4 free chips total — the
+    # DEFRAG planner (compaction) refuses a (2,4) demand outright
+    # (free 4 < volume 8), but preemption frees capacity by evicting:
+    # two solos (6 chips < 8 volume) clear an adjacent host pair.
+    for i, n in enumerate(nodes):
+        occupy(api, f"solo-{i}", n, chips[n][:3])
+    state = synced_state(api)
+    assert plan_migration(state, [(2, 4)], max_moves=2,
+                          max_chips_moved=64) is None
+    plan = plan_preemption(state, (2, 4), 100, api.list("pods"),
+                           max_moves=2, max_chips_moved=64)
+    assert plan is not None
+    assert len(plan.victims) == 2 and plan.chips_moved == 6
+
+
+# ---- /debug/preempt dry-run surface -----------------------------------------
+
+
+def test_debug_preempt_endpoint():
+    import urllib.error
+    import urllib.request
+
+    from tputopo.extender import (ExtenderConfig, ExtenderHTTPServer,
+                                  ExtenderScheduler)
+
+    api, _ = build_cluster()
+    config = ExtenderConfig()
+    sched = ExtenderScheduler(api, config, clock=CLOCK)
+    srv = ExtenderHTTPServer(sched, config, port=0).start()
+    try:
+        host, port = srv.address
+
+        def get(path):
+            with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                        timeout=5) as resp:
+                return resp.status, json.loads(resp.read())
+
+        # Empty cluster: the demand places, no plan needed.
+        status, out = get("/debug/preempt?replicas=2&chips=4&priority=100")
+        assert status == 200
+        assert out["dry_run"] is True and out["plan"] is None
+        assert out["demand"] == {"replicas": 2, "chips_per_member": 4,
+                                 "priority": 100}
+
+        # Checkerboard batch occupancy: the serving-tier plan appears
+        # (named tiers accepted), and serving the plan evicts nothing.
+        state = synced_state(api)
+        dom = next(iter(state.domains.values()))
+        nodes = [dom.node_by_host[h] for h in sorted(dom.node_by_host)]
+        occupy(api, "batch-a", nodes[0], list(dom.chips_by_node[nodes[0]]))
+        occupy(api, "batch-c", nodes[2], list(dom.chips_by_node[nodes[2]]))
+        status, out = get("/debug/preempt?replicas=2&chips=4"
+                          "&priority=serving")
+        assert status == 200
+        assert out["plan"] is not None
+        assert out["plan"]["jobs_evicted"] == 1
+        assert out["plan"]["chips_moved"] == 4
+        assert api.get("pods", "batch-a", "default")["spec"]["nodeName"]
+        assert api.get("pods", "batch-c", "default")["spec"]["nodeName"]
+        assert sched.metrics.counters["preempt_plans_found"] == 1
+        assert sched.metrics.counters["preempt_plans_considered"] == 2
+
+        # Batch demand can never preempt; malformed tiers are 400s.
+        status, out = get("/debug/preempt?replicas=2&chips=4&priority=batch")
+        assert status == 200 and out["plan"] is None
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/debug/preempt?replicas=2&chips=4&priority=platinum")
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
+
+
+def test_debug_pending_admission_order():
+    import urllib.request
+
+    from tputopo.extender import (ExtenderConfig, ExtenderHTTPServer,
+                                  ExtenderScheduler)
+
+    api, _ = build_cluster()
+    config = ExtenderConfig()
+    sched = ExtenderScheduler(api, config, clock=CLOCK)
+    srv = ExtenderHTTPServer(sched, config, port=0).start()
+    try:
+        host, port = srv.address
+        api.create("pods", ko.make_pod("b-early", chips=1))
+        api.create("pods", ko.make_pod("s-late", chips=1,
+                                       labels={PRIO_KEY: "serving"}))
+        api.create("pods", ko.make_pod("p-mid", chips=1,
+                                       labels={PRIO_KEY: "50"}))
+        # A BOUND pod never shows as pending.
+        api.create("pods", ko.make_pod("bound", chips=1))
+        state = synced_state(api)
+        node = next(iter(state._dom_by_node))
+        api.bind_pod("bound", node, "default")
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/debug/pending", timeout=5) as resp:
+            out = json.loads(resp.read())
+        assert [p["pod"] for p in out["pending"]] == \
+            ["default/s-late", "default/p-mid", "default/b-early"]
+        assert out["pending"][0]["tier"] == "serving"
+        assert out["pending"][2] == {"pod": "default/b-early",
+                                     "priority": 0, "tier": "batch"}
+    finally:
+        srv.stop()
+
+
+# ---- sim integration: preempt -> requeue -> re-place chain ------------------
+
+
+def _blocked_serving_trace() -> Trace:
+    """Four batch quads fill the 4-host domain; the two short ones
+    complete leaving a checkerboard (no adjacent free host pair), then a
+    serving-tier 2x4 gang arrives — placeable only by evicting one
+    long batch quad."""
+    cfg = TraceConfig(seed=0, nodes=4, spec="v5p:2x2x4", arrivals=5,
+                      node_failures=0, ghost_prob=0.0)
+    jobs = (
+        JobSpec("job-00000", 0.0, 4, 1, 5000.0),
+        JobSpec("job-00001", 1.0, 4, 1, 40.0),
+        JobSpec("job-00002", 2.0, 4, 1, 5000.0),
+        JobSpec("job-00003", 3.0, 4, 1, 40.0),
+        JobSpec("job-00004", 60.0, 4, 2, 500.0,
+                priority=100, slo_wait_s=60.0),
+    )
+    return Trace(config=cfg, jobs=jobs)
+
+
+def _run_preempt_chain():
+    engine = SimEngine(_blocked_serving_trace(), "ici",
+                       preempt={"max_moves": 1})
+    engine.run_events()
+    rs = engine.run_state()
+    report = finalize_run_state(rs, rs.horizon_s)
+    return engine, rs, report
+
+
+def test_preempt_chain_evict_requeue_replace():
+    """Satellite: the deterministic end-to-end chain — a blocked
+    serving gang evicts the cheapest batch victim, lands in the freed
+    host pair, the victim re-places, and report + decision log are
+    byte-stable across two runs."""
+    engine, rs, report = _run_preempt_chain()
+    p = report["preempt"]
+    assert p["plans_executed"] == 1
+    assert p["jobs_preempted"] == 1 and p["chips_freed"] == 4
+    assert p["place_failed_after_preempt"] == 0
+
+    # The per-tier block tells the story: serving met its SLO (wait 0 —
+    # preemption fired in the arrival wake), batch absorbed the
+    # disruption (one quad, 4 chips, ~59 virtual s of lost work).
+    tiers = report["tiers"]
+    assert tiers["serving"]["slo"] == {
+        "target_s": 60.0, "met": 1, "missed": 0, "attainment": 1.0}
+    d = tiers["batch"]["preemption_disruption"]
+    assert d["jobs_preempted"] == 1 and d["chips_moved"] == 4
+    assert 50.0 < d["lost_virtual_s"] < 65.0
+
+    # The decision log carries the preempt record and both placements.
+    pre = [e for e in rs.decision_log if "preempt" in e]
+    assert len(pre) == 1
+    assert pre[0]["job"] == "job-00004"
+    assert pre[0]["preempt"]["chips_freed"] == 4
+    # Victim key is "namespace/pod-name" for a lone quad; the job name
+    # drops the member suffix.
+    victim_job = pre[0]["preempt"]["victims"][0].split("/", 1)[1] \
+        .rsplit("-", 1)[0]
+    victim_entries = [e for e in rs.decision_log
+                      if e["job"] == victim_job and e["members"]]
+    assert len(victim_entries) == 2  # placed, evicted, re-placed
+
+    # The gang landed on the victim's freed host plus its free neighbor.
+    gang = [e for e in rs.decision_log
+            if e["job"] == "job-00004" and e["members"]]
+    assert len(gang) == 1
+    gang_nodes = {m["node"] for m in gang[0]["members"]}
+    victim_first_node = victim_entries[0]["members"][0]["node"]
+    assert victim_first_node in gang_nodes
+    # And the victim's re-placement moved it off that host.
+    assert victim_entries[1]["members"][0]["node"] != victim_first_node
+
+    # Everything completed; ledger cross-check held; no lost jobs.
+    assert report["jobs"]["unplaced_at_end"] == 0
+    assert engine.placed_chips == len(engine.ledger)
+    j = report["jobs"]
+    assert j["arrived"] == j["completed"] + j["ghost_reclaimed"] \
+        + j["unplaced_at_end"]
+
+    # Byte-stable: an identical second run reproduces report AND
+    # decision log exactly.
+    engine2, rs2, report2 = _run_preempt_chain()
+    assert json.dumps(report, sort_keys=True) == \
+        json.dumps(report2, sort_keys=True)
+    assert json.dumps(rs.decision_log, sort_keys=True) == \
+        json.dumps(rs2.decision_log, sort_keys=True)
+
+    # The preempt trace was recorded with its phases.
+    assert any(k.startswith("preempt") for k in report["phases"])
+
+
+def test_backfill_gate_holds_long_low_tier_jobs():
+    """While a serving gang is blocked (and unpreemptable — the chip
+    budget is zeroed), a SHORT batch job may backfill but a LONG one is
+    held; everything still places in the end (no stranded feasible
+    jobs)."""
+    cfg = TraceConfig(seed=0, nodes=4, spec="v5p:2x2x4", arrivals=7,
+                      node_failures=0, ghost_prob=0.0)
+    jobs = (
+        # Full cluster of batch quads; the serving gang below needs two
+        # ADJACENT free hosts, which only ever free up organically.
+        JobSpec("job-00000", 0.0, 4, 1, 35.0),
+        JobSpec("job-00001", 1.0, 4, 1, 200.0),
+        JobSpec("job-00002", 2.0, 4, 1, 300.0),
+        JobSpec("job-00003", 3.0, 4, 1, 400.0),
+        JobSpec("job-00004", 10.0, 4, 2, 1000.0,
+                priority=100, slo_wait_s=60.0),
+        # Short batch (30 <= 180): may backfill the t=35 hole while the
+        # serving gang is blocked.  Long batch (1000 > 180): held.
+        JobSpec("job-00005", 20.0, 4, 1, 30.0),
+        JobSpec("job-00006", 22.0, 4, 1, 1000.0),
+    )
+    engine = SimEngine(Trace(config=cfg, jobs=jobs), "ici",
+                       preempt={"max_moves": 1, "max_chips_moved": 0})
+    engine.run_events()
+    rs = engine.run_state()
+    report = finalize_run_state(rs, rs.horizon_s)
+    p = report["preempt"]
+    assert p["plans_executed"] == 0  # zeroed budget blocked every plan
+    assert p["plans_considered"] >= 1 and p["no_plan"] >= 1
+    assert p["backfill_admitted"] >= 1
+    assert p["backfill_held"] >= 1
+    # The short filler ran in the t=35 hole, BEFORE both the serving
+    # gang (needs an adjacent pair) and the held long batch job.
+    short = [e for e in rs.decision_log if e["job"] == "job-00005"]
+    long_ = [e for e in rs.decision_log if e["job"] == "job-00006"]
+    gang = [e for e in rs.decision_log
+            if e["job"] == "job-00004" and e["members"]]
+    assert short and long_ and gang
+    assert short[0]["t"] < gang[0]["t"] < long_[0]["t"]
+    assert report["jobs"]["unplaced_at_end"] == 0
+
+
+def test_run_trace_priority_schema_and_determinism():
+    """Mixed workload => schema v5 + per-tier block (preempt off and
+    on); standard stays v2 with no priority keys; --jobs 2 replays are
+    byte-identical to sequential ones."""
+    std = run_trace(TraceConfig(seed=0, nodes=8, spec="v5p:2x2x4",
+                                arrivals=20, node_failures=0), ["ici"])
+    assert std["schema"] == SCHEMA
+    assert "tiers" not in std["policies"]["ici"]
+    assert "preempt" not in std["policies"]["ici"]
+
+    cfg = TraceConfig(seed=0, nodes=8, spec="v5p:2x2x4", arrivals=40,
+                      node_failures=0, workload="mixed")
+    off = run_trace(cfg, ["ici"])
+    assert off["schema"] == SCHEMA_PRIORITY
+    assert "tiers" in off["policies"]["ici"]
+    assert "preempt" not in off["policies"]["ici"]
+    assert "serving" in off["policies"]["ici"]["tiers"]
+    assert cfg.describe()["workload"] == "mixed"
+
+    on_seq = run_trace(cfg, ["ici", "naive"], preempt={})
+    on_par = run_trace(cfg, ["ici", "naive"], preempt={}, jobs=2)
+    assert on_seq["schema"] == SCHEMA_PRIORITY
+    assert on_seq["engine"]["preempt"]["max_moves"] == 1
+    assert "preempt" in on_seq["policies"]["ici"]
+
+    def canon(r):
+        r = dict(r)
+        r.pop("throughput", None)
+        r.pop("phase_wall", None)
+        return json.dumps(r, sort_keys=True)
+
+    assert canon(on_seq) == canon(on_par)
+
+
+def test_mixed_trace_deterministic_and_tiered():
+    cfg = TraceConfig(seed=1, nodes=8, spec="v5p:2x2x4", arrivals=50,
+                      workload="mixed")
+    a, b = generate_trace(cfg), generate_trace(cfg)
+    assert a.jobs == b.jobs and a.node_events == b.node_events
+    prios = {j.priority for j in a.jobs}
+    assert 100 in prios and 0 in prios  # serving + batch present
+    serving = [j for j in a.jobs if j.priority == 100]
+    assert all(j.slo_wait_s == cfg.slo_wait_s for j in serving)
+    assert any(j.replicas > 1 for j in serving)  # serving gangs exist
+    assert all(j.slo_wait_s == 0.0 for j in a.jobs if j.priority < 100)
+    # Standard traces carry no tiers and drop the mixed knobs from
+    # describe() — the pre-priority report bytes are pinned elsewhere.
+    std = generate_trace(TraceConfig(seed=1, nodes=8, spec="v5p:2x2x4",
+                                     arrivals=20))
+    assert all(j.priority == 0 and j.slo_wait_s == 0.0 for j in std.jobs)
+    assert "workload" not in std.config.describe()

@@ -327,7 +327,9 @@ def pressure_report(state: ClusterState, demands: list[tuple[int, int]],
 def plan_migration(state: ClusterState, demands: list[tuple[int, int]], *,
                    max_moves: int = 2, max_chips_moved: int = 64,
                    pressured_out: list | None = None,
-                   placeable_out: dict | None = None) -> MigrationPlan | None:
+                   placeable_out: dict | None = None,
+                   evictable=None,
+                   require_free_capacity: bool = True) -> MigrationPlan | None:
     """The cheapest within-budget migration plan serving the largest
     pressured demand, or None (the do-nothing fallback).
 
@@ -349,7 +351,18 @@ def plan_migration(state: ClusterState, demands: list[tuple[int, int]], *,
     domain) — whether or not a plan fit the budget, so the caller never
     re-runs this scan just to classify a None return.  ``placeable_out``
     likewise receives each demand's placeable-anywhere verdict (what
-    :func:`pressure_report` consumes instead of rescanning)."""
+    :func:`pressure_report` consumes instead of rescanning).
+
+    ``evictable`` (a predicate over the victim key, "namespace/gang-id"
+    or "namespace/pod-name") restricts the victim universe: units
+    failing it count as IMMOVABLE occupancy, so no box touching them is
+    ever proposed — the priority planner (tputopo.priority) passes the
+    strictly-lower-tier filter here and inherits every other rule
+    (gang atomicity, net gain, budgets, ranking) unchanged.
+    ``require_free_capacity=False`` drops the per-domain
+    free-chips >= volume gate: defragmentation compacts (the chips must
+    already exist free somewhere), preemption *frees* by evicting — the
+    capacity comes from the victims themselves."""
     victims = None  # built lazily — pressure usually absent
     for demand in demands:
         doms = [state.domains[sid] for sid in sorted(state.domains)]
@@ -371,13 +384,15 @@ def plan_migration(state: ClusterState, demands: list[tuple[int, int]], *,
         for dom in candidates:
             volume, mode = needs[dom.slice_id]
             alloc = dom.allocator
-            if alloc.free_count < volume:
+            if require_free_capacity and alloc.free_count < volume:
                 continue  # compaction could not fit it either
             if victims is None:
                 victims = _victim_index(state)
             by_chip: dict[int, _VictimRec] = {}
             movable = 0
             for rec in victims.values():
+                if evictable is not None and not evictable(rec.key):
+                    continue  # protected tier — counts as immovable below
                 m = rec.masks.get(dom.slice_id, 0)
                 movable |= m
                 while m:

@@ -81,6 +81,13 @@ class ExtenderConfig:
     defrag_cooldown_s: float = 300.0     # min seconds between executed plans
     defrag_hysteresis: int = 2           # consecutive pressured cycles first
     defrag_max_concurrent: int = 1       # in-flight migrations cap
+    # Targeted preemption (tputopo.priority): budget for the dry-run
+    # plans served at GET /debug/preempt — a pending high-tier demand may
+    # evict at most this many strictly-lower-tier jobs / chips.  The
+    # net-gain rule (never disturb >= the volume restored) binds on top
+    # of both, whatever these allow.
+    preempt_max_moves: int = 1
+    preempt_max_chips_moved: int = 64
     # Per-generation LinkCostModel field overrides, e.g.
     # {"v5p": {"ici_link_gbps": 95.0, "dcn_host_gbps": 42.0}} — the explicit,
     # measured replacement for the reference's TODO weight table.

@@ -1251,6 +1251,44 @@ class ExtenderScheduler:
                 self._cached_state = None
         return released
 
+    # ---- priority (tputopo.priority) ---------------------------------------
+
+    @staticmethod
+    def admission_order(pods: list[dict]) -> list[dict]:
+        """Pending pods in tier-aware admission order: high-priority
+        gangs sort before lower tiers, FIFO within a tier
+        (tputopo.priority.tiers — served at ``GET /debug/pending``; the
+        sim's scheduling wake applies the same tier-then-FIFO rule at
+        the job level)."""
+        # Lazy import: tputopo.priority.preempt imports this module.
+        from tputopo.priority.tiers import admission_order as _order
+
+        return _order(pods)
+
+    def plan_preempt(self, replicas: int, k: int,
+                     priority: int):
+        """Dry-run targeted-preemption plan for a pending
+        ``replicas x k``-chip demand at ``priority``: the cheapest
+        strictly-lower-tier eviction set that would let it place, or
+        None (served by ``GET /debug/preempt``; executing the evictions
+        is the job controller's call, exactly like /debug/defrag)."""
+        from tputopo.defrag.planner import list_pods_nocopy
+        from tputopo.priority.preempt import plan_preemption
+
+        self.metrics.inc("preempt_plans_considered")
+        informer_reader = (self.informer if self.informer is not None
+                           and self.informer.synced else None)
+        state = self._state(allow_cache=True, reader=informer_reader)
+        pods = list_pods_nocopy(informer_reader if informer_reader
+                                is not None else self.api)
+        plan = plan_preemption(
+            state, (replicas, k), priority, pods,
+            max_moves=self.config.preempt_max_moves,
+            max_chips_moved=self.config.preempt_max_chips_moved)
+        if plan is not None:
+            self.metrics.inc("preempt_plans_found")
+        return plan
+
     # ---- crash recovery ----------------------------------------------------
 
     def recover(self) -> dict:

@@ -137,6 +137,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._handle_traces(url.query)
             elif url.path == "/debug/defrag":
                 self._handle_defrag(url.query)
+            elif url.path == "/debug/preempt":
+                self._handle_preempt(url.query)
+            elif url.path == "/debug/pending":
+                self._handle_pending()
             elif url.path == "/policy":
                 self._send_json(200, self.config.policy_json())
             else:
@@ -247,6 +251,58 @@ class _Handler(BaseHTTPRequestHandler):
                        "cooldown_s": cfg.defrag_cooldown_s,
                        "hysteresis": cfg.defrag_hysteresis,
                        "max_concurrent": cfg.defrag_max_concurrent},
+        })
+
+    def _handle_pending(self) -> None:
+        """GET /debug/pending — the pending (unbound) pods in tier-aware
+        admission order (tputopo.priority): higher tiers first, FIFO
+        within a tier — the order a priority-aware queue controller
+        should feed them to the scheduler."""
+        from tputopo.defrag.planner import list_pods_nocopy
+        from tputopo.k8s.objects import pod_priority, tier_name
+
+        sched = self.scheduler
+        reader = (sched.informer if sched.informer is not None
+                  and sched.informer.synced else None)
+        pods = list_pods_nocopy(reader if reader is not None else sched.api)
+        ordered = sched.admission_order(
+            [p for p in pods if not p.get("spec", {}).get("nodeName")])
+        self._send_json(200, {"pending": [
+            {"pod": f"{p['metadata'].get('namespace', 'default')}"
+                    f"/{p['metadata']['name']}",
+             "priority": (prio := pod_priority(p)),
+             "tier": tier_name(prio)}
+            for p in ordered]})
+
+    def _handle_preempt(self, query: str) -> None:
+        """GET /debug/preempt?replicas=R&chips=K&priority=P — DRY-RUN
+        targeted-preemption plan (tputopo.priority): the cheapest
+        strictly-lower-tier eviction set that would let an R x K-chip
+        gang at tier P place, or null.  ``priority`` accepts a named
+        tier (serving/prod/batch) or an integer; never evicts anything."""
+        from tputopo.k8s.objects import parse_priority
+
+        qs = urllib.parse.parse_qs(query)
+        try:
+            replicas = int(qs.get("replicas", ["1"])[0])
+            chips = int(qs.get("chips", ["1"])[0])
+            priority = parse_priority(qs.get("priority", ["0"])[0])
+            if replicas < 1 or chips < 1:
+                raise ValueError("replicas and chips must be >= 1")
+        except (ValueError, TypeError) as e:
+            self.scheduler.metrics.inc("bad_requests")
+            self._send_json(400, {"error": f"bad preempt query "
+                                           f"{query!r}: {e}"})
+            return
+        plan = self.scheduler.plan_preempt(replicas, chips, priority)
+        self._send_json(200, {
+            "dry_run": True,
+            "demand": {"replicas": replicas, "chips_per_member": chips,
+                       "priority": priority},
+            "plan": plan.describe() if plan is not None else None,
+            "budget": {"max_moves": self.config.preempt_max_moves,
+                       "max_chips_moved":
+                           self.config.preempt_max_chips_moved},
         })
 
     def _handle_sort(self) -> None:

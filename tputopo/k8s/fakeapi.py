@@ -65,17 +65,40 @@ _WATCH_WINDOW = 2048  # retained events; older watch rvs get Gone (410)
 #: reader uses).  ``list_by_meta`` answers these in O(result) instead of
 #: the O(store) client-side filtered LIST that made ``_gang_members``
 #: ~580k ``is_member`` calls per standard sim trace (ROADMAP bottleneck).
-INDEXED_META = ("tpu.dev/gang-id",)
+#: ``tpu.dev/priority`` joins the vocabulary with tputopo.priority: a
+#: tier-filtered pending lookup ("every serving-tier pod") is O(tier),
+#: not O(store) — the informer mirror shares this tuple via MetaIndex,
+#: so the authoritative and mirrored indexes can never drift.
+INDEXED_META = ("tpu.dev/gang-id", "tpu.dev/priority")
 
 
 def meta_value(obj: dict, key: str) -> str | None:
     """``key``'s value in an object's merged metadata — labels override
-    annotations, matching ``_gang_of``'s ``{**annotations, **labels}``."""
+    annotations, matching ``_gang_of``'s ``{**annotations, **labels}``.
+    Values are canonicalized per key (:func:`canon_meta_value`), so the
+    named and integer spellings of one priority tier share a bucket."""
     md = obj.get("metadata", {})
     labels = md.get("labels") or {}
     if key in labels:
-        return labels[key]
-    return (md.get("annotations") or {}).get(key)
+        return canon_meta_value(key, labels[key])
+    return canon_meta_value(key, (md.get("annotations") or {}).get(key))
+
+
+def canon_meta_value(key: str, value: str | None) -> str | None:
+    """Canonical index spelling of a metadata value.  The priority key
+    accepts aliases ("serving" == "100" — tputopo.k8s.objects), so the
+    index buckets — and every :meth:`list_by_meta` lookup — normalize
+    through ``parse_priority``; a malformed priority indexes nowhere
+    (the lenient read path treats it as unlabeled batch, and unlabeled
+    pods are not bucketed either).  Other keys pass through."""
+    if value is None or key != "tpu.dev/priority":
+        return value
+    from tputopo.k8s.objects import parse_priority
+
+    try:
+        return str(parse_priority(value))
+    except ValueError:
+        return None
 
 
 class MetaIndex:
@@ -112,13 +135,15 @@ class MetaIndex:
                         del self._buckets[(kind, mk, v)]
 
     def lookup(self, kind: str, key: str, value: str) -> list[dict]:
-        """Stored dicts with ``key == value``; unindexed keys raise
-        KeyError so a silent full miss can never masquerade as an empty
-        gang."""
+        """Stored dicts with ``key == value`` (value canonicalized, so a
+        lookup by "serving" and one by "100" answer identically);
+        unindexed keys raise KeyError so a silent full miss can never
+        masquerade as an empty gang."""
         if key not in INDEXED_META:
             raise KeyError(f"meta key {key!r} is not indexed "
                            f"(indexed: {INDEXED_META})")
-        return list(self._buckets.get((kind, key, value), {}).values())
+        return list(self._buckets.get(
+            (kind, key, canon_meta_value(key, value)), {}).values())
 
     def drop_kind(self, kind: str) -> None:
         self._buckets = {mkey: bucket

@@ -65,7 +65,8 @@ class Informer:
         # authoritative one.  Maintained wherever a mirror entry is
         # installed/removed (_relist / _apply / observe), so gang-member
         # lookup against the mirror is O(gang) instead of a filtered LIST
-        # of every pod.
+        # of every pod — and, with tpu.dev/priority in INDEXED_META
+        # (tputopo.priority), a tier-filtered pending lookup is O(tier).
         self._meta_index = MetaIndex()  # guarded-by: _lock
         self._rv: dict[str, str] = {}  # guarded-by: _lock
         # Content version: bumped ONLY when the mirror's content actually

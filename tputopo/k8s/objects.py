@@ -46,7 +46,67 @@ ANN_ASSIGNED = "tpu.dev/assigned"          # "false" at bind -> "true" at Alloca
 ANN_GANG_ID = "tpu.dev/gang-id"            # job-level token for gang scheduling
 ANN_PREDICTED_GBPS = "tpu.dev/predicted-allreduce-gbps"  # decision record
 
+# -- Priority tiers (tputopo.priority).  A pod (or every pod of a gang)
+#    declares its tier via this label/annotation; the value is either a
+#    named tier or a bare integer 0..MAX_PRIORITY_VALUE.  Higher wins:
+#    admission sorts high tiers first, and targeted preemption may evict
+#    only *strictly lower* tiers.  Absent == "batch" (0) — the whole
+#    pre-priority workload keeps its exact behavior.
+LABEL_PRIORITY = "tpu.dev/priority"
+
+#: Named tiers — the operator vocabulary; raw integers between tiers are
+#: accepted (e.g. "75") so tenants can subdivide.
+PRIORITY_TIERS = {"serving": 100, "prod": 50, "batch": 0}
+MAX_PRIORITY_VALUE = 1000
+
+#: Reverse map for reporting: int -> canonical tier name; off-map values
+#: render as ``tier-<int>``.
+_TIER_NAMES = {v: k for k, v in PRIORITY_TIERS.items()}
+
 Annotations = dict[str, str]
+
+
+def parse_priority(value: str | int | None) -> int:
+    """Validate a ``tpu.dev/priority`` value: a named tier from
+    :data:`PRIORITY_TIERS` or an integer in [0, MAX_PRIORITY_VALUE].
+    Raises ValueError on anything else — the admission validation path
+    (a malformed tier must be rejected at the door, not silently zeroed
+    there)."""
+    if value is None:
+        return 0
+    if isinstance(value, str) and value in PRIORITY_TIERS:
+        return PRIORITY_TIERS[value]
+    try:
+        p = int(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"bad {LABEL_PRIORITY} value {value!r}: want a tier name "
+            f"{sorted(PRIORITY_TIERS)} or an int in "
+            f"[0, {MAX_PRIORITY_VALUE}]") from None
+    if not 0 <= p <= MAX_PRIORITY_VALUE:
+        raise ValueError(
+            f"{LABEL_PRIORITY} value {p} outside [0, {MAX_PRIORITY_VALUE}]")
+    return p
+
+
+def pod_priority(pod: dict) -> int:
+    """A pod's priority tier, read from merged metadata (labels shadow
+    annotations — the same precedence every gang reader uses).  Lenient:
+    a malformed value on a *stored* pod degrades to the batch tier (0)
+    instead of wedging a scheduling verb; :func:`parse_priority` is the
+    strict validation entry point."""
+    md = pod.get("metadata", {})
+    meta = {**(md.get("annotations") or {}), **(md.get("labels") or {})}
+    try:
+        return parse_priority(meta.get(LABEL_PRIORITY))
+    except ValueError:
+        return 0
+
+
+def tier_name(priority: int) -> str:
+    """Canonical report label of a priority value (``serving`` / ``prod``
+    / ``batch``, else ``tier-<int>``)."""
+    return _TIER_NAMES.get(priority, f"tier-{priority}")
 
 
 def make_node(name: str, *, chips: int = 0, labels: Annotations | None = None,

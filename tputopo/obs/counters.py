@@ -44,6 +44,12 @@ COUNTERS = (
     "state_delta_fallbacks",
     "state_from_informer",
     "state_full_rebuilds",
+    # priority / targeted preemption (tputopo.priority; extender
+    # /debug/preempt dry-run planning — the sim engine's preempt/
+    # backfill/SLO tallies are deterministic report dicts, not Metrics
+    # counters, and are pinned by the report schema instead)
+    "preempt_plans_considered",
+    "preempt_plans_found",
     # gang planning
     "gang_assumptions_released",
     "gang_candidate_memo_hits",

@@ -1,0 +1,23 @@
+"""Priority tiers, admission ordering and targeted preemption.
+
+The production extension the Gaia evaluation (PAPER.md §IV) lacks:
+latency-sensitive serving pods coexist with long training gangs under an
+explicit ``tpu.dev/priority`` tier model (tputopo.k8s.objects).  Three
+rules, all riding existing substrate:
+
+- **admission order** (:func:`admission_order`): pending high-tier gangs
+  sort before lower tiers, FIFO within a tier;
+- **targeted preemption** (:func:`plan_preemption`): a high-tier gang
+  that cannot place may evict the cheapest strictly-lower-tier victim
+  set — the defrag planner's mask-native cheapest-eviction search with a
+  priority victim filter (gang atomicity, net-gain and budget rules all
+  kept); evictions flow through the existing delete -> requeue ->
+  recover path, so the chaos invariants keep holding;
+- **backfill** (:func:`backfill_ok`): while a higher-tier job is blocked,
+  only short trace-known-duration lower-tier jobs may jump it.
+"""
+
+from tputopo.priority.preempt import (plan_preemption,  # noqa: F401
+                                      victim_priorities)
+from tputopo.priority.tiers import (admission_key, admission_order,  # noqa: F401
+                                    backfill_ok)

@@ -1,0 +1,76 @@
+"""Targeted preemption: the defrag planner with a priority victim filter.
+
+A high-tier gang that cannot place right now may evict the *cheapest*
+set of strictly-lower-tier victims whose chips restore a placeable box
+for it.  Everything but the victim filter is
+:func:`tputopo.defrag.planner.plan_migration` verbatim — gang atomicity
+(whole gangs evict together), the net-gain rule (never disturb as many
+chips as the restored box yields), the ``max_moves``/``max_chips_moved``
+budgets, host-aware placeability, and the deterministic cheapest-first
+ranking.  The one semantic difference: preemption does not require the
+domain to already hold ``volume`` free chips — the capacity comes from
+the victims (``require_free_capacity=False``).
+
+Execution is the caller's: the sim engine requeues victims through the
+same path node failures use; the extender serves dry-run plans at
+``GET /debug/preempt`` (actual eviction belongs to a job controller).
+"""
+
+from __future__ import annotations
+
+from tputopo.defrag.planner import MigrationPlan, plan_migration
+from tputopo.extender.state import ClusterState
+from tputopo.k8s import objects as ko
+
+
+def victim_priorities(pods) -> dict[str, int]:
+    """Priority of every evictable unit, keyed exactly like the defrag
+    planner's victim index ("namespace/gang-id" for gang members,
+    "namespace/pod-name" for lone pods).  Gang identity reads the SAME
+    field the victim index reads — the ``tpu.dev/gang-id`` *annotation*
+    the bind verb stamps (``PodAssignment.gang_id``) — so the two key
+    derivations cannot drift.  A gang's tier is its members' MAX
+    priority: one high-tier member protects the whole gang (gangs are
+    atomic — evicting around it is impossible anyway)."""
+    out: dict[str, int] = {}
+    for p in pods:
+        md = p.get("metadata", {})
+        ns = md.get("namespace", "default")
+        gang = (md.get("annotations") or {}).get(ko.ANN_GANG_ID)
+        key = f"{ns}/{gang}" if gang else f"{ns}/{md.get('name', '')}"
+        prio = ko.pod_priority(p)
+        if prio > out.get(key, -1):
+            out[key] = prio
+    return out
+
+
+def plan_preemption(state: ClusterState, demand: tuple[int, int],
+                    demand_priority: int, pods, *,
+                    max_moves: int = 1,
+                    max_chips_moved: int = 64) -> MigrationPlan | None:
+    """The cheapest strictly-lower-tier eviction set that would let
+    ``demand`` (replicas, chips-per-member) place, or None.
+
+    ``pods`` is the pod listing the victim tiers are read from (the
+    informer mirror / nocopy listing — read-only).  A demand at the
+    bottom tier can never preempt (nothing is strictly lower), and the
+    net-gain rule structurally forbids evicting an equal-or-larger
+    volume than the demand needs — disruption is bounded by
+    construction, not by goodwill."""
+    if demand_priority <= 0:
+        return None  # bottom tier: no strictly-lower victims exist
+    if demand[0] * demand[1] <= 1:
+        # Structurally hopeless: the net-gain budget is volume - 1 = 0
+        # chips, so no victim set can ever qualify — skip the search.
+        return None
+    prio = victim_priorities(pods)
+    # Fail CLOSED: a victim-index key absent from the priority map (a
+    # pod listing raced a delete, or some future key drift) counts as
+    # maximally protected — an unknown unit must never lose its
+    # preemption protection by default.
+    return plan_migration(
+        state, [demand],
+        max_moves=max_moves, max_chips_moved=max_chips_moved,
+        evictable=lambda key: prio.get(key, ko.MAX_PRIORITY_VALUE)
+        < demand_priority,
+        require_free_capacity=False)

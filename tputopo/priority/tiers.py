@@ -1,0 +1,53 @@
+"""Tier-aware admission ordering and the backfill gate.
+
+Pure functions over pod dicts / (priority, duration) scalars.  The pod
+spelling serves the extender (``ExtenderScheduler.admission_order``,
+``GET /debug/pending``); the sim engine's scheduling wake applies the
+same tier-then-FIFO rule at the job level (its queue position is
+arrival order), and ``backfill_ok`` is shared verbatim.
+"""
+
+from __future__ import annotations
+
+from tputopo.k8s import objects as ko
+
+
+def admission_key(pod: dict) -> tuple:
+    """Sort key for one pending pod: higher tier first, then FIFO.
+
+    FIFO position prefers ``metadata.creationTimestamp`` (RFC 3339
+    sorts lexicographically — true creation order on real API servers),
+    falling back to ``resourceVersion`` where it is absent (the
+    in-memory fake).  The rv fallback is LAST-WRITE order, not strict
+    creation order: a metadata patch re-queues the pod behind its tier
+    peers — the same wait-clock-restarts-on-requeue semantics the sim's
+    engine applies, but imprecise for pure annotation touches.  Ties
+    break on (namespace, name) for determinism."""
+    md = pod.get("metadata", {})
+    try:
+        rv = int(md.get("resourceVersion", 0))
+    except (TypeError, ValueError):
+        rv = 0
+    return (-ko.pod_priority(pod), md.get("creationTimestamp", ""), rv,
+            md.get("namespace", "default"), md.get("name", ""))
+
+
+def admission_order(pods: list[dict]) -> list[dict]:
+    """Pending pods in the order the scheduler should admit them:
+    high-tier gangs strictly before lower tiers, FIFO within a tier.
+    With no priority labels anywhere this is exactly creation order —
+    the pre-priority behavior."""
+    return sorted(pods, key=admission_key)
+
+
+def backfill_ok(priority: int, duration_s: float, blocked_priority: int,
+                limit_s: float) -> bool:
+    """May a job of ``priority`` start while a ``blocked_priority`` job
+    is pending-and-unplaceable ahead of it?  Equal-or-higher tiers always
+    may (they never delay the blocked job's own tier); lower tiers only
+    when their trace-known duration is short (<= ``limit_s``): a short
+    filler releases its chips before the blocked gang plausibly places,
+    a long one would entrench the very occupancy blocking it."""
+    if priority >= blocked_priority:
+        return True
+    return duration_s <= limit_s

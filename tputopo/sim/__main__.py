@@ -14,7 +14,7 @@ import json
 import sys
 import time
 
-from tputopo.sim.engine import DEFAULT_DEFRAG, run_trace
+from tputopo.sim.engine import DEFAULT_DEFRAG, DEFAULT_PREEMPT, run_trace
 from tputopo.sim.policies import available_policies
 from tputopo.sim.trace import TraceConfig
 
@@ -76,6 +76,35 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--defrag-hysteresis", type=int,
                    default=DEFAULT_DEFRAG["hysteresis"],
                    help="consecutive pressured cycles before acting")
+    p.add_argument("--workload", choices=("standard", "mixed"),
+                   default="standard",
+                   help="trace class: 'standard' = the single-tenant "
+                        "batch vocabulary; 'mixed' = serving-tier "
+                        "inference (small k, tight queue-wait SLO, "
+                        "diurnal arrivals) interleaved with long "
+                        "prod/batch training gangs (tputopo.priority; "
+                        "adds the per-tier block, schema tputopo.sim/v5)")
+    p.add_argument("--slo-wait", type=float, default=None,
+                   help="serving-tier queue-wait SLO, virtual seconds "
+                        "(mixed workload; default 60)")
+    p.add_argument("--preempt", action="store_true",
+                   help="targeted preemption + backfill (tputopo."
+                        "priority): a blocked higher-tier job may evict "
+                        "the cheapest strictly-lower-tier victim set "
+                        "(defrag planner search, net-gain and budget "
+                        "rules kept); adds the preempt counter block "
+                        "(schema tputopo.sim/v5)")
+    p.add_argument("--preempt-max-moves", type=int,
+                   default=DEFAULT_PREEMPT["max_moves"],
+                   help="preemption budget: max victim jobs per plan")
+    p.add_argument("--preempt-max-chips", type=int,
+                   default=DEFAULT_PREEMPT["max_chips_moved"],
+                   help="preemption budget: max chips disturbed per plan")
+    p.add_argument("--backfill-limit", type=float,
+                   default=DEFAULT_PREEMPT["backfill_limit_s"],
+                   help="max duration (virtual s) a lower-tier job may "
+                        "have and still start while a higher tier is "
+                        "blocked (<= 0 disables backfill gating)")
     p.add_argument("--chaos", default=None, metavar="PROFILE",
                    help="run under the seeded fault-injection layer "
                         "(tputopo.chaos): injected CAS conflicts, "
@@ -116,11 +145,20 @@ def main(argv: list[str] | None = None) -> int:
         # a report with an empty A/B block — reject like other bad input.
         print(f"duplicate policies in {policies}", file=sys.stderr)
         return 2
+    trace_kwargs = {}
+    if args.workload != "standard":
+        trace_kwargs["workload"] = args.workload
+        if args.slo_wait is not None:
+            trace_kwargs["slo_wait_s"] = args.slo_wait
+    elif args.slo_wait is not None:
+        print("--slo-wait only applies to --workload mixed",
+              file=sys.stderr)
+        return 2
     cfg = TraceConfig(
         seed=args.seed, nodes=args.nodes, spec=args.spec,
         arrivals=args.arrivals, process=args.process, rate_per_s=args.rate,
         duration_mean_s=args.duration_mean, ghost_prob=args.ghost_prob,
-        node_failures=args.node_failures,
+        node_failures=args.node_failures, **trace_kwargs,
     )
     if args.chaos is not None:
         from tputopo.chaos import PROFILES
@@ -130,6 +168,11 @@ def main(argv: list[str] | None = None) -> int:
                   f"{sorted(PROFILES)}", file=sys.stderr)
             return 2
     flight_trace = not args.no_trace
+    preempt = None
+    if args.preempt:
+        preempt = {"max_moves": args.preempt_max_moves,
+                   "max_chips_moved": args.preempt_max_chips,
+                   "backfill_limit_s": args.backfill_limit}
     defrag = None
     if args.defrag:
         defrag = {"period_s": args.defrag_period,
@@ -156,6 +199,7 @@ def main(argv: list[str] | None = None) -> int:
                                    flight_trace=flight_trace,
                                    defrag=defrag,
                                    chaos=args.chaos,
+                                   preempt=preempt,
                                    return_states=True)
         prof.disable()
         buf = io.StringIO()
@@ -169,6 +213,7 @@ def main(argv: list[str] | None = None) -> int:
                                    flight_trace=flight_trace,
                                    defrag=defrag,
                                    chaos=args.chaos,
+                                   preempt=preempt,
                                    return_states=True)
     # tpulint: disable=determinism -- CLI wall timing feeds the throughput block only
     wall_s = time.perf_counter() - t0

@@ -32,8 +32,10 @@ from tputopo.obs import Tracer as ObsTracer
 from tputopo.extender.state import ClusterState
 from tputopo.k8s import objects as ko
 from tputopo.k8s.fakeapi import FakeApiServer, NotFound
+from tputopo.priority import backfill_ok, plan_preemption
+from tputopo.defrag.planner import list_pods_nocopy
 from tputopo.sim.policies import get_policy, pods_for_job
-from tputopo.sim.report import MetricsCollector, build_report
+from tputopo.sim.report import MetricsCollector, build_report, tier_block
 from tputopo.sim.trace import JobSpec, Trace, TraceConfig, generate_trace
 from tputopo.topology.slices import Allocator, enumerate_shapes
 from tputopo.topology.score import (_box_of, predict_allreduce_gbps,
@@ -97,7 +99,7 @@ class _JobRun:
     """Mutable per-job lifecycle state (the trace JobSpec stays frozen)."""
 
     __slots__ = ("spec", "enqueued_t", "incarnation", "chips_held",
-                 "failed_epoch", "handles")
+                 "failed_epoch", "handles", "started_t")
 
     def __init__(self, spec: JobSpec, enqueued_t: float) -> None:
         self.spec = spec
@@ -108,6 +110,10 @@ class _JobRun:
         # Copy-free pod handles, one per member: key-stable, so they
         # survive the delete/recreate of a requeued incarnation.
         self.handles: list = []
+        # Virtual time the current incarnation placed — what preemption's
+        # lost-virtual-work accounting reads (run time thrown away when a
+        # victim restarts from its queue).
+        self.started_t = -1.0
 
 
 def stage_nodes(cfg: TraceConfig) -> tuple[FakeApiServer, list[dict], dict]:
@@ -152,6 +158,18 @@ DEFAULT_DEFRAG = {
     "max_concurrent": 1,
 }
 
+#: Default knobs for targeted preemption (``--preempt``,
+#: tputopo.priority): one victim job per plan (the same single-victim
+#: posture the defrag sweep settled on — disruption stays attributable
+#: to one blocked gang), the net-gain rule bounding chips on top, and a
+#: backfill window long enough for genuinely short fillers while a
+#: multi-hour training gang can never jump a blocked serving gang.
+DEFAULT_PREEMPT = {
+    "max_moves": 1,
+    "max_chips_moved": 64,
+    "backfill_limit_s": 180.0,
+}
+
 
 class _GcChaosMetrics:
     """Counter-only Metrics facade for the engine's :class:`AssumptionGC`.
@@ -192,6 +210,7 @@ class SimEngine:
                  flight_trace: bool = True,
                  defrag: dict | None = None,
                  chaos: str | dict | None = None,
+                 preempt: dict | None = None,
                  audit_every: int = 0) -> None:
         self.trace = trace
         self.cfg = trace.config
@@ -273,6 +292,12 @@ class SimEngine:
         # costing O(queue) full sorts on every event.
         self.capacity_epoch = 0
         self._scan_start = 0  # rotating backfill window (see _try_schedule)
+        # Terminal drain in progress (run_events): the backfill gate is
+        # suspended there — with no future event ever coming, holding a
+        # feasible low-tier job for a permanently-blocked high tier would
+        # strand it, violating the drain's no-stranded-feasible-jobs
+        # contract.
+        self._draining = False
         self.failed_nodes: set[str] = set()
         self._repair_at: dict[str, float] = {}  # failed node -> latest declared repair
         self._blocked: dict[str, list[tuple]] = {}  # failed node -> chips blocked in twin
@@ -299,6 +324,30 @@ class SimEngine:
         self._substantive_pending = 0
         self.horizon_s = 0.0
         self.events_processed = 0  # heap pops — the throughput denominator
+
+        # Priority tiers (tputopo.priority): tier-aware admission order,
+        # per-tier SLO/disruption accounting, and — under ``preempt`` —
+        # targeted preemption + the backfill gate.  A trace with no
+        # tiered jobs and no preempt knobs runs the exact pre-priority
+        # scheduling wake (byte-identical decisions and report).
+        self.preempt_knobs = ({**DEFAULT_PREEMPT, **preempt}
+                              if preempt is not None else None)
+        self._tiered = self.preempt_knobs is not None or any(
+            j.priority > 0 or j.slo_wait_s > 0 for j in trace.jobs)
+        # name -> flat per-tier stats (report.tier_block shapes them).
+        self.tier_stats: dict[str, dict] | None = {} if self._tiered else None
+        self.preempt_counters: dict[str, int] | None = None
+        if self.preempt_knobs is not None:
+            self.preempt_counters = {
+                "plans_considered": 0, "plans_executed": 0, "no_plan": 0,
+                "jobs_preempted": 0, "chips_freed": 0,
+                "place_failed_after_preempt": 0,
+                "backfill_admitted": 0, "backfill_held": 0,
+            }
+            # Preemption planning reads the engine's own API (it models
+            # the cluster-level controller, like staging/confirms), via
+            # the copy-free facade — a sync per attempted plan.
+            self._plan_api = _CopyFreeApi(self.api)
 
         # Defragmentation loop (tputopo.defrag), opt-in: a periodic
         # controller cycle on virtual time, evicting through the same
@@ -409,6 +458,12 @@ class SimEngine:
             # byte-identical to the v3/v2 shapes): injected faults by
             # kind, retry/requeue attribution, and the invariant audit.
             chaos=chaos,
+            # Priority blocks (tputopo.priority): per-tier stats when the
+            # trace carried tiers, preemption counters under --preempt.
+            # Both None on untiered runs — pre-priority report bytes are
+            # pinned by their absence, same rule as defrag/chaos.
+            tiers=self.tier_stats,
+            preempt=self.preempt_counters,
         )
 
     def run_events(self) -> None:
@@ -466,6 +521,7 @@ class SimEngine:
                 # is genuinely infeasible.  Fault-free this reduces
                 # exactly to the old single pass.
                 budget = self.max_backfill_failures
+                self._draining = True
                 try:
                     for _ in range(16):
                         self.max_backfill_failures = len(self.queue) + 1
@@ -482,6 +538,7 @@ class SimEngine:
                             break  # no progress, no faults: infeasible
                 finally:
                     self.max_backfill_failures = budget
+                    self._draining = False
             # Invariant: an outstanding unconfirmed assumption always has
             # a future GC sweep to reclaim it — a ghost placed by THIS
             # event's try_schedule OR by the terminal drain just above
@@ -512,6 +569,8 @@ class SimEngine:
 
     def _on_arrival(self, spec: JobSpec) -> None:
         self.metrics.counts["arrived"] += 1
+        if self.tier_stats is not None:
+            self._tier(spec)["arrived"] += 1
         run = _JobRun(spec, self.clock.t)
         run.handles = [self.api.handle("pods", f"{spec.name}-{m}", "default")
                        for m in range(spec.replicas)]
@@ -703,6 +762,14 @@ class SimEngine:
         if self.ghosts and min(self.ghosts.values()) <= self.clock.t:
             self._sweep()
         alive = [n for n in self.node_names if n not in self.failed_nodes]
+        if self._tiered:
+            # Priority tiers present (tputopo.priority): the wake runs
+            # the tier-aware variant — admission order, the backfill
+            # gate, targeted preemption.  The branch keeps the untiered
+            # path below byte-for-byte.
+            self._schedule_tiered(alive)
+            self._sample_occupancy()
+            return
         # One pass with backfill over a ROTATED view of the FIFO queue:
         # capacity only shrinks as this wake places jobs, so a job that
         # failed once this wake cannot fit later in the same wake, and the
@@ -727,23 +794,8 @@ class SimEngine:
             decisions = self.policy.place(run.spec, alive,
                                           handles=run.handles)
             if decisions is None:
-                # Fault attribution: a None caused by a transient fault
-                # (bind conflict, API timeout, crash recovery) is a retry,
-                # not a capacity verdict — tally it by reason, and do NOT
-                # burn a per-epoch failure memo on it (capacity did not
-                # shrink; the very next wake may succeed).  Fault-aborted
-                # attempts get the reset check at ANY size: a single pod
-                # can end up bound-but-unreported after an exhausted
-                # ambiguous-timeout retry, not just a partial gang.
                 reason = getattr(self.policy, "last_none_reason", None)
-                faulted = reason is not None and reason != "infeasible"
-                if faulted:
-                    self.place_retry_reasons[reason] = \
-                        self.place_retry_reasons.get(reason, 0) + 1
-                else:
-                    run.failed_epoch = self.capacity_epoch
-                if run.spec.replicas > 1 or faulted:
-                    self._reset_if_partially_bound(run)
+                self._note_place_failure(run, reason)
                 failures += 1
                 continue
             self._commit(run, decisions)
@@ -752,6 +804,209 @@ class SimEngine:
             self.queue = [r for r in self.queue if id(r) not in placed]
         self._scan_start = (start + failures) if failures else 0
         self._sample_occupancy()
+
+    def _note_place_failure(self, run: _JobRun, reason: str | None) -> bool:
+        """The shared tail of a failed ``place()`` attempt — ONE copy for
+        the untiered and tiered wakes, so the fault rules can never
+        drift.  A None caused by a transient fault (bind conflict, API
+        timeout, crash recovery) is a retry, not a capacity verdict —
+        tally it by reason, and do NOT burn a per-epoch failure memo on
+        it (capacity did not shrink; the very next wake may succeed).
+        Fault-aborted attempts get the reset check at ANY size: a single
+        pod can end up bound-but-unreported after an exhausted
+        ambiguous-timeout retry, not just a partial gang.  Returns the
+        fault-classed verdict."""
+        faulted = reason is not None and reason != "infeasible"
+        if faulted:
+            self.place_retry_reasons[reason] = \
+                self.place_retry_reasons.get(reason, 0) + 1
+        else:
+            run.failed_epoch = self.capacity_epoch
+        if run.spec.replicas > 1 or faulted:
+            self._reset_if_partially_bound(run)
+        return faulted
+
+    # ---- priority tiers (tputopo.priority) ---------------------------------
+
+    def _tier(self, spec: JobSpec) -> dict:
+        """The flat per-tier stats record for ``spec``'s tier, created on
+        first touch (report.tier_block renders it)."""
+        name = ko.tier_name(spec.priority)
+        ts = self.tier_stats.get(name)
+        if ts is None:
+            ts = self.tier_stats[name] = {
+                "priority": spec.priority,
+                "arrived": 0, "scheduled": 0, "waits": [],
+                "slo_target_s": (float(spec.slo_wait_s)
+                                 if spec.slo_wait_s > 0 else None),
+                "slo_met": 0, "slo_missed": 0,
+                "jobs_preempted": 0, "pods_evicted": 0,
+                "chips_moved": 0, "lost_virtual_s": 0.0,
+            }
+        return ts
+
+    def _pcount(self, key: str, by: int = 1) -> None:
+        self.preempt_counters[key] = self.preempt_counters.get(key, 0) + by
+
+    def _schedule_tiered(self, alive: list[str]) -> None:
+        """The tier-aware scheduling wake: jobs attempt in admission
+        order (higher tier first, FIFO within — the job-level spelling
+        of the pod rule ``ExtenderScheduler.admission_order`` serves at
+        /debug/pending; queue position IS arrival order here), a blocked
+        higher tier gates lower-tier attempts through the backfill rule,
+        and — with ``--preempt`` — an infeasible tiered job may evict the
+        cheapest strictly-lower-tier victim set and retry immediately.
+
+        No rotation: the rotating window exists to keep head-of-queue
+        failures from starving FIFO peers, and admission priority IS the
+        fairness policy here; per-epoch failure memos still keep a stuck
+        queue from costing O(queue) sorts per wake."""
+        n = len(self.queue)
+        order = sorted(range(n),
+                       key=lambda i: (-self.queue[i].spec.priority, i))
+        # None = gate off (no preempt knobs, terminal drain, or a
+        # non-positive limit — the documented "disable" spelling).
+        backfill_limit = None
+        if self.preempt_knobs is not None and not self._draining:
+            limit = float(self.preempt_knobs["backfill_limit_s"])
+            backfill_limit = limit if limit > 0 else None
+        failures = 0
+        placed: set[int] = set()
+        blocked_priority: int | None = None  # highest tier blocked this wake
+        for i in order:
+            run = self.queue[i]
+            spec = run.spec
+            if run.failed_epoch == self.capacity_epoch:
+                # Known-infeasible this epoch: no sort spent, but it is
+                # still BLOCKED — lower tiers behind it stay gated.
+                if blocked_priority is None or spec.priority > blocked_priority:
+                    blocked_priority = spec.priority
+                continue
+            if failures >= self.max_backfill_failures:
+                continue
+            backfilling = (blocked_priority is not None
+                           and spec.priority < blocked_priority)
+            if backfilling and backfill_limit is not None and not backfill_ok(
+                    spec.priority, spec.duration_s, blocked_priority,
+                    backfill_limit):
+                self._pcount("backfill_held")
+                continue
+            decisions = self.policy.place(spec, alive, handles=run.handles)
+            reason = getattr(self.policy, "last_none_reason", None)
+            if (decisions is None and reason == "infeasible"
+                    and self.preempt_knobs is not None
+                    and spec.priority > 0 and not spec.multislice
+                    # volume <= 1 can never preempt (net-gain budget 0)
+                    # — don't pay the plan's cluster sync to learn it.
+                    and spec.replicas * spec.chips > 1):
+                if self._try_preempt(run):
+                    decisions = self.policy.place(spec, alive,
+                                                  handles=run.handles)
+                    reason = getattr(self.policy, "last_none_reason", None)
+                    if decisions is None:
+                        # The freed box did not translate into a
+                        # placement (e.g. a racing injected fault):
+                        # counted — a silently wasted eviction would
+                        # make "bounded disruption" unauditable.
+                        self._pcount("place_failed_after_preempt")
+            if decisions is None:
+                self._note_place_failure(run, reason)
+                # The gate cares about "pending ahead", not "capacity-
+                # blocked": a fault-aborted high-tier attempt leaves the
+                # job just as pending, so it gates lower tiers exactly
+                # like an infeasible one (only the epoch memo
+                # distinguishes the two).
+                if blocked_priority is None \
+                        or spec.priority > blocked_priority:
+                    blocked_priority = spec.priority
+                failures += 1
+                continue
+            if backfilling and backfill_limit is not None:
+                self._pcount("backfill_admitted")
+            self._commit(run, decisions)
+            placed.add(id(run))
+        if placed:
+            self.queue = [r for r in self.queue if id(r) not in placed]
+
+    def _try_preempt(self, run: _JobRun) -> bool:
+        """Targeted preemption for one blocked tiered job: plan the
+        cheapest strictly-lower-tier eviction set (the defrag planner's
+        search under the priority victim filter), evict the victims
+        through the SAME delete -> requeue path node failures use (so
+        the chaos invariants — no double-booking, gang atomicity, no
+        lost jobs — keep holding), and report True when chips were
+        freed.  Opens a ``preempt`` flight-recorder trace with plan/
+        evict phases and an explain record (``preempted_by``, the victim
+        set, chips freed)."""
+        spec = run.spec
+        knobs = self.preempt_knobs
+        self._pcount("plans_considered")
+        tr = self.tracer.start("preempt", job=spec.name)
+        with tr:
+            with tr.phase("plan") as sp:
+                state = ClusterState(self._plan_api,
+                                     assume_ttl_s=self.assume_ttl_s,
+                                     clock=self.clock).sync()
+                plan = plan_preemption(
+                    state, (spec.replicas, spec.chips), spec.priority,
+                    list_pods_nocopy(self._plan_api),
+                    max_moves=int(knobs["max_moves"]),
+                    max_chips_moved=int(knobs["max_chips_moved"]))
+                if plan is not None:
+                    sp.count("victims", len(plan.victims))
+                    sp.count("chips", plan.chips_moved)
+            if plan is None:
+                self._pcount("no_plan")
+                if tr.enabled:
+                    tr.explain({"verb": "preempt", "job": spec.name,
+                                "priority": spec.priority, "plan": None})
+                return False
+            with tr.phase("evict") as sp:
+                for victim in plan.victims:
+                    self._preempt_victim(victim)
+                sp.count("jobs", len(plan.victims))
+            self._pcount("plans_executed")
+            self._pcount("jobs_preempted", len(plan.victims))
+            self._pcount("chips_freed", plan.chips_moved)
+            self.capacity_epoch += 1
+            self._sample_occupancy()
+            explain = {
+                "verb": "preempt",
+                "preempted_by": spec.name,
+                "priority": spec.priority,
+                "victims": [v.key for v in plan.victims],
+                "chips_freed": plan.chips_moved,
+                "plan": plan.describe(),
+            }
+            if tr.enabled:
+                tr.explain(explain)
+            # Preemptions are decisions: one deterministic decision-log
+            # entry (no members — nothing placed yet), so an A/B replay
+            # diff and --trace-out carry the eviction record itself.
+            self.decision_log.append({
+                "job": spec.name, "t": round(self.clock.t, 6),
+                "members": [],
+                "preempt": {"victims": [v.key for v in plan.victims],
+                            "chips_freed": plan.chips_moved},
+            })
+            return True
+
+    def _preempt_victim(self, victim) -> None:
+        """Evict one planned victim (a whole job — gangs are atomic):
+        per-tier disruption accounting, then the shared requeue path."""
+        now = self.clock.t
+        for jname in sorted({self._job_of_pod(p) for p in victim.pods}):
+            vrun = self.jobs.get(jname)
+            if vrun is None:
+                continue  # completed/reclaimed since the plan was built
+            if self.tier_stats is not None:
+                ts = self._tier(vrun.spec)
+                ts["jobs_preempted"] += 1
+                ts["pods_evicted"] += vrun.spec.replicas
+                ts["chips_moved"] += len(vrun.chips_held)
+                if vrun.started_t >= 0:
+                    ts["lost_virtual_s"] += now - vrun.started_t
+            self._requeue_job(vrun, "preempted")
 
     def _reset_if_partially_bound(self, run: _JobRun) -> None:
         """Defensive: a policy returning None must leave no member bound;
@@ -826,6 +1081,15 @@ class SimEngine:
             self.metrics.placement(gbps / ideal if ideal > 0 else 0.0,
                                    contiguous)
         self.metrics.job_scheduled(now - run.enqueued_t)
+        run.started_t = now
+        if self.tier_stats is not None:
+            ts = self._tier(spec)
+            ts["scheduled"] += 1
+            wait = now - run.enqueued_t
+            ts["waits"].append(wait)
+            if spec.slo_wait_s > 0:
+                ts["slo_met" if wait <= spec.slo_wait_s
+                   else "slo_missed"] += 1
         if spec.ghost:
             # Never confirms: the assumption ages out and the TTL GC (on
             # sim time) reclaims it — the two-phase handshake's failure leg.
@@ -902,12 +1166,13 @@ class RunState:
     __slots__ = ("policy_name", "horizon_s", "end_t", "metrics",
                  "placed_chips", "frag", "counters", "events_processed",
                  "phases", "phase_wall_ms", "decision_log", "defrag",
-                 "chaos")
+                 "chaos", "tiers", "preempt")
 
     def __init__(self, *, policy_name, horizon_s, end_t, metrics,
                  placed_chips, frag, counters, events_processed,
                  phases=None, phase_wall_ms=None,
-                 decision_log=None, defrag=None, chaos=None) -> None:
+                 decision_log=None, defrag=None, chaos=None,
+                 tiers=None, preempt=None) -> None:
         self.policy_name = policy_name
         self.horizon_s = horizon_s
         self.end_t = end_t
@@ -921,6 +1186,8 @@ class RunState:
         self.decision_log = decision_log or []
         self.defrag = defrag
         self.chaos = chaos
+        self.tiers = tiers
+        self.preempt = preempt
 
 
 def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
@@ -947,6 +1214,14 @@ def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
         # (schema tputopo.sim/v4); its absence keeps chaos-off reports
         # byte-identical to the v3/v2 shapes.
         out["chaos"] = rs.chaos
+    if rs.tiers is not None:
+        # Per-tier SLO/queue-wait/disruption block (schema tputopo.sim/v5,
+        # tputopo.priority) — present only when the trace carried tiers;
+        # untiered reports keep the v2/v3/v4 shapes byte-for-byte.
+        out["tiers"] = tier_block(rs.tiers)
+    if rs.preempt is not None:
+        # Deterministic targeted-preemption counters, --preempt only.
+        out["preempt"] = dict(sorted(rs.preempt.items()))
     return out
 
 
@@ -982,11 +1257,12 @@ def _run_policy_worker(args) -> RunState:
     unit.  Regenerates the trace from the config (deterministic per seed,
     pinned by tests) so nothing heavyweight crosses the process boundary
     in either direction."""
-    cfg, name, assume_ttl_s, gc_period_s, flight_trace, defrag, chaos = args
+    (cfg, name, assume_ttl_s, gc_period_s, flight_trace, defrag, chaos,
+     preempt) = args
     engine = SimEngine(generate_trace(cfg), name,
                        assume_ttl_s=assume_ttl_s, gc_period_s=gc_period_s,
                        flight_trace=flight_trace, defrag=defrag,
-                       chaos=chaos)
+                       chaos=chaos, preempt=preempt)
     engine.run_events()
     return engine.run_state()
 
@@ -996,6 +1272,7 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
               jobs: int = 1, flight_trace: bool = True,
               defrag: dict | None = None,
               chaos: str | None = None,
+              preempt: dict | None = None,
               return_states: bool = False):
     """Replay one deterministic trace under each policy and build the
     A/B report.  Every policy sees the identical event stream.
@@ -1027,13 +1304,25 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
     by kind, retry/requeue attribution, the invariant audit), the
     resolved knobs land under ``engine.chaos``, and the schema becomes
     ``tputopo.sim/v4``.  Off (the default) leaves report bytes exactly
-    as before."""
+    as before.
+
+    ``preempt`` (a knob dict merged over :data:`DEFAULT_PREEMPT`, or
+    None) turns on targeted preemption + the backfill gate
+    (tputopo.priority) in every engine.  A tiered trace (the ``mixed``
+    workload) or ``preempt`` makes the schema ``tputopo.sim/v5``: each
+    policy record gains the per-tier ``tiers`` block (queue-wait
+    percentiles, SLO attainment, preemption disruption) and — under
+    preempt — the ``preempt`` counter block, with the knobs recorded at
+    ``engine.preempt``.  Untiered preempt-off runs keep the v2/v3/v4
+    shapes byte-for-byte."""
     # tpulint: disable=determinism -- throughput.wall_s is the documented wall-clock exception
     t0 = time.perf_counter()
     defrag_knobs = ({**DEFAULT_DEFRAG, **defrag}
                     if defrag is not None else None)
+    preempt_knobs = ({**DEFAULT_PREEMPT, **preempt}
+                     if preempt is not None else None)
     work = [(cfg, name, assume_ttl_s, gc_period_s, flight_trace,
-             defrag_knobs, chaos) for name in policy_names]
+             defrag_knobs, chaos, preempt_knobs) for name in policy_names]
     if jobs > 1 and len(work) > 1:
         import multiprocessing as mp
 
@@ -1076,11 +1365,17 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
         from tputopo.chaos import FaultPlan
 
         engine_params["chaos"] = FaultPlan(cfg.seed, chaos).describe()
+    if preempt_knobs is not None:
+        engine_params["preempt"] = dict(sorted(preempt_knobs.items()))
     report = build_report(
         cfg.describe(), horizon, policies,
         engine_params=engine_params,
         schema_defrag=defrag_knobs is not None,
         schema_chaos=chaos is not None,
+        # v5 whenever priority content exists: --preempt, or a trace
+        # class that carries tiers (the tier block appears either way).
+        schema_priority=(preempt_knobs is not None
+                         or any("tiers" in p for p in policies.values())),
         throughput={
             "events": events,  # deterministic
             "wall_s": round(wall_s, 3),

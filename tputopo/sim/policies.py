@@ -47,6 +47,12 @@ def pods_for_job(job: JobSpec) -> list[dict]:
         labels[LABEL_GANG_SIZE] = str(job.replicas)
         if job.multislice:
             labels[LABEL_ALLOW_MULTISLICE] = "true"
+    if job.priority:
+        # Canonical integer spelling (tputopo.priority): one bucket per
+        # tier in the tpu.dev/priority meta index, whatever alias the
+        # trace used.  Absent at priority 0 — batch pods are
+        # byte-identical to the pre-priority vocabulary.
+        labels[ko.LABEL_PRIORITY] = str(ko.parse_priority(job.priority))
     return [ko.make_pod(f"{job.name}-{m}", chips=job.chips, labels=labels)
             for m in range(job.replicas)]
 

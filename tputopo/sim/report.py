@@ -30,8 +30,14 @@ Schema (``tputopo.sim/v2``)::
           "defrag": {<controller counters>},        # v3 (--defrag) only
           "chaos": {"profile", "injected", "suppressed", "retries",
                     "place_retries_by_reason", "requeues_by_reason",
-                    "invariants": {"ok", "checks", "violations"}}
+                    "invariants": {"ok", "checks", "violations"}},
                                                     # v4 (--chaos) only
+          "tiers": {"<tier>": {"priority", "jobs", "queue_wait_s",
+                    "slo"?: {"target_s", "met", "missed", "attainment"},
+                    "preemption_disruption": {"jobs_preempted",
+                    "pods_evicted", "chips_moved", "lost_virtual_s"}}},
+                                                    # v5 (tiered trace)
+          "preempt": {<targeted-preemption counters>}  # v5 (--preempt)
         }, ...
       },
       "ab": {"policies": [...], "deltas": {<metric>: a_minus_b},
@@ -73,6 +79,15 @@ SCHEMA_DEFRAG = "tputopo.sim/v3"
 #: clock) — it is part of the byte-determinism contract, not a third
 #: wall-clock exception.
 SCHEMA_CHAOS = "tputopo.sim/v4"
+#: v5 = the above plus the priority surfaces (tputopo.priority): the
+#: per-policy ``tiers`` block (per-tier queue-wait percentiles, SLO
+#: attainment, preemption disruption) whenever the trace carries tiers
+#: (the ``mixed`` workload), the ``preempt`` counter block and the
+#: ``engine.preempt`` knob record under ``--preempt``.  Untiered
+#: preempt-off runs keep emitting the v2/v3/v4 shapes byte-for-byte.
+#: All v5 content is deterministic virtual-time fact — part of the
+#: byte-determinism contract.
+SCHEMA_PRIORITY = "tputopo.sim/v5"
 
 #: The extender counters the report's per-policy ``scheduler`` block
 #: keeps (the ici policy filters its merged Metrics through this — plus
@@ -89,6 +104,11 @@ SCHEDULER_COUNTER_KEEP = (
     # reported, not inferred.
     "state_delta_applied", "state_full_rebuilds",
     "state_delta_fallbacks",
+    # Targeted preemption (tputopo.priority): dry-run plan traffic on
+    # the extender's /debug/preempt surface.  Absent counters don't
+    # appear (the keep filter is presence-gated), so sim report bytes
+    # only move when an extender actually planned preemptions.
+    "preempt_plans_considered", "preempt_plans_found",
 )
 
 
@@ -208,6 +228,45 @@ class MetricsCollector:
         }
 
 
+def tier_block(tier_stats: dict[str, dict]) -> dict:
+    """Shape the engine's flat per-tier stats into the report's ``tiers``
+    block (schema v5): per tier — job counts, queue-wait percentiles
+    (the shared ceil-rank convention), SLO attainment when the tier
+    carries a target, and the preemption-disruption tally (victims,
+    chips moved, lost virtual work).  Keys are tier names; JSON key
+    sorting orders them in the emitted report."""
+    out: dict[str, dict] = {}
+    for name, ts in tier_stats.items():
+        waits = sorted(ts["waits"])
+        qw = {"p50": 0.0, "p95": 0.0, "mean": 0.0, "max": 0.0}
+        if waits:
+            qw = {"p50": _r(quantile(waits, 0.5)),
+                  "p95": _r(quantile(waits, 0.95)),
+                  "mean": _r(sum(waits) / len(waits)),
+                  "max": _r(waits[-1])}
+        rec: dict = {
+            "priority": ts["priority"],
+            "jobs": {"arrived": ts["arrived"], "scheduled": ts["scheduled"],
+                     "preempted": ts["jobs_preempted"]},
+            "queue_wait_s": qw,
+            "preemption_disruption": {
+                "jobs_preempted": ts["jobs_preempted"],
+                "pods_evicted": ts["pods_evicted"],
+                "chips_moved": ts["chips_moved"],
+                "lost_virtual_s": _r(ts["lost_virtual_s"]),
+            },
+        }
+        if ts["slo_target_s"] is not None:
+            judged = ts["slo_met"] + ts["slo_missed"]
+            rec["slo"] = {
+                "target_s": _r(ts["slo_target_s"]),
+                "met": ts["slo_met"], "missed": ts["slo_missed"],
+                "attainment": _r(ts["slo_met"] / judged) if judged else 0.0,
+            }
+        out[name] = rec
+    return out
+
+
 #: Scalar extractors for the A/B delta block: name -> path into a policy
 #: record.  Deltas are first-listed-policy minus each comparator.
 _DELTA_AXES = {
@@ -244,9 +303,11 @@ def build_report(trace_desc: dict, horizon_s: float,
                  first_divergence: dict | None = None,
                  phase_wall: dict | None = None,
                  schema_defrag: bool = False,
-                 schema_chaos: bool = False) -> dict:
+                 schema_chaos: bool = False,
+                 schema_priority: bool = False) -> dict:
     out = {
-        "schema": (SCHEMA_CHAOS if schema_chaos
+        "schema": (SCHEMA_PRIORITY if schema_priority
+                   else SCHEMA_CHAOS if schema_chaos
                    else SCHEMA_DEFRAG if schema_defrag else SCHEMA),
         "trace": trace_desc,
         # Engine knobs that change results but are not part of the trace

@@ -36,6 +36,13 @@ class JobSpec:
     duration_s: float
     multislice: bool = False  # gang may split across ICI domains
     ghost: bool = False       # binds but never confirms -> TTL GC reclaims
+    # Priority tier (tputopo.priority): stamped onto the pods as
+    # tpu.dev/priority when nonzero.  0 == the batch tier == the whole
+    # pre-priority trace vocabulary, byte-for-byte.
+    priority: int = 0
+    # Queue-wait SLO, virtual seconds (0 = none): a scheduled job meets
+    # its SLO when wait <= slo_wait_s — the per-tier attainment figure.
+    slo_wait_s: float = 0.0
 
     @property
     def total_chips(self) -> int:
@@ -70,6 +77,26 @@ class TraceConfig:
     ghost_prob: float = 0.02       # jobs that never confirm (GC exercise)
     node_failures: int = 2         # fail events spread over the arrival window
     repair_mean_s: float = 900.0   # exp-distributed time-to-repair
+    # ---- mixed serving+training workload (tputopo.priority) ------------
+    # "standard" keeps the original single-tenant batch vocabulary (and
+    # its exact report bytes — the knobs below are dropped from
+    # describe() at the defaults).  "mixed" interleaves latency-sensitive
+    # serving work (serving tier, tight queue-wait SLO, diurnal/bursty
+    # arrivals) with long training gangs (prod/batch tiers, Poisson).
+    workload: str = "standard"
+    serving_frac: float = 0.6      # fraction of arrivals that are serving
+    serving_gang_frac: float = 0.3  # of serving: multi-host model replicas
+    serving_duration_mean_s: float = 120.0
+    # Serving queue-wait SLO (virtual s): a *provisioning* SLO — how long
+    # a serving pod may pend before holding chips — not request latency.
+    # One minute is tight against training gangs whose mean duration is
+    # ~10x that, yet long enough that misses measure real contention,
+    # not same-instant placement jitter.
+    slo_wait_s: float = 60.0
+    diurnal_period_s: float = 1200.0  # serving arrival-rate cycle
+    diurnal_amp: float = 0.6          # peak-to-mean modulation (0..1)
+    train_duration_factor: float = 2.0  # training mean = factor x duration_mean_s
+    prod_train_frac: float = 0.25  # training jobs at the prod (50) tier
 
     def rng(self) -> np.random.Generator:
         # SeedSequence folds the seed on its own axis (the same collision
@@ -106,8 +133,19 @@ class TraceConfig:
     def total_chips(self) -> int:
         return self.n_domains * math.prod(self.domain_dims)
 
+    #: The mixed-workload knobs, dropped from describe() on a standard
+    #: trace so every pre-priority report stays byte-identical (same rule
+    #: as the engine's defrag/chaos records: absent when off).
+    _MIXED_KNOBS = ("workload", "serving_frac", "serving_gang_frac",
+                    "serving_duration_mean_s", "slo_wait_s",
+                    "diurnal_period_s", "diurnal_amp",
+                    "train_duration_factor", "prod_train_frac")
+
     def describe(self) -> dict:
         d = asdict(self)
+        if self.workload == "standard":
+            for k in self._MIXED_KNOBS:
+                d.pop(k, None)
         d.update(n_domains=self.n_domains, hosts_per_domain=self.hosts_per_domain,
                  chips=self.total_chips)
         return d
@@ -156,10 +194,111 @@ def _arrival_times(cfg: TraceConfig, rng: np.random.Generator) -> np.ndarray:
                      "(want 'poisson' or 'bursty')")
 
 
+def _diurnal_times(cfg: TraceConfig, rng: np.random.Generator,
+                   n: int, base_rate: float) -> list[float]:
+    """``n`` arrival times from a non-homogeneous Poisson process whose
+    rate swings sinusoidally around ``base_rate`` (period
+    ``diurnal_period_s``, amplitude ``diurnal_amp``) — the serving
+    traffic shape.  Standard thinning: candidates at the peak rate, each
+    accepted with probability rate(t)/peak; one rng, fixed draw order,
+    so the stream is deterministic per config."""
+    amp = min(max(cfg.diurnal_amp, 0.0), 1.0)
+    peak = base_rate * (1.0 + amp)
+    times: list[float] = []
+    t = 0.0
+    while len(times) < n:
+        t += float(rng.exponential(1.0 / peak))
+        rate = base_rate * (1.0 + amp * math.sin(
+            2.0 * math.pi * t / cfg.diurnal_period_s))
+        if float(rng.random()) * peak <= rate:
+            times.append(t)
+    return times
+
+
+def _generate_mixed(cfg: TraceConfig, rng: np.random.Generator) -> list[JobSpec]:
+    """The ``mixed`` serving+training job stream (tputopo.priority).
+
+    Serving work (``serving_frac`` of arrivals, diurnal arrival rate,
+    short lognormal durations, tier ``serving`` with the ``slo_wait_s``
+    queue-wait SLO): mostly single small-k inference pods, plus
+    ``serving_gang_frac`` multi-host model-replica gangs.  Training work
+    (the rest, Poisson, ``train_duration_factor`` x longer durations):
+    the standard gang vocabulary at the ``prod``/``batch`` tiers —
+    ``prod_train_frac`` of them prod, so tier strictness (prod may evict
+    batch, nothing evicts serving) is exercised, not just asserted.
+    Job names are merged-arrival-order indexed, exactly like the
+    standard stream."""
+    from tputopo.k8s.objects import PRIORITY_TIERS
+
+    n = cfg.arrivals
+    n_serv = int(round(n * min(max(cfg.serving_frac, 0.0), 1.0)))
+    n_train = n - n_serv
+    cph = cfg.chips_per_host
+    serv_rate = cfg.rate_per_s * (n_serv / n) if n else cfg.rate_per_s
+    train_rate = cfg.rate_per_s * (n_train / n) if n else cfg.rate_per_s
+
+    # Draw order is FIXED (serving block, then training block): the
+    # determinism contract is per (seed, config), same as _arrival_times.
+    serv_times = _diurnal_times(cfg, rng, n_serv, max(serv_rate, 1e-9))
+    serv_gang = rng.random(n_serv) < cfg.serving_gang_frac
+    serv_small_k = rng.choice([1, min(2, cph)], size=max(n_serv, 1),
+                              p=[0.7, 0.3])
+    serv_gang_reps = rng.choice([2, 4], size=max(n_serv, 1))
+    serv_dur = rng.lognormal(math.log(cfg.serving_duration_mean_s), 0.6,
+                             max(n_serv, 1))
+
+    train_gaps = rng.exponential(1.0 / max(train_rate, 1e-9),
+                                 max(n_train, 1))
+    train_times = np.cumsum(train_gaps)[:n_train]
+    train_reps = rng.choice(list(cfg.gang_sizes), size=max(n_train, 1))
+    train_dur = rng.lognormal(
+        math.log(cfg.duration_mean_s * cfg.train_duration_factor),
+        cfg.duration_sigma, max(n_train, 1))
+    train_prod = rng.random(max(n_train, 1)) < cfg.prod_train_frac
+    train_multi = rng.random(max(n_train, 1)) < cfg.p_multislice
+    train_ghost = rng.random(max(n_train, 1)) < cfg.ghost_prob
+
+    serving_tier = PRIORITY_TIERS["serving"]
+    prod_tier = PRIORITY_TIERS["prod"]
+    arrivals: list[tuple[float, int, int]] = []  # (t, stream, idx)
+    arrivals += [(t, 0, i) for i, t in enumerate(serv_times)]
+    arrivals += [(float(t), 1, i) for i, t in enumerate(train_times)]
+    arrivals.sort()
+
+    jobs: list[JobSpec] = []
+    for j, (t, stream, i) in enumerate(arrivals):
+        if stream == 0:  # serving
+            if serv_gang[i]:
+                chips, replicas = cph, int(serv_gang_reps[i])
+            else:
+                chips, replicas = int(serv_small_k[i]), 1
+            jobs.append(JobSpec(
+                name=f"job-{j:05d}", arrival_s=round(float(t), 6),
+                chips=chips, replicas=replicas,
+                duration_s=round(float(serv_dur[i]), 6),
+                priority=serving_tier, slo_wait_s=cfg.slo_wait_s))
+        else:  # training gang
+            jobs.append(JobSpec(
+                name=f"job-{j:05d}", arrival_s=round(float(t), 6),
+                chips=cph, replicas=int(train_reps[i]),
+                duration_s=round(float(train_dur[i]), 6),
+                multislice=bool(train_multi[i]), ghost=bool(train_ghost[i]),
+                priority=prod_tier if train_prod[i] else 0))
+    return jobs
+
+
 def generate_trace(cfg: TraceConfig) -> Trace:
     """The deterministic trace for ``cfg`` — one Philox stream, consumed in
     a fixed order, so equal configs give byte-equal traces."""
     rng = cfg.rng()
+    if cfg.workload == "mixed":
+        jobs_mixed = _generate_mixed(cfg, rng)
+        horizon = jobs_mixed[-1].arrival_s if jobs_mixed else 0.0
+        return Trace(config=cfg, jobs=tuple(jobs_mixed),
+                     node_events=tuple(_node_events(cfg, rng, horizon)))
+    if cfg.workload != "standard":
+        raise ValueError(f"unknown workload {cfg.workload!r} "
+                         "(want 'standard' or 'mixed')")
     times = _arrival_times(cfg, rng)
     kinds = rng.choice(4, size=cfg.arrivals,
                        p=np.asarray(cfg.job_mix) / sum(cfg.job_mix))
@@ -192,7 +331,16 @@ def generate_trace(cfg: TraceConfig) -> Trace:
         ))
 
     horizon = float(times[-1]) if cfg.arrivals else 0.0
-    node_events = []
+    return Trace(config=cfg, jobs=tuple(jobs),
+                 node_events=tuple(_node_events(cfg, rng, horizon)))
+
+
+def _node_events(cfg: TraceConfig, rng: np.random.Generator,
+                 horizon: float) -> list[tuple[float, float, int]]:
+    """Fail/repair events over the arrival window — the shared tail of
+    both workload generators (same draw order as the original standard
+    path, so standard traces stay byte-identical)."""
+    node_events: list[tuple[float, float, int]] = []
     if cfg.node_failures > 0 and cfg.nodes > 1:
         fail_ts = np.sort(rng.uniform(0.0, max(horizon, 1.0),
                                       cfg.node_failures))
@@ -201,4 +349,4 @@ def generate_trace(cfg: TraceConfig) -> Trace:
         for ft, victim, rep in zip(fail_ts, victims, repairs):
             node_events.append((round(float(ft), 6),
                                 round(float(ft + rep), 6), int(victim)))
-    return Trace(config=cfg, jobs=tuple(jobs), node_events=tuple(node_events))
+    return node_events
