@@ -1,0 +1,25 @@
+# lint-corpus-relpath: tputopo/corpus/effects_bad.py
+"""KNOWN-BAD effect-purity corpus: the branch-copy launder.
+
+The flow-insensitive nocopy rules walk statements in source order, so
+the copy in the ``if`` branch hides the mutation from them — only the
+per-path CFG analysis sees the uncopied path still reaching ``sort``.
+"""
+
+
+def thin(pods, aggressive):
+    if aggressive:
+        pods = [dict(p) for p in pods]  # copies on THIS path only
+    pods.sort(key=len)  # BAD: mutates the stored list on the other path
+    return pods
+
+
+def stamp(pods):
+    for p in pods:
+        p["seen"] = True  # BAD: store through a view element
+    return pods
+
+
+def caller(api):
+    thin(api.list_nocopy("pods"), False)
+    stamp(api.list_nocopy("pods"))

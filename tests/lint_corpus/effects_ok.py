@@ -1,0 +1,17 @@
+# lint-corpus-relpath: tputopo/corpus/effects_ok.py
+"""Clean twin of effects_bad: copy on EVERY path, or stay read-only."""
+
+
+def thin(pods):
+    pods = [dict(p) for p in pods]  # copy on the one path there is
+    pods.sort(key=len)
+    return pods
+
+
+def census(pods):
+    return sum(1 for p in pods if p.get("seen"))  # read-only
+
+
+def caller(api):
+    thin(api.list_nocopy("pods"))
+    census(api.list_nocopy("pods"))

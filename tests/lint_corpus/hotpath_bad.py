@@ -1,0 +1,19 @@
+# lint-corpus-relpath: tputopo/corpus/hotpath_bad.py
+"""KNOWN-BAD hot-path-scan corpus: a registered root reaching a scan."""
+
+
+class Engine:
+    def __init__(self, api):
+        self.api = api
+
+    # hot-path-root: corpus event loop (one call per event)
+    def run_events(self):
+        while self.step():
+            pass
+
+    def step(self):
+        return self.scan()
+
+    def scan(self):
+        # BAD: full-store read, two hops from the declared hot root
+        return self.api.list_nocopy("pods")

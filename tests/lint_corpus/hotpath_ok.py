@@ -1,0 +1,22 @@
+# lint-corpus-relpath: tputopo/corpus/hotpath_ok.py
+"""Clean twin of hotpath_bad: indexed reads on the hot path; the full
+scan exists but only off-path (cold setup) — reachability matters."""
+
+
+class Engine:
+    def __init__(self, api):
+        self.api = api
+
+    # hot-path-root: corpus event loop (one call per event)
+    def run_events(self):
+        while self.step():
+            pass
+
+    def step(self):
+        # O(result) indexed lookup — not a store scan
+        return self.api.list_by_meta("pods", "gang", "g1")
+
+    def cold_rebuild(self):
+        # The same primitive OFF the hot path is fine: this is the
+        # startup/recovery shape, not per-event work.
+        return self.api.list_nocopy("pods")

@@ -1,0 +1,37 @@
+# lint-corpus-relpath: tputopo/corpus/lockset_bad.py
+"""KNOWN-BAD lockset corpus: every construct here must be flagged."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # guarded-by: _lock
+        self._cache = {}  # shared, deliberately unannotated
+
+    # thread-root: corpus worker thread
+    def rmw_across_regions(self):
+        with self._lock:
+            n = self._n
+        # lock dropped: a concurrent writer in this window is lost
+        with self._lock:
+            self._n = n + 1  # BAD: non-atomic read-modify-write
+
+    # thread-root: corpus worker thread
+    def unguarded_on_one_path(self, flag):
+        if flag:
+            with self._lock:
+                return self._n
+        return self._n  # BAD: read with no lock on this path
+
+    def helper(self):  # holds-lock: _lock
+        self._n += 1
+
+    # thread-root: corpus worker thread
+    def broken_claim(self):
+        self.helper()  # BAD: claims _lock held, caller never takes it
+
+    # thread-root: corpus worker thread
+    def unannotated_mutation(self):
+        self._cache.pop("k", None)  # BAD: lock-free container mutation

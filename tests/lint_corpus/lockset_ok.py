@@ -1,0 +1,38 @@
+# lint-corpus-relpath: tputopo/corpus/lockset_ok.py
+"""Clean twin of lockset_bad: same shapes, contracts honored."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # guarded-by: _lock
+        self._cache = {}  # guarded-by: _lock
+
+    # thread-root: corpus worker thread
+    def rmw_one_region(self):
+        with self._lock:
+            n = self._n
+            self._n = n + 1  # same region: atomic under the lock
+
+    # thread-root: corpus worker thread
+    def guarded_on_all_paths(self, flag):
+        if flag:
+            with self._lock:
+                return self._n
+        with self._lock:
+            return self._n
+
+    def helper(self):  # holds-lock: _lock
+        self._n += 1
+
+    # thread-root: corpus worker thread
+    def honored_claim(self):
+        with self._lock:
+            self.helper()  # the claim is established here
+
+    # thread-root: corpus worker thread
+    def guarded_mutation(self):
+        with self._lock:
+            self._cache.pop("k", None)

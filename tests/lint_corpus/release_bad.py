@@ -1,0 +1,33 @@
+# lint-corpus-relpath: tputopo/corpus/release_bad.py
+"""KNOWN-BAD release-on-all-paths corpus."""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.budget = 3
+
+    def leaky_span(self, span, risky):
+        span.__enter__()
+        risky()  # raises -> exits without __exit__
+        span.__exit__(None, None, None)
+
+    def leaky_acquire(self, risky):
+        self._lock.acquire()
+        risky()  # raises -> the release below never runs
+        self._lock.release()
+
+    def early_return_leak(self, span, flag):
+        span.__enter__()
+        if flag:
+            return None  # BAD: returns without __exit__
+        span.__exit__(None, None, None)
+        return True
+
+    def clobbered_budget(self, risky):
+        saved = self.budget
+        self.budget = 99
+        risky()  # raises -> the restore below never runs
+        self.budget = saved

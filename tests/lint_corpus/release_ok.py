@@ -1,0 +1,39 @@
+# lint-corpus-relpath: tputopo/corpus/release_ok.py
+"""Clean twin of release_bad: with / try-finally close on every path."""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.budget = 3
+
+    def with_span(self, span, risky):
+        with span:
+            risky()
+
+    def finally_acquire(self, risky):
+        self._lock.acquire()
+        try:
+            risky()
+        finally:
+            self._lock.release()
+
+    def finally_span(self, span, flag, risky):
+        span.__enter__()
+        try:
+            if flag:
+                return None
+            risky()
+        finally:
+            span.__exit__(None, None, None)
+        return True
+
+    def restored_budget(self, risky):
+        saved = self.budget
+        self.budget = 99
+        try:
+            risky()
+        finally:
+            self.budget = saved

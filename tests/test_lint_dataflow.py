@@ -1,0 +1,801 @@
+"""The path-sensitive dataflow engine (ISSUE 10): CFG construction
+fixtures, worklist fixpoint convergence, the four new rules' TP/FP
+fixtures, the seeded known-bad corpus under tests/lint_corpus/, the new
+CLI surfaces (--explain, rule_version/by_rule JSON, dependency-aware
+--changed-only), and the lint-runtime perf smoke (slow tier).
+"""
+
+from __future__ import annotations
+
+import ast
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+import pytest
+
+from tputopo.lint import (EffectPurityChecker, HotPathChecker,
+                          LocksetChecker, ReleasePathsChecker,
+                          default_checkers)
+from tputopo.lint.cfg import build_cfg, own_exprs
+from tputopo.lint.core import LintRun
+from tputopo.lint.dataflow import run_forward
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+CORPUS = REPO_ROOT / "tests" / "lint_corpus"
+
+
+def lint_sources(checkers, *sources: tuple[str, str]):
+    run = LintRun(checkers,
+                  known_rules={c.rule for c in default_checkers()})
+    for relpath, src in sources:
+        run.add_source(relpath, textwrap.dedent(src))
+    return run.finish(), run
+
+
+def cfg_of(src: str):
+    tree = ast.parse(textwrap.dedent(src))
+    fn = next(n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef))
+    return build_cfg(fn)
+
+
+def kinds(cfg) -> list[str]:
+    return [n.kind for n in cfg.nodes]
+
+
+# ---- CFG construction fixtures -----------------------------------------------
+
+class TestCFGConstruction:
+    def test_straight_line(self):
+        cfg = cfg_of("""
+            def f():
+                a = 1
+                b = 2
+                return a + b
+        """)
+        # entry -> a -> b -> return -> exit, no branches
+        stmts = [n for n in cfg.nodes if n.kind == "stmt"]
+        assert len(stmts) == 3
+        assert cfg.entry.succs[0] is stmts[0]
+        assert stmts[2].succs == [cfg.exit]
+
+    def test_branch_joins(self):
+        cfg = cfg_of("""
+            def f(x):
+                if x:
+                    a = 1
+                else:
+                    a = 2
+                return a
+        """)
+        test = next(n for n in cfg.nodes if n.kind == "test")
+        assert len(test.succs) == 2  # both arms
+        ret = next(n for n in cfg.nodes
+                   if isinstance(n.stmt, ast.Return))
+        preds = cfg.preds_map()[ret]
+        assert len(preds) == 2  # the arms join at the return
+
+    def test_if_without_else_falls_through(self):
+        cfg = cfg_of("""
+            def f(x):
+                if x:
+                    a = 1
+                return x
+        """)
+        test = next(n for n in cfg.nodes if n.kind == "test")
+        ret = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Return))
+        # condition-false edge reaches the return directly
+        assert ret in test.succs
+
+    def test_loop_back_edge_and_break(self):
+        cfg = cfg_of("""
+            def f(xs):
+                for x in xs:
+                    if x:
+                        break
+                    y = x
+                return 1
+        """)
+        head = next(n for n in cfg.nodes if n.kind == "test"
+                    and isinstance(n.stmt, ast.For))
+        body_assign = next(n for n in cfg.nodes
+                           if isinstance(n.stmt, ast.Assign))
+        assert head in body_assign.succs  # back edge
+        brk = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Break))
+        ret = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Return))
+        assert ret in brk.succs  # break jumps past the loop
+
+    def test_with_enter_exit_shape(self):
+        cfg = cfg_of("""
+            def f(lock, risky):
+                with lock:
+                    risky()
+                return 1
+        """)
+        assert kinds(cfg).count("with_eval") == 1
+        assert kinds(cfg).count("with_enter") == 1
+        # One exit node PER leave kind (fall-through / raise / return /
+        # continue) so no leave fabricates another's path; unused ones
+        # are unreachable orphans.
+        assert kinds(cfg).count("with_exit") == 4
+        ev = next(n for n in cfg.nodes if n.kind == "with_eval")
+        enter = next(n for n in cfg.nodes if n.kind == "with_enter")
+        exits = [n for n in cfg.nodes if n.kind == "with_exit"]
+        assert enter in ev.succs
+        # the body statement leaves through exit nodes — both its
+        # fall-through and its exception edge (CPython runs __exit__ on
+        # the way out)
+        call = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Expr))
+        assert any(x in call.succs for x in exits)
+        assert any(x in call.esuccs for x in exits)
+
+    def test_try_finally_exception_edge(self):
+        cfg = cfg_of("""
+            def f(risky, cleanup):
+                try:
+                    risky()
+                finally:
+                    cleanup()
+                return 1
+        """)
+        risky = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Expr)
+                     and isinstance(n.stmt.value, ast.Call)
+                     and n.stmt.value.func.id == "risky")
+        cleanup = next(n for n in cfg.nodes
+                       if isinstance(n.stmt, ast.Expr)
+                       and isinstance(n.stmt.value, ast.Call)
+                       and n.stmt.value.func.id == "cleanup")
+        # the raise path out of the try body funnels into the finally
+        exc_targets = risky.esuccs
+        assert exc_targets, "risky() must have an exception edge"
+        # finally's exits reach BOTH the fall-through and the re-raise
+        assert cfg.exit in cleanup.succs or any(
+            cfg.exit in s.succs for s in cleanup.succs)
+
+    def test_try_except_dispatch(self):
+        cfg = cfg_of("""
+            def f(risky):
+                try:
+                    risky()
+                except ValueError:
+                    return 1
+                except KeyError:
+                    return 2
+                return 3
+        """)
+        handlers = [n for n in cfg.nodes if n.kind == "handler"]
+        assert len(handlers) == 2
+        risky = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Expr))
+        for h in handlers:
+            assert h in risky.esuccs  # dispatch to every handler
+
+    def test_early_return_reaches_exit(self):
+        cfg = cfg_of("""
+            def f(x):
+                if x:
+                    return 1
+                y = 2
+                return y
+        """)
+        rets = [n for n in cfg.nodes if isinstance(n.stmt, ast.Return)]
+        assert len(rets) == 2
+        for r in rets:
+            assert cfg.exit in r.succs
+
+    def test_own_exprs_scopes_to_node(self):
+        cfg = cfg_of("""
+            def f(x):
+                if x > 0:
+                    y = 1
+        """)
+        test = next(n for n in cfg.nodes if n.kind == "test")
+        # the test node owns only its condition, never the body
+        exprs = own_exprs(test)
+        assert len(exprs) == 1 and isinstance(exprs[0], ast.Compare)
+
+
+# ---- worklist fixpoint -------------------------------------------------------
+
+class _ReachingSet:
+    """Toy may-analysis: union of labels seen on some path."""
+
+    def entry_fact(self):
+        return frozenset()
+
+    def join(self, a, b):
+        return a | b
+
+    def transfer(self, node, fact):
+        if node.kind == "stmt" and isinstance(node.stmt, ast.Assign):
+            return fact | {node.stmt.targets[0].id}
+        return fact
+
+
+class TestDataflow:
+    def test_diamond_joins_both_arms(self):
+        """Lockset-style convergence on a diamond CFG: the join point
+        must see the union (may) of both arms, each arm only its own."""
+        cfg = cfg_of("""
+            def f(c):
+                if c:
+                    a = 1
+                else:
+                    b = 2
+                z = 3
+        """)
+        facts = run_forward(cfg, _ReachingSet())
+        z = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Assign)
+                 and n.stmt.targets[0].id == "z")
+        assert facts[z.idx] == {"a", "b"}
+        exit_fact = facts[cfg.exit.idx]
+        assert exit_fact == {"a", "b", "z"}
+
+    def test_loop_converges(self):
+        cfg = cfg_of("""
+            def f(xs):
+                t = 0
+                while xs:
+                    t = 1
+                return t
+        """)
+        facts = run_forward(cfg, _ReachingSet())
+        assert facts[cfg.exit.idx] == {"t"}
+
+    def test_visit_runs_once_per_reachable_node(self):
+        cfg = cfg_of("""
+            def f(c):
+                while c:
+                    a = 1
+        """)
+        seen = []
+        run_forward(cfg, _ReachingSet(),
+                    visit=lambda n, fact: seen.append(n.idx))
+        assert len(seen) == len(set(seen))  # once each, loop or not
+
+    def test_lockset_diamond_must_intersection(self):
+        """The real lockset join on a diamond: a lock taken on only ONE
+        arm is NOT held at the join — the must-intersection semantics
+        the race findings rest on."""
+        findings, _ = lint_sources(
+            [LocksetChecker()],
+            ("tputopo/fix/diamond.py", """\
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._n = 0  # guarded-by: _lock
+
+                    # thread-root: fixture
+                    def f(self, c):
+                        if c:
+                            self._lock.acquire()
+                        self._n = 1
+                        if c:
+                            self._lock.release()
+            """))
+        locky = [f for f in findings if f.rule == "lockset"]
+        assert any("self._n" in f.message and "no declared guard"
+                   in f.message for f in locky), [f.render()
+                                                  for f in findings]
+
+
+# ---- rule fixtures (inline) --------------------------------------------------
+
+class TestLocksetFixtures:
+    def test_holds_lock_claim_checked_at_call_site(self):
+        findings, _ = lint_sources(
+            [LocksetChecker()],
+            ("tputopo/fix/claims.py", """\
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._n = 0  # guarded-by: _lock
+
+                    def helper(self):  # holds-lock: _lock
+                        self._n += 1
+
+                    # thread-root: fixture
+                    def bad(self):
+                        self.helper()
+
+                    # thread-root: fixture
+                    def good(self):
+                        with self._lock:
+                            self.helper()
+            """))
+        msgs = [f for f in findings if "holds-lock" in f.message]
+        assert len(msgs) == 1 and msgs[0].line == 13, \
+            [f.render() for f in findings]
+
+    def test_exception_path_releases_lock(self):
+        """A with-block's exception edge releases the lock — an access
+        in the handler is NOT covered by the with above it."""
+        findings, _ = lint_sources(
+            [LocksetChecker()],
+            ("tputopo/fix/excrel.py", """\
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._n = 0  # guarded-by: _lock
+
+                    # thread-root: fixture
+                    def f(self, risky):
+                        try:
+                            with self._lock:
+                                risky()
+                        except ValueError:
+                            self._n = 0
+            """))
+        assert any(f.rule == "lockset" and f.line == 14
+                   for f in findings), [f.render() for f in findings]
+
+    def test_wait_region_is_released_by_the_with_exit(self):
+        """Review regression: Condition.wait() re-regions the hold, and
+        the region must STILL belong to its with — an access after the
+        block is lock-free and must be flagged, wait or no wait."""
+        findings, _ = lint_sources(
+            [LocksetChecker()],
+            ("tputopo/fix/waitrel.py", """\
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._cond = threading.Condition(self._lock)
+                        self._items = {}  # guarded-by: _lock|_cond
+
+                    # thread-root: fixture
+                    def f(self):
+                        with self._cond:
+                            self._cond.wait()
+                        self._items["k"] = 1
+            """))
+        assert any(f.rule == "lockset" and f.line == 13
+                   for f in findings), [f.render() for f in findings]
+
+    def test_tuple_rebind_kills_rmw_taint(self):
+        """Review regression: `v, other = ...` rebinds v — the stale
+        guarded-read taint must die with it, no spurious RMW."""
+        findings, _ = lint_sources(
+            [LocksetChecker()],
+            ("tputopo/fix/tuplekill.py", """\
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._ctr = 0  # guarded-by: _lock
+
+                    def fresh(self):
+                        return 0
+
+                    # thread-root: fixture
+                    def f(self):
+                        with self._lock:
+                            v = self._ctr
+                        with self._lock:
+                            v, other = self.fresh(), 1
+                            self._ctr = v + 1
+            """))
+        assert not any("non-atomic" in f.message for f in findings), \
+            [f.render() for f in findings]
+
+    def test_thread_target_resolution_failure_is_a_finding(self):
+        findings, _ = lint_sources(
+            [LocksetChecker()],
+            ("tputopo/fix/roots.py", """\
+                import threading
+
+                class C:
+                    def __init__(self, other):
+                        self._lock = threading.Lock()
+                        self.other = other
+
+                    def start(self):
+                        threading.Thread(target=self.other.run).start()
+            """))
+        assert any("thread root could not be resolved" in f.message
+                   for f in findings), [f.render() for f in findings]
+
+
+class TestReleasePathsFixtures:
+    def test_paired_acquire_spanning_a_with_is_clean(self):
+        """Review regression: a correctly paired acquire/release with an
+        unrelated non-raising `with` in between must not be flagged —
+        the with's exit node must not fabricate a path to the function
+        exit."""
+        findings, _ = lint_sources(
+            [ReleasePathsChecker()],
+            ("tputopo/fix/span.py", """\
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def f(self, span):
+                        self._lock.acquire()
+                        with span:
+                            pass
+                        self._lock.release()
+            """))
+        assert findings == [], [f.render() for f in findings]
+
+    def test_return_inside_with_still_leaks_outer_obligation(self):
+        """...but a real `return` inside the with DOES leave the
+        function, and an open obligation from before the with must
+        still be flagged on that path."""
+        findings, _ = lint_sources(
+            [ReleasePathsChecker()],
+            ("tputopo/fix/span2.py", """\
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def f(self, span, flag):
+                        self._lock.acquire()
+                        with span:
+                            if flag:
+                                return None
+                        self._lock.release()
+            """))
+        assert [f.line for f in findings] == [8], \
+            [f.render() for f in findings]
+
+    def test_break_through_try_finally_releases(self):
+        """Review regression: break/continue inside try/finally route
+        THROUGH the finally — a finally-released acquire broken out of
+        a loop is correctly paired, not a leak."""
+        findings, _ = lint_sources(
+            [ReleasePathsChecker()],
+            ("tputopo/fix/brkfin.py", """\
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def f(self, items, work):
+                        for x in items:
+                            self._lock.acquire()
+                            try:
+                                work(x)
+                                break
+                            finally:
+                                self._lock.release()
+            """))
+        assert findings == [], [f.render() for f in findings]
+
+    def test_restore_obligation_needs_a_dominating_save(self):
+        """Review regression: an unrelated write to a saved-elsewhere
+        attribute, on a branch that never saved, is NOT an obligation."""
+        findings, _ = lint_sources(
+            [ReleasePathsChecker()],
+            ("tputopo/fix/saves.py", """\
+                class C:
+                    def __init__(self):
+                        self.budget = 3
+
+                    def f(self, fast, work):
+                        if fast:
+                            self.budget = 1  # no save on this path
+                            return work()
+                        saved = self.budget
+                        self.budget = 99
+                        try:
+                            return work()
+                        finally:
+                            self.budget = saved
+            """))
+        assert findings == [], [f.render() for f in findings]
+
+    def test_acquire_without_finally_flagged_with_form_clean(self):
+        findings, _ = lint_sources(
+            [ReleasePathsChecker()],
+            ("tputopo/fix/rel.py", """\
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def bad(self, risky):
+                        self._lock.acquire()
+                        risky()
+                        self._lock.release()
+
+                    def good(self, risky):
+                        self._lock.acquire()
+                        try:
+                            risky()
+                        finally:
+                            self._lock.release()
+
+                    def best(self, risky):
+                        with self._lock:
+                            risky()
+            """))
+        assert [f.line for f in findings] == [8], \
+            [f.render() for f in findings]
+
+
+class TestEffectPurityFixtures:
+    def test_branch_copy_does_not_launder(self):
+        """The case the flow-insensitive rules MISS: a copy in one
+        branch, mutation after the join — flagged per-path here."""
+        findings, _ = lint_sources(
+            [EffectPurityChecker()],
+            ("tputopo/fix/eff.py", """\
+                def thin(pods, aggressive):
+                    if aggressive:
+                        pods = [dict(p) for p in pods]
+                    pods.sort(key=len)
+                    return pods
+
+                def clean(pods):
+                    pods = [dict(p) for p in pods]
+                    pods.sort(key=len)
+                    return pods
+
+                def caller(api):
+                    thin(api.list_nocopy("pods"), False)
+                    clean(api.list_nocopy("pods"))
+            """))
+        assert [f.line for f in findings] == [4], \
+            [f.render() for f in findings]
+        assert "pods" in findings[0].message
+
+    def test_interprocedural_receive_chain(self):
+        """The view flows caller -> a -> b; the mutation two hops deep
+        is still attributed."""
+        findings, _ = lint_sources(
+            [EffectPurityChecker()],
+            ("tputopo/fix/chain.py", """\
+                def b(items):
+                    items.append(1)
+
+                def a(items):
+                    b(items)
+
+                def caller(api):
+                    a(api.list_nocopy("pods"))
+            """))
+        assert any(f.line == 2 for f in findings), \
+            [f.render() for f in findings]
+
+
+class TestHotPathFixtures:
+    def test_directive_root_and_reachability(self):
+        findings, _ = lint_sources(
+            [HotPathChecker()],
+            ("tputopo/fix/hot.py", """\
+                class E:
+                    def __init__(self, api):
+                        self.api = api
+
+                    # hot-path-root: fixture loop
+                    def run(self):
+                        self.step()
+
+                    def step(self):
+                        return self.api.list_nocopy("pods")
+
+                    def cold(self):
+                        return self.api.list_nocopy("pods")
+            """))
+        assert [f.line for f in findings] == [10], \
+            [f.render() for f in findings]
+        assert "E.run -> E.step" in findings[0].message
+
+    def test_virtual_dispatch_reaches_overrides(self):
+        """A call resolving to a base method also reaches subclass
+        overrides — the polymorphism the sim's policy.place hides
+        behind."""
+        findings, _ = lint_sources(
+            [HotPathChecker()],
+            ("tputopo/fix/virt.py", """\
+                class Base:
+                    def place(self):
+                        return None
+
+                class Impl(Base):
+                    def __init__(self, api):
+                        self.api = api
+
+                    def place(self):
+                        return self.api.list_nocopy("pods")
+
+                class E:
+                    def __init__(self, p: Base):
+                        self.p = p
+
+                    # hot-path-root: fixture loop
+                    def run(self):
+                        self.p.place()
+            """))
+        assert any(f.line == 10 for f in findings), \
+            [f.render() for f in findings]
+
+
+# ---- the seeded corpus -------------------------------------------------------
+
+def _corpus_sources(name: str):
+    path = CORPUS / name
+    text = path.read_text(encoding="utf-8")
+    first = text.splitlines()[0]
+    assert first.startswith("# lint-corpus-relpath:"), name
+    return first.split(":", 1)[1].strip(), text
+
+
+CORPUS_RULES = [
+    ("lockset", LocksetChecker, "lockset"),
+    ("release-on-all-paths", ReleasePathsChecker, "release"),
+    ("effect-purity", EffectPurityChecker, "effects"),
+    ("hot-path-scan", HotPathChecker, "hotpath"),
+]
+
+
+class TestCorpus:
+    @pytest.mark.parametrize("rule,checker_cls,stem",
+                             CORPUS_RULES,
+                             ids=[r for r, _, _ in CORPUS_RULES])
+    def test_bad_corpus_fires(self, rule, checker_cls, stem):
+        rel, src = _corpus_sources(f"{stem}_bad.py")
+        findings, _ = lint_sources([checker_cls()], (rel, src))
+        mine = [f for f in findings if f.rule == rule]
+        bad_lines = [i + 1 for i, line in enumerate(src.splitlines())
+                     if "# BAD" in line or "# raises" in line]
+        assert mine, f"{stem}_bad.py produced no {rule} findings"
+        # every marked line is within one construct of a finding
+        flagged = {f.line for f in mine}
+        for line in bad_lines:
+            assert any(abs(line - fl) <= 2 for fl in flagged), (
+                f"{stem}_bad.py:{line} marked BAD but not flagged; "
+                f"flagged={sorted(flagged)}")
+
+    @pytest.mark.parametrize("rule,checker_cls,stem",
+                             CORPUS_RULES,
+                             ids=[r for r, _, _ in CORPUS_RULES])
+    def test_ok_corpus_stays_quiet(self, rule, checker_cls, stem):
+        rel, src = _corpus_sources(f"{stem}_ok.py")
+        findings, _ = lint_sources([checker_cls()], (rel, src))
+        mine = [f for f in findings if f.rule == rule]
+        assert mine == [], [f.render() for f in mine]
+
+    def test_corpus_is_excluded_from_discovery(self):
+        from tputopo.lint.core import discover_files
+
+        rels = {rel for _, rel in discover_files(REPO_ROOT)}
+        assert not any("lint_corpus" in r for r in rels)
+        # ...but the files exist and parse (the tests above depend on it)
+        assert (CORPUS / "lockset_bad.py").exists()
+
+
+# ---- CLI: --explain / rule_version / dependency-aware --changed-only ---------
+
+def _cli(*args: str, cwd: Path = REPO_ROOT):
+    return subprocess.run([sys.executable, "-m", "tputopo.lint", *args],
+                          capture_output=True, text=True, cwd=str(cwd),
+                          timeout=300)
+
+
+class TestCliAdditions:
+    def test_explain_each_new_rule(self):
+        for rule in ("lockset", "release-on-all-paths", "effect-purity",
+                     "hot-path-scan"):
+            res = _cli("--explain", rule)
+            assert res.returncode == 0, res.stderr
+            out = res.stdout
+            assert "contract:" in out and "directives" in out \
+                and "example:" in out, out
+            assert rule in out
+
+    def test_explain_covers_every_rule(self):
+        for c in default_checkers():
+            res = _cli("--explain", c.rule)
+            assert res.returncode == 0, (c.rule, res.stderr)
+
+    def test_explain_unknown_rule_exits_2(self):
+        res = _cli("--explain", "no-such-rule")
+        assert res.returncode == 2
+        assert "unknown rule" in res.stderr
+
+    def test_changed_only_is_dependency_aware(self, tmp_path):
+        """Touching a file re-checks its transitive CALLERS: a violation
+        in an unchanged caller caused by the changed callee is still
+        reported.  (cwd stays the real checkout so the module imports;
+        --root points at the throwaway repo.)"""
+        repo = tmp_path / "repo"
+        (repo / "tputopo" / "pkg").mkdir(parents=True)
+        (repo / "tputopo" / "__init__.py").write_text("")
+        (repo / "tputopo" / "pkg" / "__init__.py").write_text("")
+        # callee.py returns a nocopy view (laundering helper)...
+        (repo / "tputopo" / "pkg" / "callee.py").write_text(textwrap.dedent(
+            """\
+            def grab(api):
+                return api.list_nocopy("pods")
+            """))
+        # ...caller.py (NOT changed below) mutates through it.
+        (repo / "tputopo" / "pkg" / "caller.py").write_text(textwrap.dedent(
+            """\
+            from tputopo.pkg.callee import grab
+
+            def use(api):
+                grab(api).append(1)
+            """))
+        subprocess.run(["git", "init", "-q"], cwd=repo, check=True)
+        subprocess.run(["git", "add", "-A"], cwd=repo, check=True)
+        subprocess.run(["git", "-c", "user.email=t@t", "-c",
+                        "user.name=t", "commit", "-qm", "seed"],
+                       cwd=repo, check=True)
+        # change ONLY the callee
+        (repo / "tputopo" / "pkg" / "callee.py").write_text(textwrap.dedent(
+            """\
+            def grab(api):
+                # changed comment
+                return api.list_nocopy("pods")
+            """))
+        res = _cli("--changed-only", "--root", str(repo))
+        assert res.returncode == 1, res.stdout + res.stderr
+        # findings in caller.py survive the filter: it is a dependent
+        # file even though git did not see it change
+        assert "caller.py" in res.stdout, res.stdout
+        assert "dependent files" in res.stderr, res.stderr
+
+    def test_changed_only_json_is_self_consistent(self, tmp_path):
+        """Review regression: under --changed-only the JSON's by_rule
+        counts must describe the FILTERED document, not the whole-tree
+        run — count==0 with by_rule claiming findings would contradict
+        itself."""
+        import json as _json
+
+        repo = tmp_path / "repo"
+        (repo / "tputopo" / "pkg").mkdir(parents=True)
+        (repo / "tputopo" / "__init__.py").write_text("")
+        (repo / "tputopo" / "pkg" / "__init__.py").write_text("")
+        # A violation in a file UNRELATED to what changes below.
+        (repo / "tputopo" / "pkg" / "dirty.py").write_text(
+            "x = 1  # tpulint: disable=nocopy\n")
+        (repo / "tputopo" / "pkg" / "quiet.py").write_text("y = 2\n")
+        subprocess.run(["git", "init", "-q"], cwd=repo, check=True)
+        subprocess.run(["git", "add", "-A"], cwd=repo, check=True)
+        subprocess.run(["git", "-c", "user.email=t@t", "-c",
+                        "user.name=t", "commit", "-qm", "seed"],
+                       cwd=repo, check=True)
+        (repo / "tputopo" / "pkg" / "quiet.py").write_text("y = 3\n")
+        res = _cli("--changed-only", "--output", "json", "--root",
+                   str(repo))
+        doc = _json.loads(res.stdout)
+        assert doc["count"] == 0, doc["findings"]  # dirty.py filtered out
+        total_by_rule = sum(v["findings"] for v in doc["by_rule"].values())
+        assert total_by_rule == 0, doc["by_rule"]
+
+
+# ---- perf smoke (slow tier) --------------------------------------------------
+
+@pytest.mark.slow
+def test_full_repo_wall_under_budget():
+    """Perf smoke (slow tier): all rules over the whole repo share ONE
+    parse and ONE call-graph build, and the wall must stay under ~6 s
+    (best of 2 — the ISSUE 10 budget that keeps the lint job a gate,
+    not a tax).  The JSON's by_rule timings make a regression
+    attributable to its rule."""
+    from tputopo.lint import run_lint
+
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        findings, run = run_lint(root=REPO_ROOT)
+        best = min(best, time.perf_counter() - t0)
+    assert findings == []
+    assert best < 6.0, (best, {r: s["duration_s"]
+                               for r, s in run.rule_stats.items()})

@@ -138,6 +138,7 @@ class DefragController:
         shapes."""
         if self.target_chips > 0:
             return target_demands(state, self.target_chips)
+        # tpulint: disable=hot-path-scan -- amortized: one pending-pod scan per defrag PERIOD (cooldown/hysteresis-gated controller cycle), not per scheduling verb
         return pending_demand(list_pods_nocopy(state.api))
 
     #: In-flight entries older than this many cooldown periods (min. the

@@ -52,6 +52,7 @@ class AssumptionGC:
         """One pass: clear assignments for expired assumptions (and their
         whole gangs).  Returns the pod names released this pass."""
         t0 = self._wall()
+        # tpulint: disable=hot-path-scan -- amortized: one O(pods) sync per TTL-period sweep (gc_period = assume_ttl/2), the documented cost of durable assumption reclaim
         state = ClusterState(self.api, assume_ttl_s=self.assume_ttl_s,
                              clock=self.clock).sync()
         victims: dict[tuple[str, str], None] = {}

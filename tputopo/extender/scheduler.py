@@ -308,7 +308,11 @@ class ExtenderScheduler:
         # against the authoritative state before reuse (planned chips still
         # free, bound members consistent) — plan stability across a gang's
         # bind sequence is exactly the semantics binding wants anyway.
-        self._gang_plan_cache: dict[tuple[str, str], dict] = {}
+        # Guarded: sorts run concurrently on the threaded HTTP server, and
+        # the LRU pop-then-insert refresh is a non-atomic sequence the
+        # lockset rule flagged — _cache_lock serializes it (bind already
+        # nests _bind_lock > _cache_lock, so the order holds).
+        self._gang_plan_cache: dict[tuple[str, str], dict] = {}  # guarded-by: _cache_lock
 
     _GANG_PLAN_CACHE_MAX = 512
 
@@ -492,6 +496,7 @@ class ExtenderScheduler:
             self.metrics.inc("state_full_rebuilds")
             span.count("full_rebuild")
             with span.child("sync"):
+                # tpulint: disable=hot-path-scan -- amortized: the counted cache-miss fallback (state_full_rebuilds); the delta/journal-fold paths above are the steady state
                 state = ClusterState(
                     reader,
                     cost_for_generation=self.config.cost_model,
@@ -516,6 +521,7 @@ class ExtenderScheduler:
         self.metrics.inc("state_full_rebuilds")
         span.count("full_rebuild")
         with span.child("sync"):
+            # tpulint: disable=hot-path-scan -- amortized: counted cache-miss fallback (state_full_rebuilds); bind_from_cache/delta publication keeps this off the per-verb path
             state = ClusterState(
                 self.api,
                 cost_for_generation=self.config.cost_model,
@@ -951,17 +957,22 @@ class ExtenderScheduler:
     def _store_gang_plan(self, gang: tuple[str, str, int], k: int,
                          wanted_gen: str | None, ctx: dict) -> None:
         ns, gid, _ = gang
-        # Pop-then-insert refreshes the dict position (LRU-ish): eviction
-        # below drops the longest-unrefreshed gang, not the most active one.
-        self._gang_plan_cache.pop((ns, gid), None)
-        self._gang_plan_cache[(ns, gid)] = {
-            "k": k, "gen": wanted_gen,
-            # Full remaining plan at plan time; reuse filters out nodes
-            # that bind since consumed, so no per-bind cache surgery.
-            "plan": dict(ctx["plan"]), "order": list(ctx["order"]),
-        }
-        while len(self._gang_plan_cache) > self._GANG_PLAN_CACHE_MAX:
-            self._gang_plan_cache.pop(next(iter(self._gang_plan_cache)))
+        with self._cache_lock:
+            # Pop-then-insert refreshes the dict position (LRU-ish):
+            # eviction below drops the longest-unrefreshed gang, not the
+            # most active one.  The whole sequence holds the lock —
+            # concurrent sorts interleaving the pop and the insert was
+            # exactly the lost-update window the lockset rule flagged.
+            self._gang_plan_cache.pop((ns, gid), None)
+            self._gang_plan_cache[(ns, gid)] = {
+                "k": k, "gen": wanted_gen,
+                # Full remaining plan at plan time; reuse filters out
+                # nodes that bind since consumed, so no per-bind cache
+                # surgery.
+                "plan": dict(ctx["plan"]), "order": list(ctx["order"]),
+            }
+            while len(self._gang_plan_cache) > self._GANG_PLAN_CACHE_MAX:
+                self._gang_plan_cache.pop(next(iter(self._gang_plan_cache)))
 
     def _reuse_gang_plan(self, state: ClusterState,
                          gang: tuple[str, str, int], k: int,
@@ -972,7 +983,11 @@ class ExtenderScheduler:
         members is cheap (informer mirror / in-memory fake); what this
         skips is the planning search itself."""
         ns, gid, size = gang
-        cached = self._gang_plan_cache.get((ns, gid))
+        with self._cache_lock:
+            # The entry value is replaced wholesale on store (never
+            # mutated in place), so holding the lock for the lookup
+            # alone hands back a consistent snapshot.
+            cached = self._gang_plan_cache.get((ns, gid))
         if cached is None or cached["k"] != k or cached["gen"] != wanted_gen:
             return None
         members = self._gang_members(ns, gid, reader=reader, state=state)
@@ -980,7 +995,8 @@ class ExtenderScheduler:
                        if p["spec"].get("nodeName")}
         remaining = size - sum(1 for p in members if p["spec"].get("nodeName"))
         if remaining <= 0:
-            self._gang_plan_cache.pop((ns, gid), None)  # gang fully bound
+            with self._cache_lock:
+                self._gang_plan_cache.pop((ns, gid), None)  # fully bound
             return None
         rem_nodes = [n for n in cached["order"] if n not in bound_nodes]
         # Length equation doubles as the off-plan check: the cached order
@@ -1291,6 +1307,7 @@ class ExtenderScheduler:
 
     # ---- crash recovery ----------------------------------------------------
 
+    # thread-root: startup/crash recovery runs while the informer watch threads are already live (the chaos-injected crash-restart path re-enters here)
     def recover(self) -> dict:
         """Startup/crash recovery: rebuild the assumption cache from API
         truth and resolve every **in-flight gang** atomically.
@@ -1316,7 +1333,7 @@ class ExtenderScheduler:
         with self._cache_lock:
             self._cached_state = None
             self._cached_informer_version = None
-        self._gang_plan_cache.clear()
+            self._gang_plan_cache.clear()
         with self._bind_lock:
             self._unmirrored_binds.clear()
         outcome: dict = {"completed": [], "released": [], "stranded": []}
@@ -1434,6 +1451,10 @@ class ExtenderScheduler:
         with self._bind_lock:
             return self._bind_locked(pod_name, namespace, node_name)
 
+    # The holds-lock claims on the two helpers below are CHECKED by the
+    # lockset rule at every call site, not trusted: bind() above is the
+    # one caller and takes the lock first.
+
     def _repair_write_through(self) -> None:  # holds-lock: _bind_lock
         """Re-attempt the mirror write-through of binds whose read-back
         failed.  Success (or the pod being gone) closes the gap; anything
@@ -1482,7 +1503,7 @@ class ExtenderScheduler:
             return cur
         return None
 
-    def _bind_locked(self, pod_name: str, namespace: str, node_name: str) -> dict:
+    def _bind_locked(self, pod_name: str, namespace: str, node_name: str) -> dict:  # holds-lock: _bind_lock
         tr = self.tracer.start(
             "bind", pod=f"{namespace or 'default'}/{pod_name}",
             node=node_name)
@@ -1604,7 +1625,8 @@ class ExtenderScheduler:
                     # CAS-guarded so a racing Allocate confirm always wins.
                     released = self._release_gang_assumptions(
                         gang[0], gang_id, members=members)
-                    self._gang_plan_cache.pop((gang[0], gang_id), None)
+                    with self._cache_lock:
+                        self._gang_plan_cache.pop((gang[0], gang_id), None)
                     raise BindError(
                         f"gang {gang_id!r} cannot fit ({gang[2]} x {k} "
                         "chips) — binding nothing (all-or-nothing; released "
@@ -1671,98 +1693,98 @@ class ExtenderScheduler:
                     f"api unavailable binding {pod_name}: {e}",
                     reason=("timeout" if isinstance(e, ApiTimeout)
                             else "unavailable")) from e
-        # Manual span (not ``with``): the publish section is a pair of
-        # top-level alternative branches; everything inside either swallows
-        # its exceptions or cannot raise, and the root trace records even
-        # if one slipped through (the span would just report 0 ms).
+        # ``with``-managed span (release-on-all-paths rule): the former
+        # manual __enter__/__exit__ pair leaked the span if anything in
+        # the publish section raised — the with-form closes it on every
+        # path, exception edges included, with identical deterministic
+        # phase counts (wall-ms is telemetry either way).
         pub_span = tr.phase("publish")
-        pub_span.__enter__()
-        if self.informer is not None:
-            # Write-through assume cache: the NEXT sort must see this bind
-            # without waiting a watch round-trip, or it plans against
-            # pre-bind state and hands out already-assigned chips (the
-            # kube-scheduler cache pattern; the API server's CAS stays
-            # authoritative either way).  Prefer the object bind_pod itself
-            # returned (the fake API returns the bound pod — zero extra
-            # RPCs); the real binding subresource returns a Status, so fall
-            # back to a read-back there.
-            new_token = None
-            try:
-                if not (isinstance(bound_obj, dict)
-                        and bound_obj.get("spec", {}).get("nodeName")
-                        and bound_obj.get("metadata", {}).get("resourceVersion")):
-                    bound_obj = self.api.get("pods", pod_name, namespace)
-                new_token = self.informer.observe("pods", bound_obj)
-            # tpulint: disable=except-contract -- deliberate boundary: the bind is already committed; ANY read-back/mirror failure must become an unmirrored-bind gap (repaired later), never a bind error
-            except Exception:
-                # The bind itself already succeeded, so a failed read-back
-                # (deleted pod, transient 5xx, network) must not surface as
-                # a bind error — but until the watch delivers this bind,
-                # the mirror may lack a committed placement, so later binds
-                # must not plan from it (double-booking would pass the
-                # per-pod CAS).  Record the gap; binds go authoritative
-                # until it is repaired (_repair_write_through).
-                self.metrics.inc("bind_observe_errors")
-                self._unmirrored_binds.add((namespace or "default", pod_name))
-            # Delta fast path: when our own write is provably the ONLY
-            # mirror content change since the state was built (observe
-            # returns the post-install token atomically; expected = built
-            # token + 1), publish a copy-on-write clone with this bind
-            # applied instead of invalidating — the next verb reuses it,
-            # and bind stays O(chips) instead of O(pods).
-            published = False
-            if (self.config.state_delta and new_token is not None
-                    and state_token is not None
-                    and state is self._cached_state):
+        with pub_span:
+            if self.informer is not None:
+                # Write-through assume cache: the NEXT sort must see this bind
+                # without waiting a watch round-trip, or it plans against
+                # pre-bind state and hands out already-assigned chips (the
+                # kube-scheduler cache pattern; the API server's CAS stays
+                # authoritative either way).  Prefer the object bind_pod itself
+                # returned (the fake API returns the bound pod — zero extra
+                # RPCs); the real binding subresource returns a Status, so fall
+                # back to a read-back there.
+                new_token = None
                 try:
-                    expected = (str(int(state_token[0]) + 1),)
-                except (ValueError, IndexError):
-                    expected = None
-                if new_token == expected:
-                    new_state = self._bind_delta_state(
-                        state, pod_name, namespace, node_name, placement,
-                        now, gang_id)
-                    if new_state is not None:
-                        new_state = self._carry_state_memos(state, new_state)
-                        with self._cache_lock:
-                            self._cached_state = new_state
-                            self._cached_informer_version = new_token
-                        # _cached_at deliberately NOT refreshed: it stamps
-                        # when occupancy was last judged against the clock
-                        # (assume-TTL expiry happens only at sync), and the
-                        # 5 s age bound must keep holding under sustained
-                        # bind traffic — a delta carries the original
-                        # timestamp forward.
-                        published = True
-                        self.metrics.inc("bind_state_delta")
-            if not published and not (self.config.state_delta
-                                      and state_token is not None
-                                      and state is self._cached_state):
-                # The delta could not apply and the cached state is not an
-                # informer-coherent (state, token) pair the event journal
-                # can fold forward — drop it; the next verb rebuilds from
-                # the (write-through-fresh) mirror.  When the pair IS
-                # coherent at its token (external events merely interleaved
-                # with our bind), it stays: the next verb folds the journal
-                # tail — including this bind's own write-through — in
-                # O(events) instead of re-syncing O(pods).
+                    if not (isinstance(bound_obj, dict)
+                            and bound_obj.get("spec", {}).get("nodeName")
+                            and bound_obj.get("metadata", {}).get("resourceVersion")):
+                        bound_obj = self.api.get("pods", pod_name, namespace)
+                    new_token = self.informer.observe("pods", bound_obj)
+                # tpulint: disable=except-contract -- deliberate boundary: the bind is already committed; ANY read-back/mirror failure must become an unmirrored-bind gap (repaired later), never a bind error
+                except Exception:
+                    # The bind itself already succeeded, so a failed read-back
+                    # (deleted pod, transient 5xx, network) must not surface as
+                    # a bind error — but until the watch delivers this bind,
+                    # the mirror may lack a committed placement, so later binds
+                    # must not plan from it (double-booking would pass the
+                    # per-pod CAS).  Record the gap; binds go authoritative
+                    # until it is repaired (_repair_write_through).
+                    self.metrics.inc("bind_observe_errors")
+                    self._unmirrored_binds.add((namespace or "default", pod_name))
+                # Delta fast path: when our own write is provably the ONLY
+                # mirror content change since the state was built (observe
+                # returns the post-install token atomically; expected = built
+                # token + 1), publish a copy-on-write clone with this bind
+                # applied instead of invalidating — the next verb reuses it,
+                # and bind stays O(chips) instead of O(pods).
+                published = False
+                if (self.config.state_delta and new_token is not None
+                        and state_token is not None
+                        and state is self._cached_state):
+                    try:
+                        expected = (str(int(state_token[0]) + 1),)
+                    except (ValueError, IndexError):
+                        expected = None
+                    if new_token == expected:
+                        new_state = self._bind_delta_state(
+                            state, pod_name, namespace, node_name, placement,
+                            now, gang_id)
+                        if new_state is not None:
+                            new_state = self._carry_state_memos(state, new_state)
+                            with self._cache_lock:
+                                self._cached_state = new_state
+                                self._cached_informer_version = new_token
+                            # _cached_at deliberately NOT refreshed: it stamps
+                            # when occupancy was last judged against the clock
+                            # (assume-TTL expiry happens only at sync), and the
+                            # 5 s age bound must keep holding under sustained
+                            # bind traffic — a delta carries the original
+                            # timestamp forward.
+                            published = True
+                            self.metrics.inc("bind_state_delta")
+                if not published and not (self.config.state_delta
+                                          and state_token is not None
+                                          and state is self._cached_state):
+                    # The delta could not apply and the cached state is not an
+                    # informer-coherent (state, token) pair the event journal
+                    # can fold forward — drop it; the next verb rebuilds from
+                    # the (write-through-fresh) mirror.  When the pair IS
+                    # coherent at its token (external events merely interleaved
+                    # with our bind), it stays: the next verb folds the journal
+                    # tail — including this bind's own write-through — in
+                    # O(events) instead of re-syncing O(pods).
+                    with self._cache_lock:
+                        self._cached_state = None
+            elif self.config.bind_from_cache:
+                # Informer-less assume cache (single-writer mode): apply our
+                # own bind to the cached derived state so the next verb in the
+                # burst reuses it instead of re-syncing — the cache's coherence
+                # is exactly this delta, since no one else writes assignments.
+                new_state = (self._bind_delta_state(
+                    state, pod_name, namespace, node_name, placement, now,
+                    gang_id) if self.config.state_delta
+                    and state is self._cached_state else None)
+                if new_state is not None:
+                    new_state = self._carry_state_memos(state, new_state)
+                    self.metrics.inc("bind_state_delta")
                 with self._cache_lock:
-                    self._cached_state = None
-        elif self.config.bind_from_cache:
-            # Informer-less assume cache (single-writer mode): apply our
-            # own bind to the cached derived state so the next verb in the
-            # burst reuses it instead of re-syncing — the cache's coherence
-            # is exactly this delta, since no one else writes assignments.
-            new_state = (self._bind_delta_state(
-                state, pod_name, namespace, node_name, placement, now,
-                gang_id) if self.config.state_delta
-                and state is self._cached_state else None)
-            if new_state is not None:
-                new_state = self._carry_state_memos(state, new_state)
-                self.metrics.inc("bind_state_delta")
-            with self._cache_lock:
-                self._cached_state = new_state
-        pub_span.__exit__(None, None, None)
+                    self._cached_state = new_state
 
         decision = {
             "pod": f"{namespace}/{pod_name}",

@@ -117,6 +117,7 @@ class _Handler(BaseHTTPRequestHandler):
             # it apart from api_errors (an apiserver-health signal) and
             # don't answer a dead socket.
             self.scheduler.metrics.inc("http_client_errors")
+        # tpulint: disable=except-contract -- deliberate fail-closed boundary (ignorable=false): ANY unclassified failure must answer 503 with a reason, never drop the socket; classified handling lives in the verbs
         except Exception as e:  # API-server unreachable, etc. — fail closed
             # with a response, not a dropped socket (a real KubeApiClient
             # raises ApiUnavailable/RuntimeError the in-memory fake never
@@ -423,6 +424,7 @@ class ExtenderHTTPServer:
         return self.httpd.server_address[:2]
 
     def start(self) -> "ExtenderHTTPServer":
+        # tpulint: disable=lockset -- serve_forever is stdlib: request handling enters repo code at _Handler.do_*, which ARE enumerated HTTP-handler thread roots
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         name="tputopo-extender", daemon=True)
         self._thread.start()

@@ -91,6 +91,13 @@ class Informer:
         self._synced = {k: threading.Event() for k in kinds}
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # Counter increments are dict-slot read-modify-writes and the
+        # per-kind watch threads share this dict — each ``+= 1`` holds
+        # the mirror lock (the lockset rule flagged the former bare
+        # increments as lost-update races).  The key SET is fixed here,
+        # so lock-free scrape-side iteration (server /metrics) stays
+        # safe; writes are what serialize.
+        # guarded-by: _lock
         self.metrics = {"lists": 0, "watch_events": 0, "relists": 0,
                         "watch_errors": 0, "observes": 0,
                         "unordered_deletes_kept": 0}
@@ -235,7 +242,7 @@ class Informer:
             self._index_rebuild(kind)
             self._rv[kind] = rv
             self._content += 1  # conservative: a relist may change anything
-        self.metrics["lists"] += 1
+            self.metrics["lists"] += 1
         self._synced[kind].set()
 
     def _apply(self, kind: str, event: dict) -> None:
@@ -282,7 +289,7 @@ class Informer:
                         (self._content, kind, event["type"], obj))
             if event.get("rv"):
                 self._rv[kind] = event["rv"]
-        self.metrics["watch_events"] += 1
+            self.metrics["watch_events"] += 1
 
     def _run(self, kind: str) -> None:
         while not self._stop.is_set():
@@ -302,7 +309,8 @@ class Informer:
                         return
                 # Timed out quietly: re-watch from the last seen rv.
             except Gone:
-                self.metrics["relists"] += 1
+                with self._lock:
+                    self.metrics["relists"] += 1
                 self._synced[kind].clear()
             # tpulint: disable=except-contract -- deliberate thread-main-loop boundary: any transport exception class (REST client hangups included) must degrade to backoff+relist, counted as watch_errors, never kill the watch thread
             except Exception:
@@ -310,7 +318,8 @@ class Informer:
                     return
                 # Transport hiccup: back off, then resync from scratch —
                 # the store may have missed events.
-                self.metrics["watch_errors"] += 1
+                with self._lock:
+                    self.metrics["watch_errors"] += 1
                 self._synced[kind].clear()
                 self._stop.wait(self.relist_backoff_s)
 

@@ -16,11 +16,15 @@ from tputopo.lint.core import (Checker, Finding, LintRun, Module,
                                discover_files)
 from tputopo.lint.counters import CounterDriftChecker
 from tputopo.lint.drift import SingleDefChecker
+from tputopo.lint.effects import EffectPurityChecker
 from tputopo.lint.excepts import ExceptContractChecker
+from tputopo.lint.hotpath import HotPathChecker
 from tputopo.lint.lockorder import LockOrderChecker
 from tputopo.lint.locks import LockGuardChecker
+from tputopo.lint.lockset import LocksetChecker
 from tputopo.lint.nocopy import NocopyChecker
 from tputopo.lint.nocopyflow import NocopyFlowChecker
+from tputopo.lint.releasepaths import ReleasePathsChecker
 
 __all__ = [
     "Checker", "Finding", "LintRun", "Module",
@@ -28,6 +32,8 @@ __all__ = [
     "LockGuardChecker", "SingleDefChecker",
     "ClockFlowChecker", "CounterDriftChecker", "ExceptContractChecker",
     "LockOrderChecker", "NocopyFlowChecker",
+    "LocksetChecker", "ReleasePathsChecker", "EffectPurityChecker",
+    "HotPathChecker",
     "default_checkers", "run_lint",
 ]
 
@@ -35,8 +41,9 @@ __all__ = [
 def default_checkers() -> list[Checker]:
     """Fresh instances of every project checker (cross-module checkers
     keep state, so runs must not share instances).  The first five are
-    the per-function rules from PR 7; the last five are the whole-program
-    rules rebased on the shared call graph (lint/callgraph.py)."""
+    the per-function rules from PR 7; the next five are the whole-program
+    call-graph rules from PR 8; the last four are the path-sensitive
+    dataflow rules (lint/cfg.py + lint/dataflow.py)."""
     return [
         DeterminismChecker(),
         ClockDisciplineChecker(),
@@ -48,6 +55,10 @@ def default_checkers() -> list[Checker]:
         NocopyFlowChecker(),
         ExceptContractChecker(),
         CounterDriftChecker(),
+        LocksetChecker(),
+        ReleasePathsChecker(),
+        EffectPurityChecker(),
+        HotPathChecker(),
     ]
 
 

@@ -5,22 +5,31 @@ Usage::
     python -m tputopo.lint [paths...] [--root DIR] [--select r1,r2]
                            [--output text|json|github] [--changed-only]
                            [--show-waived] [--list-rules]
+                           [--explain RULE]
 
 Exit codes: 0 = clean, 1 = findings, 2 = usage error.  With no paths the
 default file set is every ``.py`` under ``tputopo/`` and ``tests/``
-(excluding generated ``*_pb2.py``), which is also what the CI lint job
-runs.
+(excluding generated ``*_pb2.py`` and the deliberately-bad corpus under
+``tests/lint_corpus/``), which is also what the CI lint job runs.
 
-``--output json`` emits one stable, sorted JSON document (the CI lint
-job uploads it as an artifact and asserts ``count == 0``); ``--output
-github`` emits GitHub workflow annotations (``::error file=...``) so
-findings land inline on the PR diff.
+``--output json`` emits one stable, sorted JSON document carrying
+per-rule finding/waived counts and timings (``by_rule``) plus per-rule
+semantic versions (``rule_version``) — the CI lint job uploads it as an
+artifact and asserts ``count == 0``; ``--output github`` emits GitHub
+workflow annotations (``::error file=...``) so findings land inline on
+the PR diff.
 
 ``--changed-only`` filters *findings* to files changed vs. git HEAD
-(unstaged + staged + untracked) for fast local iteration.  The whole
-tree is still parsed — the graph-backed rules are whole-program, so a
-sound finding needs full context either way; only the reporting narrows.
-Outside a git repo (or if git fails) it degrades to the full run.
+(unstaged + staged + untracked) PLUS every file holding a transitive
+caller OR callee of a changed function — the graph-backed rules
+conclude through call edges in both directions (a changed callee moves
+findings in its callers; a changed call site can create findings inside
+an unchanged callee, where effect-purity and hot-path-scan attach).
+The whole tree is still parsed; only the reporting narrows.  Outside a
+git repo (or if git fails) it degrades to the full run.
+
+``--explain <rule>`` prints one rule's contract, its directive/waiver
+syntax, and a real example from this tree.
 """
 
 from __future__ import annotations
@@ -34,6 +43,143 @@ from pathlib import Path
 
 from tputopo.lint import default_checkers, find_repo_root, run_lint
 from tputopo.lint.core import PARSE_RULE, WAIVER_RULE, Finding
+
+#: Per-rule --explain payloads: (directive & waiver syntax, one real
+#: example from this tree).  Rules absent here fall back to the generic
+#: waiver syntax plus their description.
+_RULE_DOC: dict[str, tuple[str, str]] = {
+    "determinism": (
+        "waive: `# tpulint: disable=determinism -- <reason>`; the "
+        "`clock=time.time` default-arg idiom is the structural escape "
+        "hatch (a default is a reference, never a call)",
+        "tputopo/sim/engine.py runs entirely on VirtualClock; the one "
+        "perf_counter feeding the throughput block is waived with the "
+        "documented-exception reason."),
+    "clock": (
+        "no directive; a function TAKING `clock` has promised virtual "
+        "time — route reads through it",
+        "AssumptionGC.sweep judges expiry on self.clock and times "
+        "telemetry on the injected `wall=` hook."),
+    "nocopy": (
+        "waive: `# tpulint: disable=nocopy -- <reason>` (used by the "
+        "digest-guard tests that mutate on purpose)",
+        "ClusterState reads `list_nocopy` views and never stores or "
+        "mutates them; the runtime digest guard enforces the same "
+        "contract in guarded runs."),
+    "lock": (
+        "declare: `self._x = {}  # guarded-by: _lock[|_alt][ (writes)]` "
+        "on the __init__ assignment; helpers assert "
+        "`# holds-lock: _lock` on their def line",
+        "FakeApiServer._store is guarded-by _lock|_watch_cond; every "
+        "accessor holds one or carries holds-lock."),
+    "single-def": (
+        "no directive; contract literals (schema versions, counter "
+        "keep-list, Prometheus prefix) live in ONE defining module",
+        "tputopo/sim/report.py owns the tputopo.sim/v* schema strings; "
+        "a shadow literal anywhere else is a finding."),
+    "lock-order": (
+        "declare: `# lock-order: A._x > B._y` (outermost first) as a "
+        "module comment; `# holds-lock:` seeds entry sets",
+        "scheduler.py pins ExtenderScheduler._bind_lock > _cache_lock "
+        "> Informer._lock > FakeApiServer._lock; the derived "
+        "acquisition graph must stay acyclic and consistent with it."),
+    "clock-flow": (
+        "fix shape: take an injectable `wall=time.perf_counter` "
+        "default-arg hook; waive with a reason otherwise",
+        "ExtenderScheduler verb latency telemetry rides self._wall so "
+        "the sim's virtual-time callers never reach a wall clock."),
+    "nocopy-flow": (
+        "waive: `# tpulint: disable=nocopy-flow -- <reason>` (the three "
+        "shipped waivers are documented read-only handout shims)",
+        "a helper returning api.list(..., copy=False) outside the owner "
+        "modules launders a store view and is flagged at the return."),
+    "except-contract": (
+        "catch the classified vocabulary (ApiUnavailable/ApiTimeout/"
+        "Conflict/NotFound/Gone/BindError); waive deliberate boundaries "
+        "with a reason",
+        "scheduler.py's release-leg observe catches (NotFound, "
+        "ApiUnavailable) instead of Exception."),
+    "counter-drift": (
+        "register every literal counter in tputopo/obs/counters.py "
+        "(COUNTERS or a COUNTER_PREFIXES family); dead entries are "
+        "findings too",
+        "preempt_plans_considered is registered AND incremented in "
+        "ExtenderScheduler.plan_preempt — remove either and the rule "
+        "fires."),
+    "lockset": (
+        "roots: Thread(target=...) sites and do_* handlers are "
+        "auto-discovered; register a new one with `# thread-root: "
+        "<reason>` on the def line.  `# guarded-by:` / `# holds-lock:` "
+        "are CHECKED claims here, not trusted input.  waive: "
+        "`# tpulint: disable=lockset -- <reason>`",
+        "ExtenderScheduler._gang_plan_cache is guarded-by _cache_lock; "
+        "the rule caught its former lock-free LRU pop-then-insert from "
+        "concurrent HTTP sorts, and verifies bind() actually holds "
+        "_bind_lock before calling the # holds-lock helpers."),
+    "release-on-all-paths": (
+        "no directive — the fix IS structural: use `with` or "
+        "try/finally; waive only with a reason",
+        "the bind verb's publish span was a manual __enter__/__exit__ "
+        "pair that leaked on exception paths; it is now "
+        "`with pub_span:`.  The sim's terminal drain restores "
+        "max_backfill_failures in a finally, which satisfies the "
+        "saved-attribute obligation."),
+    "effect-purity": (
+        "no directive; copy (dict(p) / deepcopy) before mutating — on "
+        "EVERY path.  waive: `# tpulint: disable=effect-purity -- "
+        "<reason>`",
+        "plan_preemption receives list_pods_nocopy views and only "
+        "reads them; a helper that copies in one branch but sorts the "
+        "original in the other is flagged at the sort."),
+    "hot-path-scan": (
+        "roots: ExtenderScheduler.sort/bind + SimEngine.run_events; "
+        "register more with `# hot-path-root: <reason>`.  waive with "
+        "the amortization argument: `# tpulint: disable=hot-path-scan "
+        "-- amortized: <why>`",
+        "BaselinePolicy.place's full ClusterState sync after an "
+        "invalidate drop is the ROADMAP fleet-scale bottleneck — "
+        "waived with the ROADMAP pointer, so the debt is CI-tracked."),
+}
+
+
+#: The two meta rules --list-rules advertises; --explain must answer
+#: for them too (they have no Checker instance).
+_META_DOC = {
+    WAIVER_RULE: (
+        "waiver syntax: reason required, named rules must exist, "
+        "unused waivers are findings",
+        "none — meta findings cannot themselves be waived",
+        "`# tpulint: disable=nocopy` (no ` -- reason`) is flagged AND "
+        "suppresses nothing, so fixing the comment never silently "
+        "changes what the run reports."),
+    PARSE_RULE: (
+        "files must parse; a syntax error is reported at its position "
+        "and the file contributes no other findings",
+        "none — fix the syntax",
+        "a file with `def f(:` yields `parse: syntax error: ...` and "
+        "exits 1."),
+}
+
+
+def explain_rule(rule: str, checkers) -> str:
+    if rule in _META_DOC:
+        contract, directives, example = _META_DOC[rule]
+        return (f"{rule} (meta rule)\n"
+                f"\ncontract:\n  {contract}\n"
+                f"\ndirectives / waivers:\n  {directives}\n"
+                f"\nexample:\n  {example}\n")
+    by_rule = {c.rule: c for c in checkers}
+    c = by_rule.get(rule)
+    if c is None:
+        return ""
+    directives, example = _RULE_DOC.get(rule, (
+        "waive: `# tpulint: disable=" + rule + " -- <reason>` (reason "
+        "mandatory; unused waivers are findings)", "see the README "
+        "rule catalog"))
+    return (f"{rule} (v{c.version})\n"
+            f"\ncontract:\n  {c.description}\n"
+            f"\ndirectives / waivers:\n  {directives}\n"
+            f"\nexample:\n  {example}\n")
 
 
 def changed_files(root: Path) -> set[str] | None:
@@ -61,21 +207,68 @@ def changed_files(root: Path) -> set[str] | None:
 
 
 def _as_json(findings: list[Finding], waived: list[Finding],
-             n_files: int, rules: list[str], dt: float) -> str:
+             run, dt: float) -> str:
     def rec(f: Finding) -> dict:
         return {"path": f.path, "line": f.line, "col": f.col,
                 "rule": f.rule, "message": f.message}
 
+    # by_rule counts are recomputed from the lists THIS document carries
+    # (--changed-only narrows findings/waived after the run; reusing the
+    # whole-tree stats would let one document contradict itself), while
+    # duration stays the rule's true whole-run wall.
+    by_rule = {rule: {"findings": 0, "waived": 0,
+                      "duration_s": stats["duration_s"]}
+               for rule, stats in run.rule_stats.items()}
+    for f in findings:
+        if f.rule in by_rule:
+            by_rule[f.rule]["findings"] += 1
+    for f in waived:
+        if f.rule in by_rule:
+            by_rule[f.rule]["waived"] += 1
     doc = {
         "schema": "tputopo.lint/v1",
         "count": len(findings),
         "findings": [rec(f) for f in findings],   # already stably sorted
         "waived": [rec(f) for f in waived],
-        "files": n_files,
-        "rules": sorted(rules),
+        "files": len(run.modules),
+        "rules": sorted(c.rule for c in run.checkers),
+        # Per-rule semantic versions: a finding-count delta across PRs
+        # is attributable (rule changed vs. tree changed) from the
+        # artifact alone.
+        "rule_version": {c.rule: c.version for c in run.checkers},
+        # Per-rule finding/waived counts and wall seconds — the CI
+        # lint job uploads this document as its timing artifact.
+        "by_rule": by_rule,
         "duration_s": round(dt, 3),
     }
     return json.dumps(doc, indent=1, sort_keys=True)
+
+
+def _dependency_closure(run, changed: set[str]) -> set[str]:
+    """``changed`` plus every file holding a transitive CALLER — or
+    CALLEE — of a changed function.  The whole-program rules conclude
+    through call edges in both directions: a changed callee can move
+    findings in its callers (clock-flow, lock-order), and a changed
+    CALL SITE can create findings inside an unchanged callee
+    (effect-purity attaches at the mutation, hot-path-scan at the scan
+    site).  The parse is whole-program either way; only reporting
+    narrows."""
+    from tputopo.lint.callgraph import graph_for
+
+    graph = graph_for(run.modules)
+    seed = {f.key for f in graph.functions.values()
+            if f.relpath in changed}
+    closure = set(graph.fixpoint(seed))          # transitive callers
+    work = list(seed)                            # + transitive callees
+    while work:
+        fn = graph.functions.get(work.pop())
+        if fn is None:
+            continue
+        for site in graph.callees(fn):
+            if site.callee is not None and site.callee.key not in closure:
+                closure.add(site.callee.key)
+                work.append(site.callee.key)
+    return changed | {key[0] for key in closure}
 
 
 def _github_annotation(f: Finding) -> str:
@@ -108,8 +301,15 @@ def main(argv=None) -> int:
                              "annotations")
     parser.add_argument("--changed-only", action="store_true",
                         help="report findings only in files changed vs. "
-                             "git HEAD (full parse either way; falls "
-                             "back to a full report outside a repo)")
+                             "git HEAD plus their transitive callers "
+                             "AND callees (call-graph reachability in "
+                             "both directions; full parse either way; "
+                             "falls back to a full report outside a "
+                             "repo)")
+    parser.add_argument("--explain", metavar="RULE", default=None,
+                        help="print one rule's contract, directive/"
+                             "waiver syntax and a real example, then "
+                             "exit")
     parser.add_argument("--show-waived", action="store_true",
                         help="also print findings suppressed by waivers")
     parser.add_argument("--list-rules", action="store_true",
@@ -121,6 +321,15 @@ def main(argv=None) -> int:
         return int(e.code or 0)
 
     checkers = default_checkers()
+    if args.explain is not None:
+        text = explain_rule(args.explain, checkers)
+        if not text:
+            known = sorted({c.rule for c in checkers} | set(_META_DOC))
+            print(f"error: unknown rule {args.explain!r}; known: "
+                  f"{known}", file=sys.stderr)
+            return 2
+        print(text, end="")
+        return 0
     if args.list_rules:
         meta = [(WAIVER_RULE, "waiver syntax: reason required, rules must "
                               "exist, unused waivers flagged"),
@@ -154,14 +363,16 @@ def main(argv=None) -> int:
         if changed is None:
             scope_note = " (--changed-only: no git, full report)"
         else:
-            findings = [f for f in findings if f.path in changed]
-            waived = [f for f in waived if f.path in changed]
-            scope_note = f" (--changed-only: {len(changed)} changed files)"
+            affected = _dependency_closure(run, changed)
+            findings = [f for f in findings if f.path in affected]
+            waived = [f for f in waived if f.path in affected]
+            scope_note = (f" (--changed-only: {len(changed)} changed + "
+                          f"{len(affected) - len(changed & affected)} "
+                          "dependent files)")
     dt = time.perf_counter() - t0
 
     if args.output == "json":
-        print(_as_json(findings, waived, len(run.modules),
-                       [c.rule for c in run.checkers], dt))
+        print(_as_json(findings, waived, run, dt))
     elif args.output == "github":
         for f in findings:
             print(_github_annotation(f))

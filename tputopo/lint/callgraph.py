@@ -367,6 +367,16 @@ class CallGraph:
     def _infer_attr_types(self) -> None:
         for ci in self.classes.values():
             scope = self.scopes[ci.relpath]
+            # Class-body annotations (``scheduler: ExtenderScheduler``
+            # on a handler class) declare instance attributes as surely
+            # as an __init__ assignment — the HTTP handler's calls into
+            # the scheduler resolve through exactly this.
+            for node in ci.node.body:
+                if isinstance(node, ast.AnnAssign) \
+                        and isinstance(node.target, ast.Name):
+                    got = self._resolve_class_expr(node.annotation, scope)
+                    if got is not None:
+                        ci.attr_types.setdefault(node.target.id, got)
             for meth in ci.methods.values():
                 ann_of = self._param_annotations(meth, scope)
                 for node in ast.walk(meth.node):
@@ -541,6 +551,48 @@ class CallGraph:
                         files: tuple[str, ...] = ()) -> list[FunctionInfo]:
         return [f for f in self.functions.values()
                 if f.relpath.startswith(prefixes) or f.relpath in files]
+
+    def closure_with_parents(self, roots, expand=None
+                             ) -> dict[tuple, tuple | None]:
+        """Forward closure over resolved call edges from ``roots``:
+        ``{function key: parent key (None for a root)}`` — the parent
+        chain doubles as one example entry path for findings.
+        ``expand(callee)`` may return extra FunctionInfos a call also
+        reaches (virtual-dispatch widening).  Shared by the lockset and
+        hot-path-scan root closures so path rendering and reachability
+        can never drift between them."""
+        parent: dict[tuple, tuple | None] = {k: None for k in roots}
+        work = list(roots)
+        while work:
+            key = work.pop()
+            fn = self.functions.get(key)
+            if fn is None:
+                continue
+            targets = []
+            for site in self.callees(fn):
+                if site.callee is None:
+                    continue
+                targets.append(site.callee)
+                if expand is not None:
+                    targets.extend(expand(site.callee))
+            for callee in targets:
+                if callee.key not in parent:
+                    parent[callee.key] = key
+                    work.append(callee.key)
+        return parent
+
+    def render_entry_path(self, parent: dict, key: tuple,
+                          hops: int = 6) -> str:
+        """``root -> ... -> fn`` along the parent chain, elided past
+        ``hops`` — the finding-message spelling shared by every
+        closure-backed rule."""
+        chain, k = [], key
+        while k is not None and len(chain) < hops:
+            fn = self.functions.get(k)
+            chain.append(fn.qualname if fn is not None else str(k))
+            k = parent.get(k)
+        chain.reverse()
+        return " -> ".join(chain)
 
     def fixpoint(self, seed: set[tuple[str, str]],
                  stop=None) -> set[tuple[str, str]]:

@@ -1,0 +1,419 @@
+"""Per-function control-flow graphs over the repository's ASTs.
+
+The PR-7/8 rules are lexical or flow-insensitive: ``locks.py`` matches
+``with self._lock:`` blocks by nesting, ``nocopyflow.py`` walks
+statements in AST order (so a rebind in one branch wrongly launders the
+other), and nothing can ask "is this lock still held on the exception
+path?".  This module gives every function a real CFG — branches, loops,
+``with`` enter/exit, ``try``/``except``/``finally`` exception edges,
+early returns, ``break``/``continue``, ``raise`` — that the
+path-sensitive checkers (:mod:`lockset`, :mod:`releasepaths`,
+:mod:`effects`) run dataflow over (:mod:`tputopo.lint.dataflow`).
+
+Shape:
+
+- A :class:`CFGNode` is one *simple* statement, a compound statement's
+  header (an ``if``/``while`` test, a ``for`` iterator), a ``with``
+  eval/enter/exit, a ``try`` handler entry, or a synthetic entry/exit.
+  Compound bodies are linked by edges, not nested.
+- **Exception edges**: any node whose statement can plausibly raise (it
+  contains a call, a ``raise``, or an ``assert``) gets an edge to the
+  innermost handlers — through every enclosing ``with``'s exit node
+  (CPython runs ``__exit__`` on the way out, which is exactly what a
+  lockset analysis must see: the lock is *released* on the exception
+  path) and through ``finally`` bodies — ending at the shared
+  :attr:`CFG.exit` when nothing catches.
+- ``with`` is split into an **eval** node (the context expression — it
+  can raise *before* acquisition) and an **enter** node (acquisition
+  succeeded), plus one **exit** node every leaving edge funnels through.
+- ``finally`` bodies are built once; their exits fan out to every
+  continuation that entered them (normal fall-through, the unmatched-
+  exception escape, return targets).  That merges facts conservatively —
+  sound for the must-analyses built on top.
+
+CFGs are built lazily per function and cached on the FunctionInfo via
+:func:`cfg_for` (one build shared by every checker in a run).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+__all__ = ["CFG", "CFGNode", "build_cfg", "cfg_for", "own_exprs",
+           "walk_exprs"]
+
+
+class CFGNode:
+    """One CFG node.  ``kind`` is one of ``entry`` / ``exit`` / ``stmt``
+    / ``test`` / ``handler`` / ``with_eval`` / ``with_enter`` /
+    ``with_exit``; ``stmt`` carries the underlying AST node (None for
+    entry/exit).  ``succs`` are normal-completion edges; ``esuccs`` are
+    the this-node-raised edges — obligation checks must NOT count an
+    acquire's own failure as a leaked path (the resource was never
+    obtained), which is exactly the distinction the split preserves."""
+
+    __slots__ = ("kind", "stmt", "succs", "esuccs", "idx")
+
+    def __init__(self, kind: str, stmt: ast.AST | None, idx: int) -> None:
+        self.kind = kind
+        self.stmt = stmt
+        self.succs: list[CFGNode] = []
+        self.esuccs: list[CFGNode] = []
+        self.idx = idx  # creation order — stable ids for tests/messages
+
+    def link(self, other: "CFGNode") -> None:
+        if other not in self.succs:
+            self.succs.append(other)
+
+    def elink(self, other: "CFGNode") -> None:
+        if other not in self.esuccs:
+            self.esuccs.append(other)
+
+    def all_succs(self) -> list["CFGNode"]:
+        return self.succs + self.esuccs
+
+    @property
+    def line(self) -> int:
+        return getattr(self.stmt, "lineno", 0)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<CFGNode {self.idx} {self.kind} L{self.line}>"
+
+
+def _can_raise(node: ast.AST) -> bool:
+    """Conservative: a statement that contains a call, ``raise`` or
+    ``assert`` may transfer to the innermost handler.  Pure
+    name/constant shuffling is treated as non-raising — precise enough
+    for release-on-all-paths, and it keeps the graphs small."""
+    if isinstance(node, (ast.Raise, ast.Assert)):
+        return True
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            return True
+    return False
+
+
+class _Frame:
+    """Per-construct context the builder threads through recursion."""
+
+    __slots__ = ("exc_targets", "break_to", "continue_to", "return_to")
+
+    def __init__(self, exc_targets, break_to, continue_to, return_to):
+        self.exc_targets = exc_targets    # list[CFGNode]: where raises go
+        self.break_to = break_to          # list collecting break nodes
+        self.continue_to = continue_to    # CFGNode or None
+        self.return_to = return_to        # CFGNode: cfg.exit or a finally
+
+
+class CFG:
+    """The graph: ``entry`` -> ... -> ``exit`` (one shared exit for
+    returns, fall-through, AND escaping exceptions — every obligation
+    checker cares that all of them release)."""
+
+    def __init__(self) -> None:
+        self.nodes: list[CFGNode] = []
+        self.entry = self._new("entry", None)
+        self.exit = self._new("exit", None)
+
+    def _new(self, kind: str, stmt: ast.AST | None) -> CFGNode:
+        n = CFGNode(kind, stmt, len(self.nodes))
+        self.nodes.append(n)
+        return n
+
+    # ---- queries -----------------------------------------------------------
+
+    def preds_map(self) -> dict[CFGNode, list[CFGNode]]:
+        out: dict[CFGNode, list[CFGNode]] = {n: [] for n in self.nodes}
+        for n in self.nodes:
+            for s in n.all_succs():
+                out[s].append(n)
+        return out
+
+    def reachable_without(self, start: CFGNode, stop) -> bool:
+        """True when :attr:`exit` is reachable from ``start`` along a
+        path whose nodes (``start`` excluded) never satisfy ``stop`` —
+        the release-on-all-paths query.  ``start``'s own exception
+        edges are excluded: the obligation only exists once the
+        acquiring statement COMPLETED."""
+        seen = {id(start)}
+        work = list(start.succs)
+        while work:
+            n = work.pop()
+            if id(n) in seen:
+                continue
+            seen.add(id(n))
+            if n is self.exit:
+                return True
+            if stop(n):
+                continue
+            work.extend(n.all_succs())
+        return False
+
+
+class _Builder:
+    def __init__(self, cfg: CFG) -> None:
+        self.cfg = cfg
+
+    def build(self, body: list, frame: _Frame,
+              frontier: list[CFGNode]) -> list[CFGNode]:
+        """Wire ``body`` after ``frontier``; returns the fall-through
+        frontier (nodes whose next edge is the statement after the
+        construct)."""
+        for stmt in body:
+            frontier = self.stmt(stmt, frame, frontier)
+            if not frontier:
+                break  # everything returned/raised/broke
+        return frontier
+
+    def _join(self, frontier: Iterable[CFGNode], node: CFGNode) -> None:
+        for f in frontier:
+            f.link(node)
+
+    def _raise_edges(self, node: CFGNode, frame: _Frame) -> None:
+        for t in frame.exc_targets:
+            node.elink(t)
+
+    def stmt(self, stmt: ast.AST, frame: _Frame,
+             frontier: list[CFGNode]) -> list[CFGNode]:
+        cfg = self.cfg
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            n = cfg._new("stmt", stmt)  # the *definition* executes; its
+            self._join(frontier, n)     # body is a separate function
+            return [n]
+        if isinstance(stmt, ast.Return):
+            n = cfg._new("stmt", stmt)
+            self._join(frontier, n)
+            if stmt.value is not None and _can_raise(stmt.value):
+                self._raise_edges(n, frame)
+            n.link(frame.return_to)
+            return []
+        if isinstance(stmt, ast.Raise):
+            n = cfg._new("stmt", stmt)
+            self._join(frontier, n)
+            self._raise_edges(n, frame)
+            return []
+        if isinstance(stmt, ast.Break):
+            n = cfg._new("stmt", stmt)
+            self._join(frontier, n)
+            if frame.break_to is not None:
+                frame.break_to.append(n)
+            return []
+        if isinstance(stmt, ast.Continue):
+            n = cfg._new("stmt", stmt)
+            self._join(frontier, n)
+            if frame.continue_to is not None:
+                n.link(frame.continue_to)
+            return []
+        if isinstance(stmt, ast.If):
+            test = cfg._new("test", stmt)
+            self._join(frontier, test)
+            if _can_raise(stmt.test):
+                self._raise_edges(test, frame)
+            out = self.build(stmt.body, frame, [test])
+            if stmt.orelse:
+                out = out + self.build(stmt.orelse, frame, [test])
+            else:
+                out = out + [test]  # condition false, no else
+            return out
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            head = cfg._new("test", stmt)
+            self._join(frontier, head)
+            head_expr = stmt.test if isinstance(stmt, ast.While) \
+                else stmt.iter
+            if _can_raise(head_expr):
+                self._raise_edges(head, frame)
+            breaks: list[CFGNode] = []
+            inner = _Frame(frame.exc_targets, breaks, head, frame.return_to)
+            body_out = self.build(stmt.body, inner, [head])
+            self._join(body_out, head)  # loop back
+            out = list(breaks)
+            # Loop may run zero times / exhaust -> else -> fall through.
+            if stmt.orelse:
+                out = out + self.build(stmt.orelse, frame, [head])
+            else:
+                out = out + [head]
+            return out
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._with(stmt, frame, frontier)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, frame, frontier)
+        # Simple statement.
+        n = cfg._new("stmt", stmt)
+        self._join(frontier, n)
+        if _can_raise(stmt):
+            self._raise_edges(n, frame)
+        return [n]
+
+    def _with(self, stmt, frame: _Frame,
+              frontier: list[CFGNode]) -> list[CFGNode]:
+        cfg = self.cfg
+        ev = cfg._new("with_eval", stmt)    # context exprs
+        self._join(frontier, ev)            # BEFORE acquisition
+        # Raise edge only when a context expr itself contains a call
+        # (``with tr.phase("x"):``).  A bare ``with self._lock:`` is
+        # treated as non-raising — flagging every manual acquire that
+        # merely SPANS a lock block would drown the real leaks.
+        if any(_can_raise(item.context_expr) for item in stmt.items):
+            self._raise_edges(ev, frame)
+        enter = cfg._new("with_enter", stmt)
+        ev.link(enter)
+        # ``__exit__`` runs on EVERY way out — but each way continues
+        # somewhere DIFFERENT, so each leave kind gets its own exit
+        # node (all kind "with_exit": a lockset transfer releases on
+        # any of them).  One shared exit node fabricated paths (a
+        # pass-through body appeared to reach the function exit
+        # directly), which falsely tripped release-on-all-paths on
+        # correctly paired acquires spanning a with.  Unused exits stay
+        # unreachable orphans — harmless to every analysis.
+        ex_norm = cfg._new("with_exit", stmt)   # fall-through
+        ex_exc = cfg._new("with_exit", stmt)    # unwinding a raise
+        for t in frame.exc_targets:
+            ex_exc.link(t)
+        ex_ret = cfg._new("with_exit", stmt)    # unwinding a return
+        ex_ret.link(frame.return_to)
+        ex_cont = cfg._new("with_exit", stmt)   # unwinding a continue
+        if frame.continue_to is not None:
+            ex_cont.link(frame.continue_to)
+        breaks: list[CFGNode] = []
+        inner = _Frame([ex_exc], breaks, ex_cont, ex_ret)
+        body_out = self.build(stmt.body, inner, [enter])
+        self._join(body_out, ex_norm)
+        if breaks:                               # unwinding a break
+            ex_brk = cfg._new("with_exit", stmt)
+            self._join(breaks, ex_brk)
+            if frame.break_to is not None:
+                frame.break_to.append(ex_brk)
+        return [ex_norm] if body_out else []
+
+    def _try(self, stmt: ast.Try, frame: _Frame,
+             frontier: list[CFGNode]) -> list[CFGNode]:
+        cfg = self.cfg
+        if stmt.finalbody:
+            # One finally COPY per continuation kind, same reasoning as
+            # the per-leave with exits: a single shared finally whose
+            # exits fan out to every continuation fabricates paths (a
+            # plain fall-through appeared to reach the function exit),
+            # and routing break/continue around it entirely modeled
+            # finally-released locks as leaked.  Unused copies are
+            # unreachable orphans — harmless.
+            fin_frame = _Frame(frame.exc_targets, frame.break_to,
+                               frame.continue_to, frame.return_to)
+
+            def fin(link_outs) -> CFGNode:
+                entry = cfg._new("stmt", stmt)
+                link_outs(self.build(stmt.finalbody, fin_frame, [entry]))
+                return entry
+
+            after: list[CFGNode] = []
+            fin_norm = fin(after.extend)
+            fin_exc = fin(lambda outs: [o.link(t) for o in outs
+                                        for t in frame.exc_targets])
+            fin_ret = fin(lambda outs: [o.link(frame.return_to)
+                                        for o in outs])
+            local_breaks: list[CFGNode] | None = None
+            if frame.break_to is not None:
+                fin_brk = fin(frame.break_to.extend)
+                local_breaks = []
+            fin_cont = None
+            if frame.continue_to is not None:
+                fin_cont = fin(lambda outs: [o.link(frame.continue_to)
+                                             for o in outs])
+            exc_escape: list[CFGNode] = [fin_exc]
+            inner_return_to = fin_ret
+            inner_break_to = local_breaks
+            inner_continue_to = fin_cont
+        else:
+            fin_norm = None
+            exc_escape = list(frame.exc_targets)
+            inner_return_to = frame.return_to
+            inner_break_to = frame.break_to
+            inner_continue_to = frame.continue_to
+            after = []
+        handler_nodes = [cfg._new("handler", h) for h in stmt.handlers]
+        # Raises in the try body dispatch to every handler (we cannot
+        # statically match exception types) or escape unmatched.
+        body_frame = _Frame(handler_nodes + exc_escape, inner_break_to,
+                            inner_continue_to, inner_return_to)
+        body_out = self.build(stmt.body, body_frame, frontier)
+        # else runs only after a raise-free body — its own raises are
+        # NOT caught by this try's handlers.
+        escape_frame = _Frame(exc_escape, inner_break_to,
+                              inner_continue_to, inner_return_to)
+        if stmt.orelse:
+            body_out = self.build(stmt.orelse, escape_frame, body_out)
+        # Handler bodies: raises inside a handler escape the construct
+        # (through finally when present).
+        handler_outs: list[CFGNode] = []
+        for hn, h in zip(handler_nodes, stmt.handlers):
+            handler_outs += self.build(h.body, escape_frame, [hn])
+        normal_out = body_out + handler_outs
+        if fin_norm is not None:
+            self._join(normal_out, fin_norm)
+            if local_breaks:
+                self._join(local_breaks, fin_brk)
+            return after if normal_out else []
+        return normal_out
+
+
+def build_cfg(fn_node: ast.AST) -> CFG:
+    """The CFG of one ``def``'s own body (nested defs are opaque
+    single nodes — they are separate functions)."""
+    cfg = CFG()
+    frame = _Frame([cfg.exit], None, None, cfg.exit)
+    out = _Builder(cfg).build(list(getattr(fn_node, "body", [])),
+                              frame, [cfg.entry])
+    for n in out:
+        n.link(cfg.exit)
+    return cfg
+
+
+def cfg_for(fn) -> CFG:
+    """Build-once CFG cache on a callgraph FunctionInfo: the three
+    path-sensitive checkers in a run share one graph per function."""
+    got = getattr(fn, "_cfg", None)
+    if got is None:
+        got = fn._cfg = build_cfg(fn.node)
+    return got
+
+
+def own_exprs(node: CFGNode) -> list:
+    """The AST fragments a CFG node itself evaluates (compound bodies
+    are separate nodes; nested function bodies never run here)."""
+    s = node.stmt
+    if s is None:
+        return []
+    if node.kind == "test":
+        if isinstance(s, (ast.If, ast.While)):
+            return [s.test]
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            return [s.iter, s.target]
+        return []
+    if node.kind == "with_eval":
+        out = []
+        for item in s.items:
+            out.append(item.context_expr)
+            if item.optional_vars is not None:
+                out.append(item.optional_vars)
+        return out
+    if node.kind in ("with_enter", "with_exit"):
+        return []
+    if node.kind == "handler":
+        return [s.type] if getattr(s, "type", None) is not None else []
+    if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                      ast.Try)):
+        return []  # opaque definition / structural anchor
+    return [s]
+
+
+def walk_exprs(node: CFGNode):
+    """Every AST node the CFG node evaluates, nested scopes excluded."""
+    stack = list(own_exprs(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))

@@ -29,6 +29,7 @@ from __future__ import annotations
 import ast
 import io
 import re
+import time
 import tokenize
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -73,16 +74,21 @@ class Waiver:
 
 @dataclass
 class Module:
-    """One source file, parsed once and shared by every checker."""
+    """One source file, parsed once and shared by every checker.
+
+    Comment extraction is LAZY: tokenizing every file cost ~1.5 s of a
+    whole-repo run, yet only modules carrying waiver/annotation markers
+    ever need their comments — the first touch of :attr:`comments`
+    tokenizes, everything else never pays."""
 
     relpath: str                       # repo-relative, posix separators
     source: str
     tree: ast.AST = field(repr=False, default=None)
     lines: list[str] = field(repr=False, default_factory=list)
-    comments: dict[int, str] = field(repr=False, default_factory=dict)
     waivers: list[Waiver] = field(default_factory=list)
     parse_error: Finding | None = None
     _nodes: list = field(repr=False, default=None)
+    _comments: dict = field(repr=False, default=None)
 
     def nodes(self) -> list:
         """Every AST node of the module, walked once and cached — the
@@ -90,6 +96,20 @@ class Module:
         if self._nodes is None:
             self._nodes = list(ast.walk(self.tree))
         return self._nodes
+
+    @property
+    def comments(self) -> dict[int, str]:
+        """{line: comment text}, tokenized on first access."""
+        if self._comments is None:
+            self._comments = {}
+            try:
+                for tok in tokenize.generate_tokens(
+                        io.StringIO(self.source).readline):
+                    if tok.type == tokenize.COMMENT:
+                        self._comments[tok.start[0]] = tok.string
+            except (tokenize.TokenError, IndentationError):
+                pass  # AST parsed; comments are best-effort beyond that
+        return self._comments
 
     @classmethod
     def parse(cls, relpath: str, source: str) -> "Module":
@@ -102,13 +122,8 @@ class Module:
                                       PARSE_RULE, f"syntax error: {e.msg}")
             mod.tree = ast.Module(body=[], type_ignores=[])
             return mod
-        try:
-            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
-                if tok.type == tokenize.COMMENT:
-                    mod.comments[tok.start[0]] = tok.string
-        except (tokenize.TokenError, IndentationError):
-            pass  # AST parsed; comments are best-effort beyond that
-        mod._parse_waivers()
+        if "tpulint:" in source:  # only waiver-bearing files tokenize here
+            mod._parse_waivers()
         return mod
 
     def _parse_waivers(self) -> None:
@@ -146,10 +161,14 @@ class Checker:
 
     ``check_module`` runs per file (scoped by :meth:`applies_to`);
     ``finalize`` runs once after every file was seen — cross-module rules
-    (single-definition drift) report there."""
+    (single-definition drift) report there.  ``version`` bumps whenever a
+    rule's semantics change, so CI JSON artifacts diff cleanly across
+    PRs (a finding-count delta is attributable to a rule change, not a
+    tree change)."""
 
     rule = "abstract"
     description = ""
+    version = 1
 
     def applies_to(self, relpath: str) -> bool:
         return True
@@ -196,6 +215,20 @@ class LintRun:
         self.modules: list[Module] = []
         self._raw: list[Finding] = []
         self.waived: list[Finding] = []
+        #: Per-rule finding/waived counts and wall seconds — the CI
+        #: artifact's ``by_rule`` block, so a slow or noisy rule is
+        #: attributable from the JSON alone.
+        self.rule_stats: dict[str, dict] = {
+            c.rule: {"findings": 0, "waived": 0, "duration_s": 0.0}
+            for c in self.checkers}
+
+    def _timed(self, checker: Checker, fn) -> list[Finding]:
+        t0 = time.perf_counter()
+        got = list(fn())
+        stats = self.rule_stats.get(checker.rule)
+        if stats is not None:
+            stats["duration_s"] += time.perf_counter() - t0
+        return got
 
     def add_module(self, mod: Module) -> None:
         self.modules.append(mod)
@@ -204,7 +237,9 @@ class LintRun:
             return
         for checker in self.checkers:
             if checker.applies_to(mod.relpath):
-                self._raw.extend(checker.check_module(mod))
+                self._raw.extend(
+                    self._timed(checker,
+                                lambda: checker.check_module(mod)))
 
     def add_source(self, relpath: str, source: str) -> None:
         self.add_module(Module.parse(relpath, source))
@@ -216,7 +251,7 @@ class LintRun:
         """Finalize cross-module checkers, apply waivers, and return the
         ACTIVE findings (waived ones land in :attr:`waived`)."""
         for checker in self.checkers:
-            self._raw.extend(checker.finalize())
+            self._raw.extend(self._timed(checker, checker.finalize))
         by_module = {m.relpath: m for m in self.modules}
         active: list[Finding] = []
         for f in sorted(self._raw, key=lambda f: (f.path, f.line, f.col,
@@ -225,10 +260,18 @@ class LintRun:
             if waiver is not None:
                 waiver.used = True
                 self.waived.append(f)
+                if f.rule in self.rule_stats:
+                    self.rule_stats[f.rule]["waived"] += 1
             else:
                 active.append(f)
         active.extend(self._waiver_findings())
-        return sorted(active, key=lambda f: (f.path, f.line, f.col, f.rule))
+        active = sorted(active, key=lambda f: (f.path, f.line, f.col, f.rule))
+        for f in active:
+            if f.rule in self.rule_stats:
+                self.rule_stats[f.rule]["findings"] += 1
+        for stats in self.rule_stats.values():
+            stats["duration_s"] = round(stats["duration_s"], 3)
+        return active
 
     @staticmethod
     def _matching_waiver(mod: Module | None, f: Finding) -> Waiver | None:
@@ -276,7 +319,9 @@ def discover_files(root: Path, roots: Sequence[str] = ("tputopo", "tests"),
                    ) -> list[tuple[Path, str]]:
     """All ``.py`` files under ``root/<r>`` for each requested subtree,
     as (absolute path, repo-relative posix path), deterministically
-    ordered.  Generated protobuf stubs are excluded (not ours to lint)."""
+    ordered.  Generated protobuf stubs are excluded (not ours to lint),
+    and so is ``tests/lint_corpus/`` — the seeded KNOWN-BAD fixture
+    files each rule must flag; the corpus tests feed them explicitly."""
     out: list[tuple[Path, str]] = []
     for sub in roots:
         base = root / sub
@@ -284,7 +329,8 @@ def discover_files(root: Path, roots: Sequence[str] = ("tputopo", "tests"),
             continue
         for p in sorted(base.rglob("*.py")):
             rel = p.relative_to(root).as_posix()
-            if "__pycache__" in rel or rel.endswith("_pb2.py"):
+            if "__pycache__" in rel or rel.endswith("_pb2.py") \
+                    or "tests/lint_corpus/" in rel:
                 continue
             out.append((p, rel))
     return out

@@ -1,0 +1,87 @@
+"""Worklist fixpoint framework over :mod:`tputopo.lint.cfg` graphs.
+
+One engine, two lattices in practice:
+
+- **must** analyses (lockset): facts are sets that shrink at joins —
+  ``join`` is intersection, and an unvisited predecessor contributes
+  nothing (the engine seeds only the entry node and propagates, so a
+  node's input is the join over *visited* predecessors; by the fixpoint
+  every reachable predecessor has been visited, which is exactly the
+  must-over-all-paths semantics).
+- **may** analyses (effect taint): ``join`` is union.
+
+Interprocedural composition stays the checkers' job: they compute
+per-function summaries with one intraprocedural pass each, then iterate
+caller rescans over the existing call graph (:mod:`callgraph`) — the
+infer-style summary worklist the whole-program rules already use.
+
+Facts must be immutable values with ``==`` (frozensets, tuples);
+``transfer`` returns a NEW fact.  The engine iterates in node creation
+order (a reverse-postorder-ish order for the structured graphs the
+builder emits), with a hard iteration backstop so a buggy transfer can
+fail loudly instead of hanging a lint run.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Generic, Hashable, TypeVar
+
+from tputopo.lint.cfg import CFG, CFGNode
+
+__all__ = ["ForwardAnalysis", "run_forward"]
+
+F = TypeVar("F", bound=Hashable)
+
+
+class ForwardAnalysis(Generic[F]):
+    """Subclass (or duck-type) with ``entry_fact``, ``join`` and
+    ``transfer``."""
+
+    def entry_fact(self) -> F:
+        raise NotImplementedError
+
+    def join(self, a: F, b: F) -> F:
+        raise NotImplementedError
+
+    def transfer(self, node: CFGNode, fact: F) -> F:
+        raise NotImplementedError
+
+
+def run_forward(cfg: CFG, analysis: ForwardAnalysis,
+                visit: Callable[[CFGNode, object], None] | None = None,
+                ) -> dict[int, object]:
+    """Run ``analysis`` to fixpoint; returns ``{node.idx: input fact}``
+    for every reachable node.  ``visit(node, in_fact)`` — when given —
+    is called exactly once per reachable node AFTER convergence, in node
+    order, with the converged input fact: the reporting pass, separated
+    so findings are emitted once however many times the worklist
+    revisited a node."""
+    in_facts: dict[int, object] = {cfg.entry.idx: analysis.entry_fact()}
+    out_facts: dict[int, object] = {}
+    work = [cfg.entry]
+    # Loops converge in a handful of rounds on these lattices; the
+    # backstop turns a non-monotone transfer into a loud failure.
+    budget = 64 * max(1, len(cfg.nodes))
+    while work:
+        budget -= 1
+        if budget < 0:
+            raise RuntimeError("dataflow fixpoint did not converge "
+                               f"({len(cfg.nodes)} nodes)")
+        node = work.pop()
+        fact = analysis.transfer(node, in_facts[node.idx])
+        if node.idx in out_facts and out_facts[node.idx] == fact:
+            continue
+        out_facts[node.idx] = fact
+        for succ in node.all_succs():
+            if succ.idx in in_facts:
+                merged = analysis.join(in_facts[succ.idx], fact)
+            else:
+                merged = fact
+            if succ.idx not in in_facts or merged != in_facts[succ.idx]:
+                in_facts[succ.idx] = merged
+                work.append(succ)
+    if visit is not None:
+        for node in cfg.nodes:
+            if node.idx in in_facts:
+                visit(node, in_facts[node.idx])
+    return in_facts

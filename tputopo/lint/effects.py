@@ -1,0 +1,355 @@
+"""The ``effect-purity`` checker: nocopy views demand pure receivers.
+
+``nocopy`` (per-function) and ``nocopy-flow`` (interprocedural taint)
+walk statements in AST order, which makes them *flow-insensitive
+across branches*: a function that copies its argument in one branch and
+mutates the original in the other is laundered clean, because the
+rebind is "seen" before the mutation in source order::
+
+    def thin(pods, aggressive):
+        if aggressive:
+            pods = [dict(p) for p in pods]   # copies on THIS path only
+        pods.sort(...)                        # mutates the STORE on the other
+
+This rule upgrades the contract to an **effect system over the CFG**:
+
+- Compute, per function, whether any *nocopy view* can reach each
+  parameter — interprocedurally: a view is a direct source result
+  (``list_nocopy`` / ``get_nocopy`` / ``fetch`` / the ``copy=False``
+  read family), the result of a *returns-view* function (summary
+  fixpoint over the call graph), or a view-receiving parameter passed
+  onward.
+- For each view-receiving parameter, run a **may-hold-view** dataflow
+  (:mod:`dataflow`, union join) over the function's CFG: per path,
+  rebinding a name kills the view; aliasing, ``for`` targets and
+  subscript loads propagate it.
+- Any **store or mutation effect** through a name that may still hold
+  the view on SOME path — subscript/attribute store, ``del``, augmented
+  assignment, a mutating method call, storing it onto ``self`` — is a
+  finding at the effect site, with one example caller that hands the
+  view in.
+
+Read-only effects (returns, iteration, passing onward to pure callees)
+are exactly what the contract allows, so they are not findings here —
+escapes are ``nocopy-flow``'s department.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tputopo.lint.callgraph import CallGraph, FunctionInfo, graph_for
+from tputopo.lint.cfg import CFGNode, cfg_for, walk_exprs
+from tputopo.lint.core import Checker, Finding, Module, subscript_root
+from tputopo.lint.nocopy import _MUTATING_METHODS, NOCOPY_SOURCES
+from tputopo.lint.nocopyflow import _is_copyfree_call, _is_direct_source
+
+
+def _callee_param_names(callee: FunctionInfo) -> list[str]:
+    names = callee.param_names()
+    if names[:1] in (["self"], ["cls"]):
+        names = names[1:]
+    return names
+
+
+def _own_nodes(fn_node: ast.AST):
+    """Every AST node of a function's own body — nested function/class
+    bodies excluded (they are separate functions)."""
+    stack = list(getattr(fn_node, "body", []))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _ViewWorld:
+    """Interprocedural facts: which functions return views, which
+    (function, param) pairs can receive one, and one example caller
+    per receiving param (for the finding message)."""
+
+    def __init__(self) -> None:
+        self.returns_view: set[tuple] = set()
+        self.receives: dict[tuple, set[str]] = {}      # fn key -> params
+        self.example: dict[tuple, str] = {}            # (fn key, param)
+
+
+class EffectPurityChecker(Checker):
+    rule = "effect-purity"
+    description = ("a function receiving a list_nocopy/get_nocopy/fetch/"
+                   "copy=False view through a parameter must have no "
+                   "store or mutation effect on it along ANY CFG path "
+                   "(a copy on one branch does not excuse the other)")
+
+    version = 1
+
+    def __init__(self) -> None:
+        self._mods: list[Module] = []
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(("tputopo/", "tests/"))
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        self._mods.append(mod)
+        return ()
+
+    # ---- interprocedural seeding -------------------------------------------
+
+    @staticmethod
+    def _touchy(mods: list[Module]) -> set[str]:
+        return {m.relpath for m in mods
+                if any(s in m.source for s in NOCOPY_SOURCES)
+                or "copy=False" in m.source}
+
+    def _value_is_view(self, node: ast.AST, world: _ViewWorld,
+                       graph: CallGraph, fn: FunctionInfo,
+                       local_views: set[str]) -> bool:
+        """Does evaluating ``node`` (flow-insensitively, for seeding)
+        yield a view?  ``local_views`` are names the caller already
+        knows hold one."""
+        if _is_direct_source(node) or _is_copyfree_call(node):
+            return True
+        if isinstance(node, ast.Call):
+            callee = graph.resolve(node, fn)
+            return callee is not None and callee.key in world.returns_view
+        if isinstance(node, ast.Name):
+            return node.id in local_views
+        if isinstance(node, ast.Subscript):
+            return self._value_is_view(node.value, world, graph, fn,
+                                       local_views)
+        if isinstance(node, (ast.IfExp,)):
+            return (self._value_is_view(node.body, world, graph, fn,
+                                        local_views)
+                    or self._value_is_view(node.orelse, world, graph, fn,
+                                           local_views))
+        return False
+
+    def _seed_world(self, graph: CallGraph, fns: list[FunctionInfo]
+                    ) -> _ViewWorld:
+        """Fixpoint over (returns-view, receives-view) summaries.  Name
+        propagation here is deliberately coarse (any bind of a view to
+        a name marks the name); precision lives in the per-path report
+        pass below."""
+        world = _ViewWorld()
+        changed = True
+        rounds = 0
+        # Each round can only ADD summary facts, and a fact needs at
+        # most one round per call-chain hop — 64 is far above any real
+        # forwarding depth.  Exhausting it means a bug, and a truncated
+        # summary silently un-flags real mutations, so fail LOUDLY
+        # (same posture as dataflow.py's fixpoint backstop).
+        while changed:
+            if rounds >= 64:
+                raise RuntimeError(
+                    "effect-purity summary fixpoint did not converge "
+                    f"after {rounds} rounds over {len(fns)} functions")
+            changed = False
+            rounds += 1
+            for fn in fns:
+                local: set[str] = set(world.receives.get(fn.key, ()))
+                for node in _own_nodes(fn.node):
+                    if isinstance(node, ast.Assign):
+                        if self._value_is_view(node.value, world, graph,
+                                               fn, local):
+                            for t in node.targets:
+                                if isinstance(t, ast.Name):
+                                    local.add(t.id)
+                    elif isinstance(node, ast.For):
+                        if self._value_is_view(node.iter, world, graph,
+                                               fn, local) \
+                                and isinstance(node.target, ast.Name):
+                            local.add(node.target.id)
+                    elif isinstance(node, ast.Return) \
+                            and node.value is not None:
+                        if self._value_is_view(node.value, world, graph,
+                                               fn, local) \
+                                and fn.key not in world.returns_view:
+                            world.returns_view.add(fn.key)
+                            changed = True
+                    elif isinstance(node, ast.Call):
+                        callee = graph.resolve(node, fn)
+                        if callee is None:
+                            continue
+                        pnames = _callee_param_names(callee)
+                        for i, arg in enumerate(node.args):
+                            if i < len(pnames) and self._value_is_view(
+                                    arg, graph=graph, fn=fn,
+                                    world=world, local_views=local):
+                                got = world.receives.setdefault(
+                                    callee.key, set())
+                                if pnames[i] not in got:
+                                    got.add(pnames[i])
+                                    world.example.setdefault(
+                                        (callee.key, pnames[i]),
+                                        f"{fn.relpath}:{node.lineno} "
+                                        f"({fn.qualname})")
+                                    changed = True
+                        for kw in node.keywords:
+                            if kw.arg in pnames and self._value_is_view(
+                                    kw.value, graph=graph, fn=fn,
+                                    world=world, local_views=local):
+                                got = world.receives.setdefault(
+                                    callee.key, set())
+                                if kw.arg not in got:
+                                    got.add(kw.arg)
+                                    world.example.setdefault(
+                                        (callee.key, kw.arg),
+                                        f"{fn.relpath}:{node.lineno} "
+                                        f"({fn.qualname})")
+                                    changed = True
+        return world
+
+    # ---- the per-path report pass ------------------------------------------
+
+    def finalize(self) -> Iterable[Finding]:
+        mods, self._mods = self._mods, []
+        graph = graph_for(mods)
+        touchy = self._touchy(mods)
+        fns = sorted((f for f in graph.functions.values()
+                      if f.relpath in touchy), key=lambda f: f.key)
+        world = self._seed_world(graph, fns)
+        for fn in fns:
+            params = world.receives.get(fn.key)
+            if not params or not fn.relpath.startswith("tputopo/"):
+                continue
+            yield from self._check_fn(graph, world, fn, params)
+
+    def _check_fn(self, graph: CallGraph, world: _ViewWorld,
+                  fn: FunctionInfo, params: set[str]) -> Iterable[Finding]:
+        cfg = cfg_for(fn)
+        checker = self
+
+        class _A:
+            """fact: frozenset[(name, origin-param)] — names that MAY
+            still hold the view on some path into the node."""
+
+            def entry_fact(self):
+                return frozenset((p, p) for p in params)
+
+            def join(self, a, b):
+                return a | b
+
+            def transfer(self, node: CFGNode, fact):
+                s = node.stmt
+                if s is None:
+                    return fact
+                if node.kind == "test" \
+                        and isinstance(s, (ast.For, ast.AsyncFor)):
+                    # Iterating a view list yields stored dicts: the
+                    # loop target inherits the iterable's origins.
+                    origins = checker._expr_origins(s.iter, fact)
+                    names = checker._target_names(s.target)
+                    out = {e for e in fact if e[0] not in names}
+                    for n in names:
+                        out |= {(n, o) for o in origins}
+                    return frozenset(out)
+                if node.kind != "stmt":
+                    return fact
+                if isinstance(s, ast.Assign):
+                    origins = checker._expr_origins(s.value, fact)
+                    out = set(fact)
+                    for t in s.targets:
+                        names = checker._target_names(t)
+                        out = {e for e in out if e[0] not in names}
+                        for n in names:
+                            out |= {(n, o) for o in origins}
+                    return frozenset(out)
+                return fact
+
+        findings: list[Finding] = []
+
+        def visit(node: CFGNode, fact) -> None:
+            if node.kind != "stmt" or node.stmt is None:
+                return
+            findings.extend(self._effects_at(node, fact, fn, world))
+
+        from tputopo.lint.dataflow import run_forward
+
+        run_forward(cfg, _A(), visit=visit)
+        yield from findings
+
+    @staticmethod
+    def _target_names(t: ast.AST) -> set[str]:
+        if isinstance(t, ast.Name):
+            return {t.id}
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out = set()
+            for e in t.elts:
+                if isinstance(e, ast.Name):
+                    out.add(e.id)
+            return out
+        return set()
+
+    @staticmethod
+    def _expr_origins(expr: ast.AST, fact) -> set[str]:
+        """Origin params whose view the expression may evaluate to."""
+        if isinstance(expr, ast.Name):
+            return {o for (n, o) in fact if n == expr.id}
+        if isinstance(expr, ast.Subscript):
+            return EffectPurityChecker._expr_origins(expr.value, fact)
+        if isinstance(expr, ast.IfExp):
+            return (EffectPurityChecker._expr_origins(expr.body, fact)
+                    | EffectPurityChecker._expr_origins(expr.orelse, fact))
+        if isinstance(expr, ast.BoolOp):
+            out = set()
+            for v in expr.values:
+                out |= EffectPurityChecker._expr_origins(v, fact)
+            return out
+        return set()
+
+    def _effects_at(self, node: CFGNode, fact, fn: FunctionInfo,
+                    world: _ViewWorld) -> list[Finding]:
+        out: list[Finding] = []
+        s = node.stmt
+
+        def flag(ast_node, what: str, origin: str) -> None:
+            example = world.example.get((fn.key, origin), "a caller")
+            out.append(Finding(
+                fn.relpath, ast_node.lineno, ast_node.col_offset,
+                self.rule,
+                f"{what} on parameter {origin!r} of {fn.qualname}(), "
+                f"which receives a copy-free view (e.g. from {example}) "
+                "— the view is the stored object; copy before mutating, "
+                "on EVERY path"))
+
+        def root_origins(expr: ast.AST) -> set[str]:
+            root = subscript_root(expr)
+            if isinstance(root, ast.Name):
+                return {o for (n, o) in fact if n == root.id}
+            return set()
+
+        if isinstance(s, ast.Assign):
+            for t in s.targets:
+                if isinstance(t, (ast.Subscript, ast.Attribute)):
+                    for o in sorted(root_origins(t)):
+                        flag(t, "store through a view", o)
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    for o in sorted(self._expr_origins(s.value, fact)):
+                        flag(s, "storing the view onto self", o)
+        elif isinstance(s, ast.AugAssign):
+            if isinstance(s.target, (ast.Subscript, ast.Attribute)):
+                for o in sorted(root_origins(s.target)):
+                    flag(s.target, "augmented store through a view", o)
+            elif isinstance(s.target, ast.Name):
+                # ``views += [...]`` mutates the underlying list in place.
+                for o in sorted({o for (n, o) in fact
+                                 if n == s.target.id}):
+                    flag(s, "augmented assignment to a view", o)
+        elif isinstance(s, ast.Delete):
+            for t in s.targets:
+                if isinstance(t, (ast.Subscript, ast.Attribute)):
+                    for o in sorted(root_origins(t)):
+                        flag(t, "del through a view", o)
+        # Mutating method calls anywhere in the statement's expressions.
+        for sub in walk_exprs(node):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in _MUTATING_METHODS:
+                for o in sorted(root_origins(sub.func.value)):
+                    flag(sub, f"mutating call .{sub.func.attr}()", o)
+        return out

@@ -1,0 +1,186 @@
+"""The ``hot-path-scan`` checker: no O(pods) work on scheduler hot verbs.
+
+The ROADMAP's fleet-scale item (1024 nodes / 10k arrivals) is blocked by
+full-store scans that only a profiler used to find —
+``BaselinePolicy.invalidate``'s conservative drop forces a full
+``ClusterState.sync`` on the very next ``place()``, ~35% of sim wall.
+This rule turns that hunt into a CI gate:
+
+- **Hot roots** are the scheduler's verbs (``ExtenderScheduler.sort`` /
+  ``.bind``) and the sim event loop (``SimEngine.run_events``), plus any
+  ``def`` carrying a ``# hot-path-root: <reason>`` directive (how a new
+  subsystem registers one).
+- The **hot closure** is everything reachable from a root through the
+  call graph — with *virtual dispatch* widened: a call resolving to a
+  base-class method also reaches every subclass override (the sim's
+  ``policy.place`` polymorphism is precisely how the expensive path
+  hides from a naive closure).
+- **Full-store primitives** are flagged at their call sites inside the
+  closure: ``ClusterState.sync`` (the O(pods) rebuild),
+  ``FakeApiServer.list`` / ``list_nocopy`` / ``list_with_version`` and
+  the informer mirrors, and ``defrag.planner.list_pods_nocopy``.
+  Constructor-chained calls (``ClusterState(...).sync()``) resolve too.
+
+Every finding names the entry path from a hot root.  Deliberate,
+amortized scans — the cache-miss rebuild fallback, the periodic GC
+sweep, a defrag cycle — carry **reasoned budgeted waivers**; the pinned
+per-rule waiver budget (tests/test_lint.py) is what keeps "just waive
+it" from becoming the path of least resistance.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from tputopo.lint.callgraph import (CallGraph, ClassInfo, FunctionInfo,
+                                    graph_for)
+from tputopo.lint.core import Checker, Finding, Module
+
+_ROOT_RE = re.compile(r"#\s*hot-path-root:\s*(?P<reason>.*\S)")
+
+#: The standing hot verbs (filter/score -> sort, bind) and the sim's
+#: event loop.  New roots register via the directive, not this list.
+HOT_ROOTS: tuple[tuple[str, str], ...] = (
+    ("tputopo/extender/scheduler.py", "ExtenderScheduler.sort"),
+    ("tputopo/extender/scheduler.py", "ExtenderScheduler.bind"),
+    ("tputopo/sim/engine.py", "SimEngine.run_events"),
+)
+
+#: (class qualname, method) pairs that scan a whole store per call.
+FULL_SCAN_METHODS = frozenset({
+    ("ClusterState", "sync"),
+    ("FakeApiServer", "list"),
+    ("FakeApiServer", "list_nocopy"),
+    ("FakeApiServer", "list_with_version"),
+    ("Informer", "list"),
+})
+
+#: Bare function names that are full-store scans wherever they resolve.
+FULL_SCAN_FUNCTIONS = frozenset({"list_pods_nocopy"})
+
+#: Attribute names unambiguous enough to flag even unresolved (no other
+#: meaning in this codebase).
+FULL_SCAN_ATTRS = frozenset({"list_nocopy", "list_with_version"})
+
+
+class HotPathChecker(Checker):
+    rule = "hot-path-scan"
+    description = ("functions reachable from the scheduler hot verbs "
+                   "(sort/bind) or the sim event loop must not call "
+                   "full-store O(pods) primitives (ClusterState.sync, "
+                   "api.list*, list_pods_nocopy) — amortized scans "
+                   "carry reasoned budgeted waivers")
+
+    version = 1
+
+    def __init__(self) -> None:
+        self._mods: list[Module] = []
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(("tputopo/", "tests/"))
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        self._mods.append(mod)
+        return ()
+
+    # ---- closure -----------------------------------------------------------
+
+    def _roots(self, graph: CallGraph, by_path) -> dict[tuple, str]:
+        roots: dict[tuple, str] = {}
+        for key in HOT_ROOTS:
+            if key in graph.functions:
+                roots[key] = "standing hot verb"
+        for fn in graph.functions.values():
+            if not fn.relpath.startswith("tputopo/"):
+                continue
+            mod = by_path.get(fn.relpath)
+            if mod is None or "hot-path-root" not in mod.source:
+                continue
+            m = _ROOT_RE.search(mod.comment_on_or_above(fn.node.lineno))
+            if m is not None:
+                roots[fn.key] = f"declared: {m.group('reason')}"
+        return roots
+
+    @staticmethod
+    def _subclass_overrides(graph: CallGraph) -> dict[tuple, list]:
+        """method key -> overriding FunctionInfos in subclasses (virtual
+        dispatch widening)."""
+        by_class: dict[tuple, list[ClassInfo]] = {}
+        for ci in graph.classes.values():
+            for b in ci.mro()[1:]:
+                by_class.setdefault(b.key, []).append(ci)
+        out: dict[tuple, list] = {}
+        for ci_key, subs in by_class.items():
+            base = graph.classes.get(ci_key)
+            if base is None:
+                continue
+            for name, meth in base.methods.items():
+                overrides = [s.methods[name] for s in subs
+                             if name in s.methods]
+                if overrides:
+                    out.setdefault(meth.key, []).extend(overrides)
+        return out
+
+    def _closure(self, graph: CallGraph, roots: dict[tuple, str]
+                 ) -> dict[tuple, tuple | None]:
+        overrides = self._subclass_overrides(graph)
+        return graph.closure_with_parents(
+            roots, expand=lambda callee: overrides.get(callee.key, ()))
+
+    # ---- scan-site detection -----------------------------------------------
+
+    def _scan_callee(self, graph: CallGraph, fn: FunctionInfo,
+                     call: ast.Call) -> str | None:
+        """A display name when ``call`` is a full-store primitive."""
+        callee = graph.resolve(call, fn)
+        if callee is None and isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Call):
+            # Constructor-chained: ``ClusterState(...).sync()``.
+            inner = graph.resolve(call.func.value, fn)
+            if inner is not None and inner.cls is not None:
+                callee = inner.cls.find_method(call.func.attr)
+        if callee is not None:
+            meth = callee.qualname.rsplit(".", 1)[-1]
+            if callee.cls is not None \
+                    and (callee.cls.qualname, meth) in FULL_SCAN_METHODS:
+                return f"{callee.cls.qualname}.{meth}"
+            if meth in FULL_SCAN_FUNCTIONS:
+                return callee.qualname
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in (FULL_SCAN_ATTRS
+                                       | FULL_SCAN_FUNCTIONS):
+            return call.func.attr
+        return None
+
+    def _entry_path(self, graph: CallGraph, parent, roots,
+                    key: tuple) -> str:
+        return graph.render_entry_path(parent, key)
+
+    # ---- the analysis ------------------------------------------------------
+
+    def finalize(self) -> Iterable[Finding]:
+        mods, self._mods = self._mods, []
+        graph = graph_for(mods)
+        by_path = {m.relpath: m for m in mods}
+        roots = self._roots(graph, by_path)
+        if not roots:
+            return
+        parent = self._closure(graph, roots)
+        for key in sorted(parent):
+            fn = graph.functions.get(key)
+            if fn is None or not fn.relpath.startswith("tputopo/"):
+                continue
+            for site in graph.callees(fn):
+                scan = self._scan_callee(graph, fn, site.node)
+                if scan is None:
+                    continue
+                via = self._entry_path(graph, parent, roots, key)
+                yield Finding(
+                    fn.relpath, site.node.lineno, site.node.col_offset,
+                    self.rule,
+                    f"full-store scan {scan}() on the hot path "
+                    f"({via}) — O(pods) per call blocks the fleet-scale "
+                    "trace; make it incremental/indexed, or waive with "
+                    "the amortization argument")

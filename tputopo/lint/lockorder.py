@@ -73,6 +73,84 @@ class _LockDecl:
         return self.kind == "RLock"
 
 
+def discover_locks(graph: CallGraph) -> tuple[
+        dict[LockKey, _LockDecl], dict[tuple, dict[str, str]]]:
+    """All declared locks, plus per-class alias maps
+    (attr -> canonical attr, identity included).  Shared with the
+    path-sensitive ``lockset`` rule — one lock vocabulary, one
+    Condition-alias inference."""
+    locks: dict[LockKey, _LockDecl] = {}
+    aliases: dict[tuple, dict[str, str]] = {}
+    for ci in graph.classes.values():
+        init = ci.methods.get("__init__")
+        if init is None:
+            continue
+        amap: dict[str, str] = {}
+        for node in ast.walk(init.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            target = node.targets[0] if len(node.targets) == 1 else None
+            if not (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                continue
+            call = node.value
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and isinstance(call.func.value, ast.Name)
+                    and call.func.value.id == "threading"):
+                continue
+            kind = call.func.attr
+            if kind in ("Lock", "RLock"):
+                decl = _LockDecl(ci, target.attr, kind, node.lineno)
+                locks[decl.key] = decl
+                amap[target.attr] = target.attr
+            elif kind == "Condition":
+                base = None
+                if call.args and isinstance(call.args[0], ast.Attribute) \
+                        and isinstance(call.args[0].value, ast.Name) \
+                        and call.args[0].value.id == "self":
+                    base = call.args[0].attr
+                if base is not None and base in amap:
+                    amap[target.attr] = amap[base]  # alias, same lock
+                else:
+                    decl = _LockDecl(ci, target.attr, "Condition",
+                                     node.lineno)
+                    locks[decl.key] = decl  # Condition owns its lock
+                    amap[target.attr] = target.attr
+        if amap:
+            aliases[ci.key] = amap
+    return locks, aliases
+
+
+def canonical_lock(fn: FunctionInfo, attr: str,
+                   locks: dict[LockKey, _LockDecl],
+                   aliases: dict) -> _LockDecl | None:
+    """The lock declaration ``self.<attr>`` refers to inside ``fn``,
+    through the class alias maps (Condition -> base lock), or None."""
+    if fn.cls is None:
+        return None
+    for c in fn.cls.mro():
+        amap = aliases.get(c.key)
+        if amap and attr in amap:
+            return locks.get((c.key, amap[attr]))
+    return None
+
+
+def entry_held_locks(mod: Module, fn: FunctionInfo,
+                     locks, aliases) -> frozenset[LockKey]:
+    """The ``# holds-lock:`` entry set of ``fn``, canonicalized."""
+    m = _HOLDS_RE.search(mod.comment_on_or_above(fn.node.lineno))
+    if m is None:
+        return frozenset()
+    held = set()
+    for attr in m.group("locks").split("|"):
+        decl = canonical_lock(fn, attr, locks, aliases)
+        if decl is not None:
+            held.add(decl.key)
+    return frozenset(held)
+
+
 class LockOrderChecker(Checker):
     rule = "lock-order"
     description = ("lock acquisitions (with self.<lock>:, held sets "
@@ -91,77 +169,19 @@ class LockOrderChecker(Checker):
         self._mods.append(mod)
         return ()
 
-    # ---- discovery ---------------------------------------------------------
+    # ---- discovery (module-level helpers, shared with lockset) -------------
 
-    def _discover_locks(self, graph: CallGraph) -> tuple[
-            dict[LockKey, _LockDecl], dict[tuple, dict[str, str]]]:
-        """All declared locks, plus per-class alias maps
-        (attr -> canonical attr, identity included)."""
-        locks: dict[LockKey, _LockDecl] = {}
-        aliases: dict[tuple, dict[str, str]] = {}
-        for ci in graph.classes.values():
-            init = ci.methods.get("__init__")
-            if init is None:
-                continue
-            amap: dict[str, str] = {}
-            for node in ast.walk(init.node):
-                if not isinstance(node, ast.Assign):
-                    continue
-                target = node.targets[0] if len(node.targets) == 1 else None
-                if not (isinstance(target, ast.Attribute)
-                        and isinstance(target.value, ast.Name)
-                        and target.value.id == "self"):
-                    continue
-                call = node.value
-                if not (isinstance(call, ast.Call)
-                        and isinstance(call.func, ast.Attribute)
-                        and isinstance(call.func.value, ast.Name)
-                        and call.func.value.id == "threading"):
-                    continue
-                kind = call.func.attr
-                if kind in ("Lock", "RLock"):
-                    decl = _LockDecl(ci, target.attr, kind, node.lineno)
-                    locks[decl.key] = decl
-                    amap[target.attr] = target.attr
-                elif kind == "Condition":
-                    base = None
-                    if call.args and isinstance(call.args[0], ast.Attribute) \
-                            and isinstance(call.args[0].value, ast.Name) \
-                            and call.args[0].value.id == "self":
-                        base = call.args[0].attr
-                    if base is not None and base in amap:
-                        amap[target.attr] = amap[base]  # alias, same lock
-                    else:
-                        decl = _LockDecl(ci, target.attr, "Condition",
-                                         node.lineno)
-                        locks[decl.key] = decl  # Condition owns its lock
-                        amap[target.attr] = target.attr
-            if amap:
-                aliases[ci.key] = amap
-        return locks, aliases
+    def _discover_locks(self, graph: CallGraph):
+        return discover_locks(graph)
 
     def _canonical(self, fn: FunctionInfo, attr: str,
                    locks: dict[LockKey, _LockDecl],
                    aliases: dict) -> _LockDecl | None:
-        if fn.cls is None:
-            return None
-        for c in fn.cls.mro():
-            amap = aliases.get(c.key)
-            if amap and attr in amap:
-                return locks.get((c.key, amap[attr]))
-        return None
+        return canonical_lock(fn, attr, locks, aliases)
 
     def _entry_held(self, mod: Module, fn: FunctionInfo,
                     locks, aliases) -> frozenset[LockKey]:
-        m = _HOLDS_RE.search(mod.comment_on_or_above(fn.node.lineno))
-        if m is None:
-            return frozenset()
-        held = set()
-        for attr in m.group("locks").split("|"):
-            decl = self._canonical(fn, attr, locks, aliases)
-            if decl is not None:
-                held.add(decl.key)
-        return frozenset(held)
+        return entry_held_locks(mod, fn, locks, aliases)
 
     # ---- per-function scan -------------------------------------------------
 
@@ -368,6 +388,8 @@ class LockOrderChecker(Checker):
         order: list[LockKey] = []
         findings: list[Finding] = []
         for mod in mods:
+            if "lock-order" not in mod.source:
+                continue  # directives only; skip the tokenize
             for line_no, text in sorted(mod.comments.items()):
                 m = _ORDER_RE.search(text)
                 if m is None:

@@ -1,0 +1,622 @@
+"""The ``lockset`` checker: path-sensitive race detection.
+
+The lexical ``lock`` rule (locks.py) proves a guarded attribute sits
+inside *some* ``with self.<lock>:`` block, trusting every ``#
+holds-lock:`` annotation it meets.  This rule re-derives the same
+contract over the control-flow graph (:mod:`cfg`) with a must-hold
+lockset dataflow (:mod:`dataflow`), composed interprocedurally on the
+call graph — which buys three things the lexical rule cannot see:
+
+- **Thread roots are enumerated, not assumed.**  Concurrency enters this
+  codebase at known points: ``threading.Thread(target=...)`` call sites
+  (the informer watch loops, the server's GC/defrag loops), the threaded
+  HTTP server's ``do_*`` handler methods, and any ``def`` carrying a
+  ``# thread-root: <reason>`` directive (how a new subsystem registers
+  one — e.g. the chaos-injected crash/restart path).  Enforcement covers
+  every function reachable from a thread root plus every method of a
+  lock-owning class.
+- **``# guarded-by:`` / ``# holds-lock:`` are demoted from trusted input
+  to checked claim.**  A ``# holds-lock: _x`` annotation seeds the entry
+  lockset — and every *caller* of that function is checked to actually
+  hold ``_x`` at the call site.  A claim nobody establishes is a
+  finding, not a free pass.
+- **Non-atomic read-modify-write detection.**  A value read from a
+  guarded attribute under one lock region that flows into a write of the
+  same attribute under a *different* region (the lock was released and
+  re-taken in between — including across a ``Condition.wait()``, which
+  drops the lock mid-``with``) is a lost-update window even though both
+  accesses are individually "under the lock".  Attributes declared
+  ``(writes)`` are exempt: lock-free readers + serialized check-then-act
+  writers is that pattern's documented design.
+
+Locks, Condition aliasing, and canonicalization are shared with
+``lock-order`` (:func:`lockorder.discover_locks`); guard declarations
+are shared with ``lock`` (the ``# guarded-by:`` grammar).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from tputopo.lint.callgraph import (CallGraph, FunctionInfo, graph_for)
+from tputopo.lint.cfg import CFGNode, cfg_for, walk_exprs as _walk_exprs
+from tputopo.lint.core import Checker, Finding, Module, dotted_name
+from tputopo.lint.dataflow import run_forward
+from tputopo.lint.lockorder import (LockKey, canonical_lock, discover_locks,
+                                    entry_held_locks)
+from tputopo.lint.locks import _GUARDED_RE, _GuardDecl, _self_attr
+from tputopo.lint.nocopy import _MUTATING_METHODS
+
+_THREAD_ROOT_RE = re.compile(r"#\s*thread-root:\s*(?P<reason>.*\S)")
+
+#: Text markers that make a module worth scanning for roots/claims.
+_ROOT_MARKERS = ("Thread(", "thread-root", "BaseHTTPRequestHandler")
+
+
+class _ClassGuards:
+    """Guard declarations of one class: attr -> (_GuardDecl, canonical
+    lock keys the declaration accepts)."""
+
+    __slots__ = ("decls",)
+
+    def __init__(self) -> None:
+        self.decls: dict[str, tuple[_GuardDecl, frozenset[LockKey]]] = {}
+
+
+# Fact shape (immutable, hashable):
+#   held:  tuple of (LockKey, frozenset[region]) sorted by key
+#   taint: frozenset of (name, attr, LockKey, frozenset[region])
+# A region is ("with", id(With-node)) / ("acq", node-idx) / ("entry",)
+# / ("wait", node-idx, owner) — the OWNER (the With that created the
+# hold) survives a Condition.wait() re-region, so the matching
+# with_exit still releases it; an id-offset scheme would leak the hold
+# past the with after any wait().
+_EMPTY_FACT = ((), frozenset())
+
+
+def _held_to_map(held) -> dict:
+    return {k: r for k, r in held}
+
+
+def _map_to_held(m: dict) -> tuple:
+    return tuple(sorted(m.items()))
+
+
+def _region_owner(region) -> int | None:
+    """The id() of the With node a region belongs to, or None for
+    entry/manual-acquire holds (released by annotation scope or
+    ``.release()``, never by a with_exit)."""
+    if region[0] == "with":
+        return region[1]
+    if region[0] == "wait":
+        return region[2]
+    return None
+
+
+class _LocksetAnalysis:
+    """The per-function must-hold dataflow (see module docstring)."""
+
+    def __init__(self, checker: "LocksetChecker", fn: FunctionInfo,
+                 graph: CallGraph, entry_held: frozenset[LockKey]) -> None:
+        self.checker = checker
+        self.fn = fn
+        self.graph = graph
+        self.entry_held = entry_held
+        self.locks = checker.locks
+        self.aliases = checker.aliases
+
+    def entry_fact(self):
+        return (tuple(sorted((k, frozenset({("entry",)}))
+                             for k in self.entry_held)),
+                frozenset())
+
+    def join(self, a, b):
+        am, bm = _held_to_map(a[0]), _held_to_map(b[0])
+        held = {k: am[k] | bm[k] for k in am.keys() & bm.keys()}
+        return (_map_to_held(held), a[1] | b[1])
+
+    # -- helpers -------------------------------------------------------------
+
+    def _lock_of_expr(self, expr: ast.AST):
+        attr = _self_attr(expr)
+        if attr is None:
+            return None
+        return canonical_lock(self.fn, attr, self.locks, self.aliases)
+
+    def transfer(self, node: CFGNode, fact):
+        held = _held_to_map(fact[0])
+        taint = fact[1]
+        s = node.stmt
+        if node.kind == "with_enter":
+            for item in s.items:
+                decl = self._lock_of_expr(item.context_expr)
+                if decl is not None:
+                    held[decl.key] = (held.get(decl.key, frozenset())
+                                      | {("with", id(s))})
+            return (_map_to_held(held), taint)
+        if node.kind == "with_exit":
+            # Release the regions THIS with owns (wait-re-regioned ones
+            # included — the owner survives the re-region); a reentrant
+            # outer hold of the same lock keeps its other regions.
+            for item in s.items:
+                decl = self._lock_of_expr(item.context_expr)
+                if decl is not None and decl.key in held:
+                    regions = {r for r in held[decl.key]
+                               if _region_owner(r) != id(s)}
+                    if regions:
+                        held[decl.key] = regions
+                    else:
+                        del held[decl.key]
+            return (_map_to_held(held), taint)
+        changed = False
+        new_taint = taint
+        for sub in _walk_exprs(node):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute):
+                if sub.func.attr in ("acquire", "release", "wait"):
+                    decl = self._lock_of_expr(sub.func.value)
+                    if decl is not None:
+                        if sub.func.attr == "acquire":
+                            held[decl.key] = (held.get(decl.key, frozenset())
+                                              | {("acq", node.idx)})
+                        elif sub.func.attr == "release":
+                            held.pop(decl.key, None)
+                        elif decl.key in held:
+                            # Condition.wait() drops and re-takes the
+                            # lock: same hold (same owning with), NEW
+                            # region — a read-before / write-after pair
+                            # spans a real race window.
+                            held[decl.key] = frozenset(
+                                {("wait", node.idx, _region_owner(r))
+                                 for r in held[decl.key]})
+                        changed = True
+        # RMW taint bookkeeping: name <- guarded-attr read.
+        if isinstance(s, ast.Assign) and node.kind == "stmt":
+            src_attr = _self_attr(s.value)
+            guards = self.checker.guards_of(self.fn)
+            # EVERY rebound name kills its stale taint — tuple-unpacking
+            # targets included (a Name-only kill left stale taint behind
+            # `v, other = ...` and produced spurious RMW findings).
+            bound = []
+            for t in s.targets:
+                if isinstance(t, ast.Name):
+                    bound.append(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    bound.extend(e.id for e in t.elts
+                                 if isinstance(e, ast.Name))
+            if bound:
+                # Rebinds kill stale taint for these names.
+                kept = frozenset(e for e in new_taint if e[0] not in bound)
+                taint_bound = [t.id for t in s.targets
+                               if isinstance(t, ast.Name)]
+                if src_attr is not None and guards is not None \
+                        and taint_bound and src_attr in guards.decls:
+                    decl, lock_keys = guards.decls[src_attr]
+                    if not decl.writes_only:
+                        for lk in lock_keys:
+                            regions = held.get(lk)
+                            if regions:
+                                kept = kept | {(n, src_attr, lk, regions)
+                                               for n in taint_bound}
+                if kept != new_taint:
+                    new_taint = kept
+                    changed = True
+        if changed or new_taint is not taint:
+            return (_map_to_held(held), new_taint)
+        return fact
+
+
+class LocksetChecker(Checker):
+    rule = "lockset"
+    description = ("path-sensitive lockset analysis from enumerated "
+                   "thread roots: guarded attributes must be reached "
+                   "with the lock held on EVERY path, # holds-lock: "
+                   "claims are verified at call sites, and non-atomic "
+                   "read-modify-write across lock regions is flagged")
+
+    version = 1
+
+    def __init__(self) -> None:
+        self._mods: list[Module] = []
+        self.locks = {}
+        self.aliases = {}
+        self._guards_by_class: dict[tuple, _ClassGuards] = {}
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(("tputopo/", "tests/"))
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        self._mods.append(mod)
+        return ()
+
+    # ---- guard declarations ------------------------------------------------
+
+    def _collect_init_attrs(self, graph: CallGraph) -> None:
+        """Instance attributes born in ``__init__`` of LOCK-OWNING
+        classes: mutating one of these (container mutation, not a plain
+        rebind) from a thread-reachable method with no class lock held
+        is shared-state corruption waiting for load — flagged even
+        WITHOUT a ``# guarded-by:`` declaration (the unguarded-shared-
+        attribute half of this rule)."""
+        self._init_attrs: dict[tuple, set[str]] = {}
+        lock_classes = {k[0] for k in self.locks}
+        for ci in graph.classes.values():
+            if ci.key not in lock_classes:
+                continue
+            init = ci.methods.get("__init__")
+            if init is None:
+                continue
+            attrs = set()
+            for node in ast.walk(init.node):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign):
+                    targets = [node.target]
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        attrs.add(attr)
+            amap = self.aliases.get(ci.key, {})
+            self._init_attrs[ci.key] = attrs - set(amap)
+
+    def _collect_guards(self, graph: CallGraph,
+                        by_path: dict[str, Module]) -> None:
+        for ci in graph.classes.values():
+            mod = by_path.get(ci.relpath)
+            if mod is None or "guarded-by" not in mod.source:
+                continue
+            init = ci.methods.get("__init__")
+            if init is None:
+                continue
+            cg = _ClassGuards()
+            for node in ast.walk(init.node):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign):
+                    targets = [node.target]
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    m = _GUARDED_RE.search(mod.comment_on_or_above(t.lineno))
+                    if m is None:
+                        continue
+                    decl = _GuardDecl(
+                        frozenset(m.group("locks").split("|")),
+                        m.group("mode") == "writes", t.lineno)
+                    keys = set()
+                    for lname in decl.locks:
+                        ld = canonical_lock(init, lname, self.locks,
+                                            self.aliases)
+                        if ld is not None:
+                            keys.add(ld.key)
+                    cg.decls[attr] = (decl, frozenset(keys))
+            if cg.decls:
+                self._guards_by_class[ci.key] = cg
+
+    def guards_of(self, fn: FunctionInfo) -> _ClassGuards | None:
+        if fn.cls is None:
+            return None
+        merged: _ClassGuards | None = None
+        for c in fn.cls.mro():
+            cg = self._guards_by_class.get(c.key)
+            if cg is None:
+                continue
+            if merged is None:
+                merged = cg
+            else:  # subclass sees base guards too (rare; merge lazily)
+                both = _ClassGuards()
+                both.decls = {**cg.decls, **merged.decls}
+                merged = both
+        return merged
+
+    # ---- thread roots ------------------------------------------------------
+
+    def _thread_roots(self, graph: CallGraph,
+                      by_path: dict[str, Module]
+                      ) -> tuple[dict[tuple, str], list[Finding]]:
+        """{function key: reason} for every discovered thread root."""
+        roots: dict[tuple, str] = {}
+        findings: list[Finding] = []
+        for fn in graph.functions.values():
+            if not fn.relpath.startswith("tputopo/"):
+                continue
+            mod = by_path.get(fn.relpath)
+            if mod is None or not any(mk in mod.source
+                                      for mk in _ROOT_MARKERS):
+                continue
+            # (a) explicit directive on the def line
+            m = _THREAD_ROOT_RE.search(
+                mod.comment_on_or_above(fn.node.lineno))
+            if m is not None:
+                roots[fn.key] = f"declared: {m.group('reason')}"
+            # (b) threading.Thread(target=...) call sites
+            for site in graph.callees(fn):
+                if site.dotted is None or \
+                        site.dotted.rsplit(".", 1)[-1] != "Thread":
+                    continue
+                target = next((kw.value for kw in site.node.keywords
+                               if kw.arg == "target"), None)
+                if target is None:
+                    continue
+                resolved = graph._resolve_target(target, fn)
+                if isinstance(resolved, FunctionInfo):
+                    roots.setdefault(
+                        resolved.key,
+                        f"Thread target at {fn.relpath}:"
+                        f"{site.node.lineno}")
+                else:
+                    findings.append(Finding(
+                        fn.relpath, site.node.lineno,
+                        site.node.col_offset, self.rule,
+                        "thread root could not be resolved: Thread("
+                        "target=...) does not name a known function — "
+                        "name it directly or mark the target def with "
+                        "`# thread-root: <reason>`"))
+        # (c) HTTP handler methods (ThreadingHTTPServer runs each
+        # request on its own thread).
+        for ci in graph.classes.values():
+            if not ci.relpath.startswith("tputopo/"):
+                continue
+            base_names = {b for e in ci.base_exprs
+                          if (b := dotted_name(e)) is not None}
+            if not any("BaseHTTPRequestHandler" in b or "_Handler" in b
+                       for b in base_names):
+                continue
+            for name, meth in ci.methods.items():
+                if name.startswith("do_"):
+                    roots.setdefault(meth.key,
+                                     "HTTP handler (threaded server)")
+        return roots, findings
+
+    # ---- the analysis ------------------------------------------------------
+
+    def finalize(self) -> Iterable[Finding]:
+        mods, self._mods = self._mods, []
+        graph = graph_for(mods)
+        by_path = {m.relpath: m for m in mods}
+        self._mods_by_path = by_path
+        self.locks, self.aliases = discover_locks(graph)
+        if not self.locks:
+            return
+        self._collect_guards(graph, by_path)
+        self._collect_init_attrs(graph)
+        roots, findings = self._thread_roots(graph, by_path)
+
+        # Reachability from thread roots, remembering one example path
+        # for messages (shared helper with hot-path-scan).
+        parent = graph.closure_with_parents(roots)
+
+        lock_classes = {k[0] for k in self.locks}
+        enforce: set[tuple] = set(parent)
+        for fn in graph.functions.values():
+            if fn.cls is not None and fn.cls.key in lock_classes:
+                enforce.add(fn.key)
+
+        for key in sorted(enforce):
+            fn = graph.functions.get(key)
+            if fn is None or not fn.relpath.startswith("tputopo/"):
+                continue
+            if fn.qualname.endswith("__init__"):
+                continue  # the object is not shared yet
+            mod = by_path.get(fn.relpath)
+            if mod is None:
+                continue
+            findings.extend(self._check_function(graph, mod, fn, roots,
+                                                 parent))
+        yield from findings
+
+    def _root_path(self, graph: CallGraph, parent, roots,
+                   key: tuple) -> str:
+        via = graph.render_entry_path(parent, key)
+        root_key = key
+        while parent.get(root_key) is not None:
+            root_key = parent[root_key]
+        reason = roots.get(root_key, "")
+        return f"{via} [{reason}]" if reason else via
+
+    def _check_function(self, graph: CallGraph, mod: Module,
+                        fn: FunctionInfo, roots, parent) -> list[Finding]:
+        guards = self.guards_of(fn)
+        # Cheap relevance gate: the function must touch a guarded attr,
+        # a lock primitive, or call an annotated helper.
+        callee_claims: dict[int, tuple[FunctionInfo, frozenset]] = {}
+        for site in graph.callees(fn):
+            callee = site.callee
+            if callee is None or not callee.relpath.startswith("tputopo/"):
+                continue
+            cmod = self._mod_of(callee.relpath)
+            if cmod is None or "holds-lock" not in cmod.source:
+                continue
+            claimed = entry_held_locks(cmod, callee, self.locks,
+                                       self.aliases)
+            if claimed:
+                callee_claims[id(site.node)] = (callee, claimed)
+        touches_guard = guards is not None and any(
+            attr in mod.source for attr in guards.decls)
+        reachable = fn.key in parent
+        shared_attrs = self._shared_attrs_of(fn) if reachable else frozenset()
+        if not touches_guard and not callee_claims and not shared_attrs:
+            return []
+
+        entry = entry_held_locks(mod, fn, self.locks, self.aliases)
+        analysis = _LocksetAnalysis(self, fn, graph, entry)
+        cfg = cfg_for(fn)
+        out: list[Finding] = []
+        in_facts = run_forward(cfg, analysis)
+
+        for node in cfg.nodes:
+            fact = in_facts.get(node.idx)
+            if fact is None:
+                continue
+            # The fact AFTER this node's own acquisitions: accesses in a
+            # with_enter node (none) / statements see the pre-state; for
+            # plain statements the pre-state is correct (an acquire in
+            # the same statement cannot guard its own expression).
+            held = _held_to_map(fact[0])
+            taint = fact[1]
+            if guards is not None:
+                out.extend(self._check_accesses(mod, fn, node, held, taint,
+                                                guards, roots, parent,
+                                                graph, reachable))
+            if shared_attrs and not held:
+                out.extend(self._check_unannotated(mod, fn, node,
+                                                   shared_attrs,
+                                                   guards, roots, parent,
+                                                   graph))
+            for sub in _walk_exprs(node):
+                if isinstance(sub, ast.Call):
+                    claim = callee_claims.get(id(sub))
+                    if claim is None:
+                        continue
+                    callee, locks_needed = claim
+                    missing = [lk for lk in locks_needed if lk not in held]
+                    if missing:
+                        names = ", ".join(self.locks[lk].display
+                                          for lk in missing)
+                        out.append(Finding(
+                            mod.relpath, sub.lineno, sub.col_offset,
+                            self.rule,
+                            f"call to {callee.qualname}() which claims "
+                            f"`# holds-lock: {names}` — but this path "
+                            "does not hold it; take the lock here or "
+                            "fix the annotation (claims are checked, "
+                            "not trusted)"))
+        return out
+
+    _mods_by_path: dict[str, Module] | None = None
+
+    def _mod_of(self, relpath: str) -> Module | None:
+        return (self._mods_by_path or {}).get(relpath)
+
+    def _shared_attrs_of(self, fn: FunctionInfo) -> frozenset[str]:
+        """Init-born attrs of ``fn``'s (lock-owning) class hierarchy."""
+        if fn.cls is None:
+            return frozenset()
+        out: set[str] = set()
+        for c in fn.cls.mro():
+            out |= self._init_attrs.get(c.key, set())
+        return frozenset(out)
+
+    @staticmethod
+    def _self_attr_root(expr: ast.AST) -> str | None:
+        """The ``self.<attr>`` prefix under at least one more
+        subscript/attribute layer (``self.m["k"]``, ``self.m.field``) —
+        a store here mutates the CONTAINER, not the attribute slot."""
+        seen_layer = False
+        while isinstance(expr, (ast.Subscript, ast.Attribute)):
+            attr = _self_attr(expr)
+            if attr is not None:
+                return attr if seen_layer else None
+            seen_layer = True
+            expr = expr.value
+        return None
+
+    def _check_unannotated(self, mod, fn, node: CFGNode, shared_attrs,
+                           guards, roots, parent, graph) -> list[Finding]:
+        """Container mutations of unannotated init-born attributes with
+        no class lock held, in thread-reachable code.  Plain attribute
+        rebinds (``self.x = y``) are NOT flagged — a pointer swap is
+        atomic under the GIL and is the published-pair pattern's
+        foundation; what races is in-place container mutation."""
+        declared = set(guards.decls) if guards is not None else set()
+        out = []
+
+        def flag(ast_node, attr: str, what: str) -> None:
+            via = self._root_path(graph, parent, roots, fn.key)
+            out.append(Finding(
+                mod.relpath, ast_node.lineno, ast_node.col_offset,
+                self.rule,
+                f"unguarded {what} of shared self.{attr} with no lock "
+                f"held — reachable from thread root via {via}; declare "
+                f"it `# guarded-by: <lock>` on its __init__ assignment "
+                "and take the lock (or move the mutation under one)"))
+
+        for sub in _walk_exprs(node):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in _MUTATING_METHODS:
+                # Direct container mutation only (self.attr.pop(...)) —
+                # a method on an ELEMENT (self._synced[k].clear()) may
+                # be that object's own thread-safe primitive.
+                attr = _self_attr(sub.func.value)
+                if attr in shared_attrs and attr not in declared:
+                    flag(sub, attr, f"mutating call .{sub.func.attr}()")
+        s = node.stmt
+        if isinstance(s, ast.Assign):
+            for t in s.targets:
+                attr = self._self_attr_root(t)
+                if attr in shared_attrs and attr not in declared:
+                    flag(t, attr, "container store")
+        elif isinstance(s, ast.AugAssign):
+            attr = self._self_attr_root(s.target) or _self_attr(s.target)
+            if attr in shared_attrs and attr not in declared:
+                flag(s, attr, "read-modify-write")
+        elif isinstance(s, ast.Delete):
+            for t in s.targets:
+                attr = self._self_attr_root(t)
+                if attr in shared_attrs and attr not in declared:
+                    flag(t, attr, "del")
+        return out
+
+    def _check_accesses(self, mod, fn, node: CFGNode, held, taint, guards,
+                        roots, parent, graph, reachable) -> list[Finding]:
+        out = []
+        for sub in _walk_exprs(node):
+            attr = _self_attr(sub)
+            if attr is None or attr not in guards.decls:
+                continue
+            decl, lock_keys = guards.decls[attr]
+            is_store = isinstance(sub.ctx, (ast.Store, ast.Del))
+            if decl.writes_only and not is_store:
+                continue
+            held_regions = set()
+            for lk in lock_keys:
+                held_regions |= held.get(lk, set())
+            if not held_regions:
+                what = "write" if is_store else "read"
+                where = ""
+                if reachable:
+                    where = (" — reachable from thread root via "
+                             + self._root_path(graph, parent, roots,
+                                               fn.key))
+                locks_txt = "|".join(sorted(
+                    self.locks[lk].display for lk in lock_keys)) or \
+                    "|".join(sorted(decl.locks))
+                out.append(Finding(
+                    mod.relpath, sub.lineno, sub.col_offset, self.rule,
+                    f"self.{attr} ({what}) on a path where no declared "
+                    f"guard ({locks_txt}) is held{where}; wrap the "
+                    "access or annotate the helper with "
+                    "`# holds-lock:` (the claim is then checked at "
+                    "every call site)"))
+                continue
+            # Non-atomic RMW: this write's value derives from a read of
+            # the same attribute taken under a DIFFERENT lock region.
+            if is_store and not decl.writes_only \
+                    and isinstance(node.stmt, (ast.Assign, ast.AugAssign)):
+                value = getattr(node.stmt, "value", None)
+                if value is None:
+                    continue
+                used = {n.id for n in ast.walk(value)
+                        if isinstance(n, ast.Name)}
+                for (tname, tattr, tlk, tregions) in taint:
+                    if tattr != attr or tname not in used:
+                        continue
+                    if not (tregions & held_regions):
+                        out.append(Finding(
+                            mod.relpath, sub.lineno, sub.col_offset,
+                            self.rule,
+                            f"non-atomic read-modify-write of self."
+                            f"{attr}: the value derives from a read "
+                            f"(via {tname!r}) taken under a different "
+                            "lock region — the lock was released in "
+                            "between, so a concurrent writer can be "
+                            "lost; hold the lock across the full "
+                            "sequence"))
+                        break
+        return out

@@ -1,0 +1,271 @@
+"""The ``release-on-all-paths`` checker: paired obligations must close.
+
+Three acquisition idioms in this codebase create an obligation the
+function must discharge on EVERY path out — including the exception
+edges the CFG models:
+
+- a **manual lock acquire** — ``self._lock.acquire()`` must reach
+  ``self._lock.release()``;
+- a **manual span/context enter** — ``span.__enter__()`` must reach
+  ``span.__exit__(...)`` (the flight recorder's phase spans; the bind
+  verb's publish section used exactly this shape);
+- a **saved-and-overwritten attribute** — the retry/backfill-budget
+  pattern ``saved = self.X; ...; self.X = <other>; ...; self.X = saved``
+  must restore on all paths (the sim engine's terminal drain does this
+  around ``max_backfill_failures``).
+
+For each obligation-opening node, the rule asks the CFG: is the
+function exit reachable without passing a closing node?  Exception
+edges make the interesting cases real — a call that can raise between
+``__enter__`` and ``__exit__`` leaks the span even though the straight-
+line code looks paired.  The fix the finding prescribes is structural:
+use ``with`` (the CFG's ``with_exit`` node closes on every path by
+construction) or ``try``/``finally``.
+
+Scoped to ``tputopo/`` — test fixtures deliberately exercise unbalanced
+shapes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tputopo.lint.callgraph import graph_for
+from tputopo.lint.cfg import CFG, CFGNode, cfg_for, walk_exprs
+from tputopo.lint.core import Checker, Finding, Module, dotted_name
+
+#: acquire-method -> the method that discharges it.
+_PAIRS = {"acquire": "release", "__enter__": "__exit__"}
+
+
+def _call_on_base(node: ast.AST, methods) -> tuple[str, str] | None:
+    """``(dotted base, method)`` when ``node`` is ``<base>.<m>(...)``
+    with ``m`` in ``methods`` and a static dotted base."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in methods:
+        base = dotted_name(node.func.value)
+        if base is not None:
+            return base, node.func.attr
+    return None
+
+
+class _Obligation:
+    __slots__ = ("open_node", "ast_node", "describe", "closes")
+
+    def __init__(self, open_node: CFGNode, ast_node: ast.AST,
+                 describe: str, closes) -> None:
+        self.open_node = open_node
+        self.ast_node = ast_node
+        self.describe = describe
+        self.closes = closes  # predicate: CFGNode -> bool
+
+
+def _node_asts(node: CFGNode):
+    return walk_exprs(node)
+
+
+class ReleasePathsChecker(Checker):
+    rule = "release-on-all-paths"
+    description = ("manually acquired locks (.acquire()), manually "
+                   "entered spans (.__enter__()), and saved-then-"
+                   "overwritten attributes (retry budgets) must be "
+                   "released/restored on every CFG path out, exception "
+                   "edges included — use `with` or try/finally")
+
+    version = 1
+
+    def __init__(self) -> None:
+        self._mods: list[Module] = []
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(("tputopo/", "tests/"))
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        self._mods.append(mod)
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        mods, self._mods = self._mods, []
+        graph = graph_for(mods)
+        by_path = {m.relpath: m for m in mods}
+        restore_mods = self._modules_with_restore_shapes(mods)
+        for fn in sorted(graph.functions.values(), key=lambda f: f.key):
+            if not fn.relpath.startswith("tputopo/"):
+                continue
+            mod = by_path.get(fn.relpath)
+            if mod is None:
+                continue
+            has_manual = ".acquire(" in mod.source \
+                or ".__enter__(" in mod.source
+            has_restore = (self._save_restore_candidates(fn)
+                           if fn.relpath in restore_mods else {})
+            if not has_manual and not has_restore:
+                continue
+            cfg = cfg_for(fn)
+            obligations = []
+            if has_manual:
+                obligations += self._manual_obligations(cfg)
+            obligations += self._restore_obligations(cfg, has_restore)
+            for ob in obligations:
+                if cfg.reachable_without(ob.open_node, ob.closes):
+                    yield Finding(
+                        fn.relpath, ob.ast_node.lineno,
+                        ob.ast_node.col_offset, self.rule,
+                        f"{ob.describe} is not released/restored on "
+                        "every path out of "
+                        f"{fn.qualname}() (exception edges included) — "
+                        "use `with`, or wrap the span in try/finally")
+
+    # ---- manual acquire/enter ---------------------------------------------
+
+    def _manual_obligations(self, cfg: CFG) -> list[_Obligation]:
+        out = []
+        for node in cfg.nodes:
+            for sub in _node_asts(node):
+                got = _call_on_base(sub, _PAIRS)
+                if got is None:
+                    continue
+                base, meth = got
+                closer = _PAIRS[meth]
+
+                def closes(n, base=base, closer=closer):
+                    for s in _node_asts(n):
+                        c = _call_on_base(s, {closer})
+                        if c is not None and c[0] == base:
+                            return True
+                    return False
+
+                out.append(_Obligation(
+                    node, sub,
+                    f"manual `{base}.{meth}()`", closes))
+        return out
+
+    # ---- saved-attribute restore (retry budgets) ---------------------------
+
+    @staticmethod
+    def _self_attr_of(expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            return expr.attr
+        return None
+
+    def _modules_with_restore_shapes(self, mods) -> set[str]:
+        """Modules holding BOTH a ``name = self.attr`` save and a
+        ``self.attr = name`` restore for the same attr *somewhere* —
+        one pass over the cached node lists; the per-function scan runs
+        only inside these (most modules have neither shape paired)."""
+        out = set()
+        for mod in mods:
+            if not mod.relpath.startswith("tputopo/"):
+                continue
+            saves: dict[str, set[str]] = {}
+            restores: dict[str, set[str]] = {}
+            for node in mod.nodes():
+                if not isinstance(node, ast.Assign) \
+                        or len(node.targets) != 1:
+                    continue
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    attr = self._self_attr_of(node.value)
+                    if attr is not None:
+                        saves.setdefault(attr, set()).add(t.id)
+                else:
+                    attr = self._self_attr_of(t)
+                    if attr is not None and isinstance(node.value, ast.Name):
+                        restores.setdefault(attr, set()).add(node.value.id)
+            if any(saves.get(a, set()) & restores.get(a, set())
+                   for a in saves):
+                out.add(mod.relpath)
+        return out
+
+    def _save_restore_candidates(self, fn) -> dict[str, set[str]]:
+        """{attr: {local names that saved it}} for attributes with BOTH
+        a ``local = self.attr`` save and a ``self.attr = local`` restore
+        somewhere in the function — the only shape that creates a
+        restore obligation."""
+        saves: dict[str, set[str]] = {}
+        restores: dict[str, set[str]] = {}
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            t = node.targets[0]
+            if isinstance(t, ast.Name):
+                attr = self._self_attr_of(node.value)
+                if attr is not None:
+                    saves.setdefault(attr, set()).add(t.id)
+            else:
+                attr = self._self_attr_of(t)
+                if attr is not None and isinstance(node.value, ast.Name):
+                    restores.setdefault(attr, set()).add(node.value.id)
+        return {attr: names & restores.get(attr, set())
+                for attr, names in saves.items()
+                if names & restores.get(attr, set())}
+
+    def _restore_obligations(self, cfg: CFG,
+                             candidates: dict[str, set[str]]
+                             ) -> list[_Obligation]:
+        """The obligation opens at an OVERWRITE of a saved attribute
+        (``self.X = <something other than the saved name>``) and closes
+        at any restore (``self.X = saved_name``) — but ONLY at
+        overwrites the save actually dominates: a must-saved dataflow
+        gates it, so an unrelated ``self.X = 1`` on a branch that never
+        saved is not an obligation (review-verified false positive)."""
+        out = []
+        if not candidates:
+            return out
+        checker = self
+
+        class _MustSaved:
+            """fact: frozenset of attrs saved on EVERY path in."""
+
+            def entry_fact(self):
+                return frozenset()
+
+            def join(self, a, b):
+                return a & b
+
+            def transfer(self, node, fact):
+                s = node.stmt
+                if node.kind == "stmt" and isinstance(s, ast.Assign) \
+                        and len(s.targets) == 1 \
+                        and isinstance(s.targets[0], ast.Name):
+                    attr = checker._self_attr_of(s.value)
+                    if attr in candidates \
+                            and s.targets[0].id in candidates[attr]:
+                        return fact | {attr}
+                return fact
+
+        from tputopo.lint.dataflow import run_forward
+
+        saved_in = run_forward(cfg, _MustSaved())
+        for node in cfg.nodes:
+            s = node.stmt
+            if node.kind != "stmt" or not isinstance(s, ast.Assign) \
+                    or len(s.targets) != 1:
+                continue
+            attr = self._self_attr_of(s.targets[0])
+            if attr not in candidates:
+                continue
+            if attr not in saved_in.get(node.idx, frozenset()):
+                continue  # no save on (all) paths here — not the pattern
+            names = candidates[attr]
+            if isinstance(s.value, ast.Name) and s.value.id in names:
+                continue  # this IS the restore
+            if self._self_attr_of(s.value) == attr:
+                continue  # self.X = self.X — the save shape, not a clobber
+
+            def closes(n, attr=attr, names=names):
+                st = n.stmt
+                return (n.kind == "stmt" and isinstance(st, ast.Assign)
+                        and len(st.targets) == 1
+                        and self._self_attr_of(st.targets[0]) == attr
+                        and isinstance(st.value, ast.Name)
+                        and st.value.id in names)
+
+            out.append(_Obligation(
+                node, s,
+                f"saved attribute `self.{attr}` (overwritten here)",
+                closes))
+        return out

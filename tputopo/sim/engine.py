@@ -944,11 +944,13 @@ class SimEngine:
         tr = self.tracer.start("preempt", job=spec.name)
         with tr:
             with tr.phase("plan") as sp:
+                # tpulint: disable=hot-path-scan -- amortized: preemption planning runs only when a high tier is capacity-blocked (volume-gated in _schedule_tiered), not per wake
                 state = ClusterState(self._plan_api,
                                      assume_ttl_s=self.assume_ttl_s,
                                      clock=self.clock).sync()
                 plan = plan_preemption(
                     state, (spec.replicas, spec.chips), spec.priority,
+                    # tpulint: disable=hot-path-scan -- amortized: same gate as the sync above — one victim-candidate listing per considered preemption plan
                     list_pods_nocopy(self._plan_api),
                     max_moves=int(knobs["max_moves"]),
                     max_chips_moved=int(knobs["max_chips_moved"]))

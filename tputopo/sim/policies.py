@@ -386,6 +386,7 @@ class BaselinePolicy(PlacementPolicy):
         self._counters["plans"] += 1
         state = self._cached_state
         if state is None:
+            # tpulint: disable=hot-path-scan -- KNOWN fleet-scale bottleneck, now CI-tracked: invalidate()'s conservative drop forces this full sync (~35% sim wall); the ROADMAP item is to fold engine events like the ici policy, keeping the decision stream bit-stable
             state = self._cached_state = ClusterState(
                 self.api, assume_ttl_s=self.assume_ttl_s,
                 clock=self.clock).sync()
