@@ -437,7 +437,8 @@ def bench_scale(n_domains: int = 4, spec: str = "v5p:8x8x4",
     return out
 
 
-def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0) -> dict:
+def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0,
+              fleet_nodes: int = 256, fleet_arrivals: int = 2000) -> dict:
     """Trace-driven sim scenario (tputopo.sim): one deterministic Poisson
     trace replayed under the ICI-aware policy AND the count-only baseline,
     reported as the A/B block future perf/policy PRs diff against.  Pure
@@ -496,6 +497,46 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0) -> dict:
     # and the preemption counters next to the standing events_per_s
     # figure — the "millions of users" axis future priority/fairness PRs
     # diff against.
+    # Fleet-scale trace (the second standing figure): a multi-domain
+    # offered-load replay — 256/2000 here (CI-runnable), with
+    # `python -m tputopo.sim --nodes 1024 --arrivals 10000
+    # --offered-load 0.73 --no-trace` as the documented dev-host
+    # standing command.  events_per_s is the throughput figure perf PRs
+    # move at scale; the invalidate split is the rebuild-avoidance
+    # evidence (delta folds vs forced full syncs); phase_wall_ms comes
+    # from a traced replay of the same trace.
+    fleet_cfg = TraceConfig(seed=seed, nodes=fleet_nodes,
+                            arrivals=fleet_arrivals, offered_load=0.73)
+    fleet = run_trace(fleet_cfg, ["ici", "naive"], flight_trace=False)
+    # Only the ici phase breakdown is consumed from the traced replay —
+    # one policy keeps the second 2000-arrival run at half cost.
+    fleet_traced = run_trace(fleet_cfg, ["ici"])
+    fp = fleet["policies"]
+    out["fleet"] = {
+        "nodes": fleet["trace"]["nodes"],
+        "chips": fleet["trace"]["chips"],
+        "arrivals": fleet_arrivals,
+        "offered_load": fleet["trace"]["offered_load"],
+        "events": fleet["throughput"]["events"],
+        "events_per_s": fleet["throughput"]["events_per_s"],
+        "wall_s": fleet["throughput"]["wall_s"],
+        "phase_wall_ms": fleet_traced.get("phase_wall", {}).get("ici", {}),
+        "state_maintenance": {
+            name: {k: v for k, v in fp[name]["scheduler"].items()
+                   if k.startswith(("invalidate_", "state_"))}
+            for name in ("ici", "naive")
+        },
+        "ab_deltas": fleet["ab"]["deltas"]["ici-vs-naive"],
+    }
+    for name in ("ici", "naive"):
+        p = fp[name]
+        out["fleet"][name] = {
+            "queue_wait_p95_s": p["queue_wait_s"]["p95"],
+            "utilization": p["chip_utilization"]["time_weighted_mean"],
+            "fragmentation": p["fragmentation"]["time_weighted_mean"],
+            "bw_vs_ideal": p["ici_bw_score"]["mean_vs_ideal"],
+            "scheduled": p["jobs"]["scheduled"],
+        }
     mixed = run_trace(
         TraceConfig(seed=seed, nodes=nodes, arrivals=arrivals,
                     workload="mixed"),

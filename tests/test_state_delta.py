@@ -344,6 +344,93 @@ def test_sim_report_carries_state_maintenance_counters():
     assert c["state_delta_applied"] > c["state_full_rebuilds"]
 
 
+# ---- differential replay: baseline delta folding vs the full drop ------------
+
+
+def _baseline_run(cfg, delta_fold: bool, policy: str = "naive"):
+    """One baseline-policy engine run, returning (decision stream, report,
+    scheduler counters).  ``delta_fold=False`` flips the kill switch to
+    the historical drop-on-every-invalidate implementation — the
+    differential comparator."""
+    from tputopo.sim.engine import SimEngine
+    from tputopo.sim.trace import generate_trace
+
+    engine = SimEngine(generate_trace(cfg), policy)
+    engine.policy.delta_fold = delta_fold
+    engine.run_events()
+    rs = engine.run_state()
+    stream = json.dumps(rs.decision_log, sort_keys=True)
+    report = engine.finalize(engine.horizon_s)
+    return stream, report, rs.counters
+
+
+def test_baseline_delta_decisions_match_full_drop_standard_trace():
+    """The tentpole's hard constraint for the BASELINE side, replayed on
+    the standard 64/500 trace: the delta-folding baseline must emit a
+    byte-identical decision log — and an identical report outside the
+    state-maintenance counters that OBSERVE the strategy — vs the prior
+    conservative full-drop implementation (mirrors the ici
+    delta-vs-full-rebuild differential above)."""
+    from tputopo.sim.trace import TraceConfig
+
+    cfg = TraceConfig(seed=0, nodes=64, arrivals=500)
+    d_stream, d_report, d_c = _baseline_run(cfg, delta_fold=True)
+    f_stream, f_report, f_c = _baseline_run(cfg, delta_fold=False)
+    assert d_stream == f_stream
+    d = {k: v for k, v in d_report.items() if k != "scheduler"}
+    f = {k: v for k, v in f_report.items() if k != "scheduler"}
+    assert json.dumps(d, sort_keys=True) == json.dumps(f, sort_keys=True)
+    # The delta run actually folded instead of dropping: full rebuilds
+    # collapse to the node-churn events (trace default: 2 failures ->
+    # fail + repair), everything else rode with_events.
+    assert d_c["invalidate_delta_applied"] > 0
+    assert d_c["invalidate_drops_avoided"] > 100
+    assert d_c["invalidate_full_drops"] <= 2 * cfg.node_failures
+    assert "invalidate_drops" not in d_c
+    # And the comparator really ran the historical path, with its
+    # historical counter vocabulary.
+    assert f_c["invalidate_drops"] > 100
+    assert "invalidate_delta_applied" not in f_c
+
+
+def test_baseline_journal_gap_falls_back_and_stays_bit_stable(monkeypatch):
+    """An event burst outrunning the bounded buffer (the fleet-scale
+    journal-gap analog) must degrade to a counted full sync — and the
+    decision stream must not move: the fallback is a perf event, never a
+    behavior change."""
+    from tputopo.sim import policies as pol
+    from tputopo.sim.trace import TraceConfig
+
+    cfg = TraceConfig(seed=3, nodes=16, arrivals=120, ghost_prob=0.1)
+    ref_stream, _, ref_c = _baseline_run(cfg, delta_fold=True)
+    assert ref_c.get("invalidate_full_drop_journal_gap", 0) == 0
+    # A 2-event buffer: every completed gang's DELETED burst (and every
+    # GC wipe batch) overflows it.
+    monkeypatch.setattr(pol.BaselinePolicy, "_EVENT_BUFFER_MAX", 2)
+    gap_stream, _, gap_c = _baseline_run(cfg, delta_fold=True)
+    assert gap_c["invalidate_full_drop_journal_gap"] > 10
+    assert gap_stream == ref_stream
+
+
+def test_event_has_impact_prescreen():
+    """The O(1) no-op screen: arrival ADDEDs and unknown DELETEDs are
+    provably derived-state-neutral; recorded pods and node events always
+    report impact."""
+    clock = _Clock()
+    api, _ = build_cluster(clock=clock)
+    state = _sync(api, clock)
+    pending = make_pod("idle-0", chips=1)
+    assert not state.event_has_impact("pods", "ADDED", pending)
+    assert not state.event_has_impact("pods", "DELETED", pending)
+    bound = _bind(api, "held-0", "node-0", [(0, 0, 0)], clock)
+    assert state.event_has_impact("pods", "ADDED", bound)  # carries a claim
+    state2 = _sync(api, clock)
+    assert state2.event_has_impact(
+        "pods", "DELETED", {"metadata": {"name": "held-0",
+                                         "namespace": "default"}})
+    assert state2.event_has_impact("nodes", "MODIFIED", {"metadata": {}})
+
+
 # ---- perf smoke (slow tier) --------------------------------------------------
 
 

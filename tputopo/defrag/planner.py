@@ -27,6 +27,7 @@ from dataclasses import dataclass
 from tputopo.extender.scheduler import (LABEL_ALLOW_MULTISLICE, _gang_of,
                                         _host_grid)
 from tputopo.extender.state import ClusterState, SliceDomain
+from tputopo.extender.state import list_pods_nocopy as list_pods_nocopy
 from tputopo.k8s import objects as ko
 from tputopo.topology.model import ChipTopology, Coord
 from tputopo.topology.slices import (Allocator, _boxes_for, _chip_masks,
@@ -90,15 +91,8 @@ def dedupe_demands(pairs) -> list[tuple[int, int]]:
     return sorted(set(pairs), key=lambda rk: (-(rk[0] * rk[1]), -rk[0]))
 
 
-def list_pods_nocopy(api) -> list[dict]:
-    """Read-only pod listing, copy-free where the reader supports the
-    hint (informer mirror / fake API nocopy) — the shared shim for every
-    defrag consumer (controller demand derivation, /debug/defrag)."""
-    try:
-        # tpulint: disable=nocopy-flow -- THE documented copy-free shim: every defrag consumer (demand derivation, /debug/defrag) reads the listing and keeps nothing
-        return api.list("pods", copy=False)
-    except TypeError:  # reader without a copy kwarg (fake/REST client)
-        return api.list("pods")
+# list_pods_nocopy moved to tputopo.extender.state (the GC sweep shares
+# it now); re-exported above for the existing defrag-side importers.
 
 
 def pending_demand(pods) -> list[tuple[int, int]]:

@@ -20,13 +20,17 @@ from __future__ import annotations
 import time
 
 from tputopo.k8s import objects as ko
-from tputopo.k8s.fakeapi import Conflict, FakeApiServer, NotFound
+from tputopo.k8s.fakeapi import Conflict, NotFound
 from tputopo.k8s.retry import ApiUnavailable
-from tputopo.extender.state import ClusterState
+from tputopo.extender.state import _pod_assignment_of, list_pods_nocopy
 
 
 class AssumptionGC:
-    def __init__(self, api_server: FakeApiServer, assume_ttl_s: float = 60.0,
+    # ``api_server`` is deliberately untyped: the sweeper runs against
+    # every reader/writer shape the control plane uses — FakeApiServer,
+    # the REST KubeApiClient, the sim's copy-free facade, the chaos
+    # proxy — needing only list/patch_annotations.
+    def __init__(self, api_server, assume_ttl_s: float = 60.0,
                  clock=time.time, metrics=None,
                  wall=time.perf_counter) -> None:
         self.api = api_server
@@ -50,29 +54,71 @@ class AssumptionGC:
 
     def sweep(self) -> list[str]:
         """One pass: clear assignments for expired assumptions (and their
-        whole gangs).  Returns the pod names released this pass."""
+        whole gangs).  Returns the pod names released this pass.
+
+        The scan is direct: pods are filtered through the same
+        :func:`_pod_assignment_of` parse sync() uses and judged against
+        the TTL at one clock read — no :class:`ClusterState` build (the
+        full sync here was ~20% of fleet-scale sim wall once the baseline
+        policies stopped re-syncing; the sweep never needed allocators or
+        topology, only the assignment annotations).  Victim ORDER is the
+        old sync-derived order — expired assumptions in (assume_time,
+        namespace, name) order, then gang-expanded members grouped by
+        domain in node-list order — so release patch streams (and the
+        fault draws a chaos run assigns to them) are byte-stable across
+        the rewrite."""
         t0 = self._wall()
-        # tpulint: disable=hot-path-scan -- amortized: one O(pods) sync per TTL-period sweep (gc_period = assume_ttl/2), the documented cost of durable assumption reclaim
-        state = ClusterState(self.api, assume_ttl_s=self.assume_ttl_s,
-                             clock=self.clock).sync()
+        now = self.clock()
+        # TPU nodes only (the known-node gate sync applies), with each
+        # slice's rank in node-name order — the domain iteration order the
+        # gang expansion must reproduce.
+        node_slice: dict[str, str] = {}
+        slice_rank: dict[str, int] = {}
+        try:
+            nodes = self.api.list("nodes", copy=False)
+        except TypeError:  # reader without a copy kwarg (fake/REST client)
+            nodes = self.api.list("nodes")
+        for node in nodes:
+            anns = node["metadata"].get("annotations", {})
+            sid = anns.get(ko.ANN_SLICE_ID)
+            if sid is None or ko.ANN_TOPOLOGY not in anns:
+                continue
+            node_slice[node["metadata"]["name"]] = sid
+            slice_rank.setdefault(sid, len(slice_rank))
+        cands = []
+        # tpulint: disable=hot-path-scan -- amortized: one O(pods) annotation scan per TTL-period sweep (gc_period = assume_ttl/2), the documented cost of durable assumption reclaim
+        for pod in list_pods_nocopy(self.api):
+            pa = _pod_assignment_of(pod)
+            if pa is not None and pa.node_name in node_slice:
+                cands.append(pa)
+        cands.sort(key=lambda pa: (pa.assume_time, pa.namespace,
+                                   pa.pod_name))
         victims: dict[tuple[str, str], None] = {}
         gangs: set[tuple[str, str]] = set()  # (namespace, gang_id)
-        for pa in state.expired:
-            victims[(pa.namespace, pa.pod_name)] = None
-            if pa.gang_id:
-                gangs.add((pa.namespace, pa.gang_id))
+        live: list = []
+        for pa in cands:
+            if not pa.assigned and now - pa.assume_time > self.assume_ttl_s:
+                victims[(pa.namespace, pa.pod_name)] = None
+                if pa.gang_id:
+                    gangs.add((pa.namespace, pa.gang_id))
+            else:
+                live.append(pa)
         # Gang expansion: release every still-unconfirmed member of an
         # expired gang together (a partial gang holds chips a complete gang
         # needs); confirmed members are running — flag, don't release.
         stranded: set[str] = set()
         if gangs:
-            for dom in state.domains.values():
-                for pa in dom.assignments:
-                    if pa.gang_id and (pa.namespace, pa.gang_id) in gangs:
-                        if pa.assigned:
-                            stranded.add(f"{pa.namespace}/{pa.gang_id}")
-                        else:
-                            victims[(pa.namespace, pa.pod_name)] = None
+            members = [pa for pa in live
+                       if pa.gang_id and (pa.namespace, pa.gang_id) in gangs]
+            # Stable sort on the domain rank alone: domain-major, within a
+            # domain the (assume_time, namespace, name) candidate order —
+            # exactly the old per-domain assignment walk.
+            members.sort(key=lambda pa: slice_rank[node_slice[pa.node_name]])
+            for pa in members:
+                if pa.assigned:
+                    stranded.add(f"{pa.namespace}/{pa.gang_id}")
+                else:
+                    victims[(pa.namespace, pa.pod_name)] = None
         self.stranded_gangs.extend(sorted(stranded))
         del self.stranded_gangs[:-100]
         released = []

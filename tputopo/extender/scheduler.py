@@ -41,7 +41,7 @@ from tputopo.k8s.retry import (ApiTimeout, ApiUnavailable, RetryPolicy,
 from tputopo.obs import NULL_TRACER, Tracer
 from tputopo.extender.config import ExtenderConfig
 from tputopo.extender.state import (ClusterState, PodAssignment, SliceDomain,
-                                    _assume_time_of)
+                                    _assume_time_of, full_sync)
 from tputopo.topology.model import ChipTopology, Coord
 from tputopo.topology.score import (_box_of, predict_allreduce_gbps,
                                     predict_multidomain_allreduce_gbps)
@@ -382,9 +382,18 @@ class ExtenderScheduler:
                    != dom.allocator.used_mask}
         memo = getattr(old, "_score_memo", None)
         if memo:
-            kept = {key: v for key, v in memo.items()
-                    if (d := new.domain_of_node(key[1])) is not None
-                    and d.slice_id not in changed} if changed else dict(memo)
+            if changed:
+                # Filter by a precomputed changed-NODE set: a fold never
+                # changes the node->domain map (node churn forces a full
+                # rebuild, which carries nothing), so one set membership
+                # per key replaces the two-method domain lookup that was
+                # the fold tail's top cost on thousand-node fleets.
+                changed_nodes = {n for sid in changed
+                                 for n in new.domains[sid].host_by_node}
+                kept = {key: v for key, v in memo.items()
+                        if key[1] not in changed_nodes}
+            else:
+                kept = dict(memo)
             if kept:
                 new._score_memo = kept
                 self.metrics.inc("score_memo_carried", len(kept))
@@ -496,13 +505,14 @@ class ExtenderScheduler:
             self.metrics.inc("state_full_rebuilds")
             span.count("full_rebuild")
             with span.child("sync"):
-                # tpulint: disable=hot-path-scan -- amortized: the counted cache-miss fallback (state_full_rebuilds); the delta/journal-fold paths above are the steady state
-                state = ClusterState(
+                # The counted cache-miss fallback (state_full_rebuilds);
+                # the delta/journal-fold paths above are the steady state.
+                state = full_sync(
                     reader,
                     cost_for_generation=self.config.cost_model,
                     assume_ttl_s=self.config.assume_ttl_s,
                     clock=self.clock,
-                ).sync()
+                )
             with self._cache_lock:
                 self._cached_state = state
                 self._cached_at = self.clock()
@@ -521,13 +531,15 @@ class ExtenderScheduler:
         self.metrics.inc("state_full_rebuilds")
         span.count("full_rebuild")
         with span.child("sync"):
-            # tpulint: disable=hot-path-scan -- amortized: counted cache-miss fallback (state_full_rebuilds); bind_from_cache/delta publication keeps this off the per-verb path
-            state = ClusterState(
+            # Counted cache-miss fallback (state_full_rebuilds); the
+            # bind_from_cache/delta publication keeps this off the
+            # per-verb path.
+            state = full_sync(
                 self.api,
                 cost_for_generation=self.config.cost_model,
                 assume_ttl_s=self.config.assume_ttl_s,
                 clock=self.clock,
-            ).sync()
+            )
         with self._cache_lock:
             self._cached_state = state
             self._cached_at = self.clock()

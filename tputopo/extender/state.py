@@ -300,6 +300,42 @@ class ClusterState:
 
     # ---- delta application (the watch/bind fast path) ----------------------
 
+    def event_has_impact(self, kind: str, etype: str, obj: dict) -> bool:
+        """Cheap O(1) pre-screen: could folding this watch event change
+        any derived state?  False only when provably not — a pod with no
+        record here and no assignment in the event object (the Pending
+        ADDED every arrival emits, the DELETED of a never-bound pod).
+        Screening those out before :meth:`with_events` is what keeps the
+        per-arrival path from paying a copy-on-write clone for events
+        that cannot move occupancy.  Conservative everywhere else: node
+        events and unknown kinds always report impact."""
+        if kind != "pods" or etype == "BOOKMARK":
+            return kind != "pods"  # BOOKMARK: no impact; nodes: always
+        md = obj.get("metadata", {})
+        key = (md.get("namespace", "default"), md.get("name"))
+        if key in self._pod_index:
+            return True
+        if etype == "DELETED":
+            return False  # nothing recorded -> nothing held -> no-op
+        return self._parse_pod_assignment(obj) is not None
+
+    def note_bind(self, pa: PodAssignment, *, chips_marked: bool = False) -> None:
+        """Record a bind the CALLER just committed, in place — the
+        single-owner twin of :meth:`with_bind` (no copy-on-write clone:
+        only valid when no other reader holds this state, e.g. the sim's
+        baseline policies, which own their cached state outright).
+        ``chips_marked=True`` means the caller already marked the chips
+        used during planning; otherwise they are marked here (raising if
+        any is taken).  The record is what later DELETED/assumption-wipe
+        events fold against — without it, event folding could never
+        release this bind's chips."""
+        dom = self._dom_by_node[pa.node_name]
+        if not chips_marked:
+            dom.allocator.mark_used(pa.chips)
+        dom.assignments.append(pa)
+        self._pod_index[(pa.namespace, pa.pod_name)] = _PodRec(
+            pa, dom.slice_id, "active", tuple(pa.chips))
+
     def _cow(self) -> "ClusterState":
         """Copy-on-write clone: the receiver and its domains are never
         mutated, so concurrently running sorts holding the old state keep a
@@ -642,3 +678,31 @@ class ClusterState:
                 ],
             }
         return out
+
+
+def list_pods_nocopy(api) -> list[dict]:
+    """Read-only pod listing, copy-free where the reader supports the
+    hint (informer mirror / fake API nocopy) — the shared shim for every
+    read-only whole-store consumer (defrag demand derivation,
+    /debug/defrag, the GC sweep's expiry scan).  Callers parse the
+    objects and keep none of them."""
+    try:
+        # tpulint: disable=nocopy-flow -- THE documented copy-free shim: every consumer (defrag demand derivation, /debug/defrag, the GC expiry scan) reads the listing and keeps nothing
+        return api.list("pods", copy=False)
+    except TypeError:  # reader without a copy kwarg (fake/REST client)
+        return api.list("pods")
+
+
+def full_sync(api, *, cost_for_generation=None, assume_ttl_s: float = 60.0,
+              clock=time.time) -> ClusterState:
+    """THE full O(pods) rebuild, as one shared call site: every consumer
+    of the cached-derived-state discipline (the extender's ``_state``
+    cache-miss branches, the sim baselines' delta-fallback) lands here
+    when — and only when — the delta/journal-fold fast paths cannot
+    answer exactly (cache miss, journal gap, node churn, conflicted base
+    state).  Each caller counts its own fallback (``state_full_rebuilds``
+    / ``invalidate_full_drop_*``), which is what makes the amortization
+    argument below auditable from reports instead of asserted."""
+    # tpulint: disable=hot-path-scan -- amortized: the ONE shared counted cache-miss/fallback rebuild behind every delta-maintained state (scheduler state_full_rebuilds, baseline invalidate_full_drop_*); steady-state paths fold deltas and never reach here
+    return ClusterState(api, cost_for_generation=cost_for_generation,
+                        assume_ttl_s=assume_ttl_s, clock=clock).sync()

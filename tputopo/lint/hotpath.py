@@ -1,10 +1,12 @@
 """The ``hot-path-scan`` checker: no O(pods) work on scheduler hot verbs.
 
-The ROADMAP's fleet-scale item (1024 nodes / 10k arrivals) is blocked by
-full-store scans that only a profiler used to find —
-``BaselinePolicy.invalidate``'s conservative drop forces a full
-``ClusterState.sync`` on the very next ``place()``, ~35% of sim wall.
-This rule turns that hunt into a CI gate:
+The ROADMAP's fleet-scale item (1024 nodes / 10k arrivals) was blocked
+by full-store scans that only a profiler used to find —
+``BaselinePolicy.invalidate``'s conservative drop forced a full
+``ClusterState.sync`` on the very next ``place()``, ~35% of sim wall,
+carried as this rule's waived debt until the incremental-baseline PR
+deleted the waiver by fixing it.  This rule turns that hunt into a CI
+gate:
 
 - **Hot roots** are the scheduler's verbs (``ExtenderScheduler.sort`` /
   ``.bind``) and the sim event loop (``SimEngine.run_events``), plus any
@@ -18,7 +20,8 @@ This rule turns that hunt into a CI gate:
 - **Full-store primitives** are flagged at their call sites inside the
   closure: ``ClusterState.sync`` (the O(pods) rebuild),
   ``FakeApiServer.list`` / ``list_nocopy`` / ``list_with_version`` and
-  the informer mirrors, and ``defrag.planner.list_pods_nocopy``.
+  the informer mirrors, and ``extender.state.list_pods_nocopy`` (the
+  shared copy-free listing shim, re-exported by ``defrag.planner``).
   Constructor-chained calls (``ClusterState(...).sync()``) resolve too.
 
 Every finding names the entry path from a hot root.  Deliberate,

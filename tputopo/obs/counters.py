@@ -50,6 +50,18 @@ COUNTERS = (
     # counters, and are pinned by the report schema instead)
     "preempt_plans_considered",
     "preempt_plans_found",
+    # baseline-policy state maintenance (tputopo/sim/policies.py,
+    # BaselinePolicy.inc — deterministic report-dict counters): the
+    # three-way split that replaced invalidate_drops.  delta_applied =
+    # with_events folds, drops_avoided = invalidate calls that kept the
+    # cache, full_drops = forced rebuilds (per-reason split under the
+    # invalidate_full_drop_ family below).  invalidate_drops itself
+    # survives only behind the delta_fold kill switch (the differential
+    # replay test's full-drop comparator).
+    "invalidate_delta_applied",
+    "invalidate_drops",
+    "invalidate_drops_avoided",
+    "invalidate_full_drops",
     # gang planning
     "gang_assumptions_released",
     "gang_candidate_memo_hits",
@@ -92,6 +104,7 @@ COUNTERS = (
 #: controller's deterministic counters into Prometheus.
 COUNTER_PREFIXES = (
     "defrag_",
+    "invalidate_full_drop_",
     "state_delta_fallback_",
 )
 

@@ -37,6 +37,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="mean arrival rate, jobs per virtual second "
                         "(default tuned to ~0.73 offered load at the "
                         "default fleet)")
+    p.add_argument("--offered-load", type=float, default=None,
+                   metavar="FRAC",
+                   help="derive the arrival rate from the fleet instead "
+                        "of --rate: mean offered load as a fraction of "
+                        "total chip capacity (standard workload only).  "
+                        "The scale knob behind the fleet standing trace "
+                        "— `--nodes 1024 --arrivals 10000 "
+                        "--offered-load 0.73` stresses 4096 chips at "
+                        "the same relative load the 64-node standard "
+                        "trace runs at")
     p.add_argument("--duration-mean", type=float, default=300.0,
                    help="mean job duration, virtual seconds (lognormal)")
     p.add_argument("--ghost-prob", type=float, default=0.02,
@@ -150,10 +160,21 @@ def main(argv: list[str] | None = None) -> int:
         trace_kwargs["workload"] = args.workload
         if args.slo_wait is not None:
             trace_kwargs["slo_wait_s"] = args.slo_wait
+        if args.offered_load is not None:
+            print("--offered-load only applies to --workload standard "
+                  "(the mixed workload tunes load via --rate)",
+                  file=sys.stderr)
+            return 2
     elif args.slo_wait is not None:
         print("--slo-wait only applies to --workload mixed",
               file=sys.stderr)
         return 2
+    if args.offered_load is not None:
+        if args.offered_load <= 0:
+            print(f"--offered-load must be > 0, got {args.offered_load}",
+                  file=sys.stderr)
+            return 2
+        trace_kwargs["offered_load"] = args.offered_load
     cfg = TraceConfig(
         seed=args.seed, nodes=args.nodes, spec=args.spec,
         arrivals=args.arrivals, process=args.process, rate_per_s=args.rate,

@@ -28,7 +28,7 @@ from tputopo.extender.config import ExtenderConfig
 from tputopo.extender.scheduler import (BindError, ExtenderScheduler,
                                         LABEL_ALLOW_MULTISLICE, LABEL_GANG_ID,
                                         LABEL_GANG_SIZE, bound_as_planned)
-from tputopo.extender.state import ClusterState
+from tputopo.extender.state import ClusterState, PodAssignment, full_sync
 from tputopo.k8s import objects as ko
 from tputopo.k8s.fakeapi import Conflict, FakeApiServer, NotFound
 from tputopo.k8s.retry import (ApiTimeout, ApiUnavailable, RetryPolicy,
@@ -339,7 +339,33 @@ class IciAwarePolicy(PlacementPolicy):
 
 class BaselinePolicy(PlacementPolicy):
     """Count-only node choice + a registered baseline chip picker,
-    committed through the same annotation handshake as the extender."""
+    committed through the same annotation handshake as the extender.
+
+    State maintenance mirrors the ici policy's assume-cache discipline:
+    the cached :class:`ClusterState` survives engine wakes, and
+    ``invalidate(events)`` FOLDS the engine's watch-vocabulary events
+    into it (buffered, then applied copy-on-write via
+    :meth:`ClusterState.with_events` on the next ``place``) instead of
+    dropping it — the full O(pods) :func:`full_sync` runs only on the
+    delta machinery's documented fallback reasons (node churn, journal
+    gap — the bounded event buffer overflowing — conflicted base state,
+    a half-committed abort).  The policy's own binds are registered into
+    the cached state's pod index (:meth:`ClusterState.note_bind`), which
+    is what lets later DELETED/assumption-wipe events release exactly
+    those chips.  ``delta_fold=False`` (class-level kill switch) restores
+    the historical drop-on-every-invalidate behavior byte-for-byte —
+    the differential replay test's comparator."""
+
+    #: Kill switch (class attribute so a test can flip one instance or
+    #: the whole class): False = the pre-delta conservative full drop,
+    #: counted as ``invalidate_drops`` exactly as before.
+    delta_fold = True
+
+    #: Journal-analog bound on the buffered event backlog: a burst that
+    #: outruns it (mass evictions, a GC storm) degrades to one counted
+    #: full sync instead of an unbounded fold — the same posture as the
+    #: informer's bounded journal.
+    _EVENT_BUFFER_MAX = 4096
 
     def __init__(self, api, clock, assume_ttl_s, picker_name: str,
                  picker: Callable, tracer=None, fault_plan=None) -> None:
@@ -356,26 +382,82 @@ class BaselinePolicy(PlacementPolicy):
         self._chaos_counters: dict[str, int] = {}
         self._call = bind_retry(RetryPolicy(), clock,
                                 random.Random(0xBA5E), inc=self.inc_chaos)
-        # invalidate_drops: every one is a full O(cluster) re-sync on the
-        # next place() — the counter that attributes the ROADMAP's
-        # "BaselinePolicy.invalidate full drops" sim-wall item from the
-        # report instead of a profiler run.
-        self._counters = {"plans": 0, "infeasible": 0, "binds": 0,
-                          "invalidate_drops": 0}
+        # State-maintenance economics, the three-way split that replaced
+        # the old invalidate_drops counter: invalidate_delta_applied
+        # (with_events folds), invalidate_drops_avoided (invalidate
+        # calls that kept the cache where the old code dropped it), and
+        # the invalidate_full_drop_<reason> family summed under
+        # invalidate_full_drops — every forced rebuild attributable from
+        # the report's scheduler block alone.  Registered in
+        # tputopo/obs/counters.py; mode-dependent zeros are filled by
+        # counters() so the kill-switch path keeps the historical bytes.
+        self._counters = {"plans": 0, "infeasible": 0, "binds": 0}
         # Same assume-cache discipline as the ici policy: one sync per
         # engine wake; this policy's own binds are reflected by the
         # mark_used calls during planning, and the engine invalidates on
         # every external mutation.
         self._cached_state: ClusterState | None = None
+        # Engine events awaiting their fold (delta_fold mode): buffered
+        # at invalidate(), applied in one with_events batch at the next
+        # place().  Non-empty only while _cached_state is not None.
+        self._pending_events: list[tuple] = []
         self._last_explain: dict | None = None
 
+    def inc(self, name: str, by: int = 1) -> None:
+        """Deterministic counter sink (the report's scheduler block)."""
+        self._counters[name] = self._counters.get(name, 0) + by
+
     def invalidate(self, events=None) -> None:
-        # Count-only baselines keep the conservative drop regardless of
-        # event detail — their plans are cheap relative to the A/B value
-        # of keeping their decision stream bit-stable across PRs.
-        if self._cached_state is not None:
-            self._counters["invalidate_drops"] += 1
+        if not self.delta_fold:
+            # Historical behavior, byte-for-byte (the differential
+            # test's comparator): every out-of-band mutation drops the
+            # cache and the next place() pays a full sync.
+            if self._cached_state is not None:
+                self.inc("invalidate_drops")
+            self._cached_state = None
+            return
+        if self._cached_state is None:
+            return  # nothing cached — the next place() syncs fresh anyway
+        if events is None:
+            # "Something topology-shaped moved" (node fail/repair): only
+            # a rebuild answers exactly — same verdict with_events would
+            # reach, without paying a clone to learn it.
+            self._drop_cache("node_churn")
+            return
+        self.inc("invalidate_drops_avoided")
+        state = self._cached_state
+        self._pending_events.extend(
+            e for e in events if state.event_has_impact(*e))
+        if len(self._pending_events) > self._EVENT_BUFFER_MAX:
+            self._drop_cache("journal_gap")
+
+    def _drop_cache(self, reason: str) -> None:
+        """Forced full rebuild: count it by reason, clear cache+backlog."""
+        self.inc("invalidate_full_drops")
+        self.inc(f"invalidate_full_drop_{reason}")
         self._cached_state = None
+        self._pending_events.clear()
+
+    def _state(self) -> ClusterState:
+        """The cached derived state, advanced by the pending event fold —
+        or rebuilt via the one shared counted fallback when there is no
+        cache or the fold cannot apply exactly."""
+        state = self._cached_state
+        if state is not None and self._pending_events:
+            events, self._pending_events = self._pending_events, []
+            reasons: list[str] = []
+            new = state.with_events(events, reasons)
+            if new is None:
+                self._drop_cache(reasons[0] if reasons else "other")
+                state = None
+            else:
+                self.inc("invalidate_delta_applied")
+                state = self._cached_state = new
+        if state is None:
+            self._pending_events.clear()
+            state = self._cached_state = full_sync(
+                self.api, assume_ttl_s=self.assume_ttl_s, clock=self.clock)
+        return state
 
     def inc_chaos(self, name: str, by: int = 1) -> None:
         self._chaos_counters[name] = self._chaos_counters.get(name, 0) + by
@@ -384,12 +466,7 @@ class BaselinePolicy(PlacementPolicy):
               handles: list | None = None) -> list[dict] | None:
         self.last_none_reason = "infeasible"
         self._counters["plans"] += 1
-        state = self._cached_state
-        if state is None:
-            # tpulint: disable=hot-path-scan -- KNOWN fleet-scale bottleneck, now CI-tracked: invalidate()'s conservative drop forces this full sync (~35% sim wall); the ROADMAP item is to fold engine events like the ici policy, keeping the decision stream bit-stable
-            state = self._cached_state = ClusterState(
-                self.api, assume_ttl_s=self.assume_ttl_s,
-                clock=self.clock).sync()
+        state = self._state()
         # Plan every member against one state snapshot (all-or-nothing
         # without partial binds), marking planned chips used locally; a
         # count-only scheduler walks nodes in name order — first fit.
@@ -409,12 +486,20 @@ class BaselinePolicy(PlacementPolicy):
                         walk.append({"node": node,
                                      "rejected": "not_a_tpu_node"})
                     continue
-                free_here = frozenset(state.free_chips_on_node(node))
-                if len(free_here) < job.chips:
+                # Popcount gate before materializing anything: the
+                # first-fit walk visits O(nodes) mostly-full nodes per
+                # member, and building a coord frozenset per visit was
+                # the walk's whole cost at fleet scale.  Same nodes pass
+                # (popcount == len of the materialized set), so the
+                # decision stream is bit-identical.
+                free_mask = (dom.node_masks.get(node, 0)
+                             & dom.allocator.free_mask)
+                if free_mask.bit_count() < job.chips:
                     if walk is not None and member == 0:
                         walk.append({"node": node,
                                      "rejected": "insufficient_free_chips"})
                     continue
+                free_here = frozenset(dom.allocator.chips_of_mask(free_mask))
                 picked = self.picker(dom.topology, free_here, job.chips)
                 if picked is not None:
                     placed = (node, tuple(picked), dom)
@@ -443,7 +528,10 @@ class BaselinePolicy(PlacementPolicy):
         try:
             return self._commit(job, plan, state, walk)
         except ApiUnavailable as e:
-            self._cached_state = None
+            if self.delta_fold:
+                self._drop_cache("commit_abort")
+            else:
+                self._cached_state = None
             self.last_none_reason = ("api_timeout" if isinstance(e, ApiTimeout)
                                      else "api_unavailable")
             self._chaos_counters["commit_aborted"] = \
@@ -481,6 +569,19 @@ class BaselinePolicy(PlacementPolicy):
                     raise
                 self.inc_chaos("bind_ambiguous_recovered")
             self._counters["binds"] += 1
+            if self.delta_fold:
+                # Register the bind in the cached state's pod index (chips
+                # were already marked used during planning): the record a
+                # later DELETED/assumption-wipe event folds against —
+                # exactly what a re-sync would reconstruct from the
+                # annotations stamped above.
+                state.note_bind(
+                    PodAssignment(
+                        pod_name=pod_name, namespace="default",
+                        node_name=node, chips=list(picked), assigned=False,
+                        assume_time=now,
+                        gang_id=job.name if job.replicas > 1 else None),
+                    chips_marked=True)
             decisions.append({
                 "pod": pod_name, "node": node, "slice": dom.slice_id,
                 "chips": [tuple(c) for c in picked],
@@ -503,6 +604,18 @@ class BaselinePolicy(PlacementPolicy):
 
     def counters(self) -> dict:
         out = dict(self._counters)
+        # Mode-dependent pre-zeroes: the delta path always reports its
+        # three-way split (a run that never folded still says so); the
+        # kill-switch path keeps the historical invalidate_drops
+        # vocabulary byte-for-byte.  Per-reason full-drop counters stay
+        # lazy (present only when nonzero), like the ici policy's
+        # state_delta_fallback_* family.
+        if self.delta_fold:
+            for k in ("invalidate_delta_applied", "invalidate_drops_avoided",
+                      "invalidate_full_drops"):
+                out.setdefault(k, 0)
+        else:
+            out.setdefault("invalidate_drops", 0)
         out.update(self._chaos_counters)
         return out
 

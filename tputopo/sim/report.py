@@ -25,7 +25,13 @@ Schema (``tputopo.sim/v2``)::
                            "multi_chip_placements", "contiguous_frac"},
           "preemptions": {"node_failures", "pods_evicted", "jobs_requeued"},
           "gc": {"sweeps", "assumptions_released"},
-          "scheduler": {<deterministic policy counters>},
+          "scheduler": {<deterministic policy counters>: the ici policy's
+                        kept Metrics (SCHEDULER_COUNTER_KEEP + the
+                        state_delta_fallback_* family); baselines report
+                        plans/infeasible/binds plus the state-maintenance
+                        split invalidate_delta_applied /
+                        invalidate_drops_avoided / invalidate_full_drops
+                        (+ lazy invalidate_full_drop_<reason>)},
           "phases": {"<verb>/<phase>": {"count", "counters"?}, ...},
           "defrag": {<controller counters>},        # v3 (--defrag) only
           "chaos": {"profile", "injected", "suppressed", "retries",

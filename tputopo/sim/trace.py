@@ -84,6 +84,18 @@ class TraceConfig:
     # serving work (serving tier, tight queue-wait SLO, diurnal/bursty
     # arrivals) with long training gangs (prod/batch tiers, Poisson).
     workload: str = "standard"
+    # ---- fleet-scale knob ------------------------------------------------
+    # Offered load as a fraction of fleet capacity (None = use rate_per_s
+    # as given).  The default rate (0.1 jobs/s) was tuned for the 64-node
+    # standard fleet; replayed at 1024 nodes it offers ~4% of capacity —
+    # an idle-cluster benchmark.  offered_load derives the arrival rate
+    # from the fleet itself (rate = load * total_chips / (mean_job_chips
+    # * duration_mean_s)), so one load figure scales from the 64-node
+    # standing trace to the 1024-node fleet trace without retuning.
+    # Standard workload only; a pure function of the config, so traces
+    # stay byte-deterministic.  Dropped from describe() when None —
+    # every pre-existing report's bytes are pinned.
+    offered_load: float | None = None
     serving_frac: float = 0.6      # fraction of arrivals that are serving
     serving_gang_frac: float = 0.3  # of serving: multi-host model replicas
     serving_duration_mean_s: float = 120.0
@@ -97,6 +109,20 @@ class TraceConfig:
     diurnal_amp: float = 0.6          # peak-to-mean modulation (0..1)
     train_duration_factor: float = 2.0  # training mean = factor x duration_mean_s
     prod_train_frac: float = 0.25  # training jobs at the prod (50) tier
+
+    def __post_init__(self) -> None:
+        if self.offered_load is not None:
+            if self.workload != "standard":
+                raise ValueError(
+                    "offered_load derives its rate from the standard "
+                    "job-mix vocabulary; tune the mixed workload via "
+                    "rate_per_s")
+            if not 0.0 < self.offered_load:
+                raise ValueError(f"offered_load must be > 0, "
+                                 f"got {self.offered_load}")
+            rate = (self.offered_load * self.total_chips
+                    / (self.mean_job_chips * self.duration_mean_s))
+            object.__setattr__(self, "rate_per_s", rate)
 
     def rng(self) -> np.random.Generator:
         # SeedSequence folds the seed on its own axis (the same collision
@@ -133,6 +159,17 @@ class TraceConfig:
     def total_chips(self) -> int:
         return self.n_domains * math.prod(self.domain_dims)
 
+    @property
+    def mean_job_chips(self) -> float:
+        """Expected chips per job under the standard request vocabulary
+        (the job_mix weights over single / pair / host-quad / gang) —
+        the offered-load denominator, computed from the same knobs the
+        generator draws from so the two can never drift."""
+        w = [x / sum(self.job_mix) for x in self.job_mix]
+        cph = self.chips_per_host
+        gang = cph * (sum(self.gang_sizes) / len(self.gang_sizes))
+        return w[0] * 1 + w[1] * min(2, cph) + w[2] * cph + w[3] * gang
+
     #: The mixed-workload knobs, dropped from describe() on a standard
     #: trace so every pre-priority report stays byte-identical (same rule
     #: as the engine's defrag/chaos records: absent when off).
@@ -146,6 +183,11 @@ class TraceConfig:
         if self.workload == "standard":
             for k in self._MIXED_KNOBS:
                 d.pop(k, None)
+        if self.offered_load is None:
+            # Absent when unset (same rule as the mixed knobs): every
+            # pre-fleet report's bytes stay pinned.  When set, both the
+            # load figure and the derived rate_per_s are recorded.
+            d.pop("offered_load", None)
         d.update(n_domains=self.n_domains, hosts_per_domain=self.hosts_per_domain,
                  chips=self.total_chips)
         return d
