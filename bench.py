@@ -455,8 +455,16 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0,
     # configuration — comparable across PRs and with `--no-trace` CLI
     # runs), the traced one supplies the per-phase breakdown.  Their
     # deterministic report bodies are identical, so the A/B deltas can
-    # come from either.
+    # come from either.  Wall figures are BEST-OF-2 (two untraced
+    # replays; deterministic bodies identical, so only the throughput
+    # block differs) — single-shot walls jittered enough across CI hosts
+    # to swamp real regressions.
     report = run_trace(cfg, ["ici", "naive"], flight_trace=False)
+    report2 = run_trace(cfg, ["ici", "naive"], flight_trace=False)
+    wall_runs = sorted([report["throughput"]["wall_s"],
+                        report2["throughput"]["wall_s"]])
+    if report2["throughput"]["wall_s"] < report["throughput"]["wall_s"]:
+        report = report2
     traced = run_trace(cfg, ["ici", "naive"])
     deltas = report["ab"]["deltas"]["ici-vs-naive"]
     if not any(v != 0 for v in deltas.values()):
@@ -469,7 +477,9 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0,
         "virtual_horizon_s": report["virtual_horizon_s"],
         # Wall-clock throughput of the replay itself — the standing figure
         # perf PRs move (the A/B deltas below are what POLICY PRs move).
+        # Best-of-2; both raw walls recorded for jitter visibility.
         "wall_s": report["throughput"]["wall_s"],
+        "wall_s_runs": wall_runs,
         "events": report["throughput"]["events"],
         "events_per_s": report["throughput"]["events_per_s"],
         # Flight-recorder phase breakdown from the TRACED replay (wall-ms
@@ -507,11 +517,28 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0,
     # from a traced replay of the same trace.
     fleet_cfg = TraceConfig(seed=seed, nodes=fleet_nodes,
                             arrivals=fleet_arrivals, offered_load=0.73)
+    # Best-of-2 untraced replays, same rule as the standard block.
     fleet = run_trace(fleet_cfg, ["ici", "naive"], flight_trace=False)
+    fleet2 = run_trace(fleet_cfg, ["ici", "naive"], flight_trace=False)
+    fleet_wall_runs = sorted([fleet["throughput"]["wall_s"],
+                              fleet2["throughput"]["wall_s"]])
+    if fleet2["throughput"]["wall_s"] < fleet["throughput"]["wall_s"]:
+        fleet = fleet2
     # Only the ici phase breakdown is consumed from the traced replay —
     # one policy keeps the second 2000-arrival run at half cost.
     fleet_traced = run_trace(fleet_cfg, ["ici"])
     fp = fleet["policies"]
+    # The r05 standing figures this block is diffed against — recorded
+    # INLINE so BENCH_r06+ stays comparable to r05 without re-running
+    # old code (r05's artifact predates the fleet block's best-of-2
+    # shape).  Dev-host numbers from the PR-12 ROADMAP record; the
+    # deltas below divide same-host best-of-2 figures, so they move
+    # with code, not hosts, once r06 exists.
+    baseline_ref = {
+        "ref": "BENCH_r05 (PR 12, ROADMAP fleet-scale record)",
+        "fleet_1024x10000": {"wall_s": 280.0, "events_per_s": 144.0},
+        "standard_64x500_no_trace": {"wall_s": 1.2, "events_per_s": 2000.0},
+    }
     out["fleet"] = {
         "nodes": fleet["trace"]["nodes"],
         "chips": fleet["trace"]["chips"],
@@ -520,6 +547,8 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0,
         "events": fleet["throughput"]["events"],
         "events_per_s": fleet["throughput"]["events_per_s"],
         "wall_s": fleet["throughput"]["wall_s"],
+        "wall_s_runs": fleet_wall_runs,
+        "baseline_ref": baseline_ref,
         "phase_wall_ms": fleet_traced.get("phase_wall", {}).get("ici", {}),
         "state_maintenance": {
             name: {k: v for k, v in fp[name]["scheduler"].items()

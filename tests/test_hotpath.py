@@ -1,0 +1,527 @@
+"""Fleet hot-path elimination (ISSUE 13): the single-owner in-place fold,
+the incremental score index, the copy-free fakeapi write path, and the GC
+next-expiry watermark — each leg's equivalence property and its kill
+switch, plus the all-switches-off report identity that pins the legacy
+paths byte-for-byte."""
+
+from __future__ import annotations
+
+import copy as copymod
+import json
+import random
+
+import pytest
+
+from tests.cluster import build_cluster
+from tputopo.extender.config import ExtenderConfig
+from tputopo.extender.gc import AssumptionGC
+from tputopo.extender.scheduler import ExtenderScheduler, Metrics
+from tputopo.extender.state import ClusterState
+from tputopo.k8s import objects as ko
+from tputopo.k8s.fakeapi import FakeApiServer
+from tputopo.k8s.objects import make_pod
+
+
+class _Clock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _sync(api, clock):
+    return ClusterState(api, clock=clock).sync()
+
+
+def _bind_pod(api, name, node, chips, clock, *, assigned=False, gang=None):
+    anns = {
+        ko.ANN_GROUP: ko.coords_to_ann(chips),
+        ko.ANN_ASSUME_TIME: str(clock()),
+        ko.ANN_ASSIGNED: "true" if assigned else "false",
+    }
+    if gang:
+        anns[ko.ANN_GANG_ID] = gang
+    api.create("pods", make_pod(name, chips=len(chips), annotations=anns,
+                                node_name=node))
+    return api.get("pods", name, "default")
+
+
+def _state_facts(state: ClusterState) -> dict:
+    """Everything the fold equivalence contract covers: the pod index
+    (records + status + held chips), per-domain occupancy and derived
+    lists, the conflict/expiry ledgers, and the sync-time cursor."""
+    return {
+        "pod_index": {
+            key: (rec.sid, rec.status, tuple(rec.held),
+                  rec.pa.node_name, tuple(map(tuple, rec.pa.chips)),
+                  rec.pa.assigned, rec.pa.assume_time, rec.pa.gang_id)
+            for key, rec in state._pod_index.items()
+        },
+        "occupancy": {sid: dom.allocator.used_mask
+                      for sid, dom in state.domains.items()},
+        "unhealthy": {sid: frozenset(dom.unhealthy)
+                      for sid, dom in state.domains.items()},
+        "assignments": {
+            sid: sorted(f"{pa.namespace}/{pa.pod_name}"
+                        for pa in dom.assignments)
+            for sid, dom in state.domains.items()
+        },
+        "expired": sorted(f"{pa.namespace}/{pa.pod_name}"
+                          for pa in state.expired),
+        "conflicts": sorted(f"{pa.namespace}/{pa.pod_name}"
+                            for pa in state.conflicts),
+        "synced_at": state._synced_at,
+    }
+
+
+def _random_event(api, clock, rng, live, step):
+    """One random cluster mutation + its informer-vocabulary event —
+    the same op mix as test_state_delta's fold fuzz."""
+    topo_chips = [(x, y, z) for x in range(2) for y in range(2)
+                  for z in range(4)]
+    op = rng.random()
+    clock.t += rng.random()
+    if op < 0.4 or not live:
+        name = f"p{step}"
+        node = f"node-{rng.randrange(4)}"
+        k = rng.choice([1, 2, 4])
+        free = set(_sync(api, clock).free_chips_on_node(node))
+        chips = sorted(free)[:k]
+        if len(chips) < k:
+            return None
+        obj = _bind_pod(api, name, node, chips, clock,
+                        assigned=rng.random() < 0.5)
+        live.append(name)
+        return ("pods", "ADDED", obj)
+    if op < 0.6:
+        name = rng.choice(live)
+        api.patch_annotations("pods", name, {ko.ANN_ASSIGNED: "true"},
+                              namespace="default")
+        return ("pods", "MODIFIED", api.get("pods", name, "default"))
+    if op < 0.8:
+        name = live.pop(rng.randrange(len(live)))
+        api.patch_annotations("pods", name,
+                              {ko.ANN_GROUP: None, ko.ANN_ASSIGNED: None,
+                               ko.ANN_ASSUME_TIME: None},
+                              namespace="default")
+        return ("pods", "MODIFIED", api.get("pods", name, "default"))
+    if op < 0.9:
+        name = live.pop(rng.randrange(len(live)))
+        obj = api.get("pods", name, "default")
+        api.delete("pods", name, "default")
+        return ("pods", "DELETED", obj)
+    node = f"node-{rng.randrange(4)}"
+    bad = rng.sample(topo_chips, rng.randrange(0, 3))
+    api.patch_annotations(
+        "nodes", node,
+        {ko.ANN_UNHEALTHY: ko.coords_to_ann(bad) if bad else None})
+    return ("nodes", "MODIFIED", api.get("nodes", node))
+
+
+# ---- leg 1: single-owner in-place fold ---------------------------------------
+
+
+def test_fold_inplace_matches_cow_over_random_event_streams():
+    """Property: fold_inplace and _cow+with_events produce EQUAL states
+    (pod index, occupancy, derived lists, sync cursor) across randomized
+    event streams, and agree on when a fold is unappliable."""
+    clock = _Clock()
+    api, _ = build_cluster(clock=clock)
+    rng = random.Random(23)
+    cow_state = _sync(api, clock)
+    inp_state = _sync(api, clock)
+    live: list[str] = []
+    folds = fallbacks = 0
+    for step in range(140):
+        event = _random_event(api, clock, rng, live, step)
+        if event is None:
+            continue
+        cow_reasons: list[str] = []
+        inp_reasons: list[str] = []
+        cow_new = cow_state.with_events([event], cow_reasons)
+        inp_new = inp_state.fold_inplace([event], inp_reasons)
+        assert (cow_new is None) == (inp_new is None), (step, event[:2])
+        if cow_new is None:
+            assert cow_reasons == inp_reasons
+            fallbacks += 1
+            cow_state = _sync(api, clock)
+            inp_state = _sync(api, clock)
+            continue
+        folds += 1
+        assert inp_new is inp_state  # mutated, not replaced
+        cow_state = cow_new
+        assert _state_facts(cow_state) == _state_facts(inp_state), \
+            (step, event[:2])
+    assert folds > 40  # the fuzz actually exercised the fold path
+
+
+def test_fold_inplace_kill_switch_restores_cow():
+    clock = _Clock()
+    api, _ = build_cluster(clock=clock)
+    state = _sync(api, clock)
+    obj = _bind_pod(api, "p", "node-0", [(0, 0, 0)], clock)
+    try:
+        ClusterState.FOLD_INPLACE = False
+        new = state.fold_inplace([("pods", "ADDED", obj)])
+        # Feature-off: a copy-on-write clone, receiver untouched.
+        assert new is not None and new is not state
+        assert (0, 0, 0) in state.free_chips_on_node("node-0")
+        assert (0, 0, 0) not in new.free_chips_on_node("node-0")
+    finally:
+        ClusterState.FOLD_INPLACE = True
+    new2 = state.fold_inplace([("pods", "ADDED", obj)])
+    assert new2 is state  # feature-on: mutation in place
+    assert (0, 0, 0) not in state.free_chips_on_node("node-0")
+
+
+def test_fold_inplace_failure_means_discard():
+    """A None from fold_inplace may leave the state partially mutated —
+    the contract is 'discard and full-sync', which must land on the same
+    facts as a fresh sync."""
+    clock = _Clock()
+    api, _ = build_cluster(clock=clock)
+    _bind_pod(api, "a", "node-0", [(0, 0, 0)], clock)
+    state = _sync(api, clock)
+    overlap = _bind_pod(api, "b", "node-0", [(0, 0, 0)], clock)
+    reasons: list[str] = []
+    assert state.fold_inplace([("pods", "ADDED", overlap)], reasons) is None
+    assert reasons == ["overlap"]
+    assert _state_facts(_sync(api, clock)) == _state_facts(_sync(api, clock))
+
+
+# ---- leg 2: incremental score index ------------------------------------------
+
+
+def _index_matches_uncached(sched, state):
+    idx = getattr(state, "_score_index", None) or {}
+    for k, kd in idx.items():
+        for node, score in kd.items():
+            assert score == sched._score_node_uncached(state, k, node), \
+                (k, node)
+
+
+def test_score_index_matches_uncached_after_every_fold():
+    """Property: every (k, node) entry the index holds equals a fresh
+    _score_node_uncached against the CURRENT state, after sorts, event
+    folds, and bind deltas."""
+    clock = _Clock()
+    api, _ = build_cluster(clock=clock)
+    sched = ExtenderScheduler(
+        api, ExtenderConfig(state_cache_s=1e12, bind_from_cache=True),
+        clock=clock)
+    nodes = [f"node-{i}" for i in range(4)]
+    api.create("pods", make_pod("q0", chips=2))
+    sched.sort(api.get("pods", "q0", "default"), nodes)
+    state = sched._cached_state
+    assert state is not None and state._score_index
+    _index_matches_uncached(sched, state)
+    # Bind delta: the bound domain's entries are evicted; survivors
+    # still match (here: one domain, so the index empties and refills).
+    sched.bind("q0", "default", "node-1")
+    assert sched._cached_state is state  # in-place single-owner delta
+    _index_matches_uncached(sched, state)
+    api.create("pods", make_pod("q1", chips=4))
+    sched.sort(api.get("pods", "q1", "default"), nodes)
+    _index_matches_uncached(sched, state)
+    # Out-of-band fold (the engine's invalidate path): a wipe releases
+    # chips — the index must never serve a pre-release score.
+    api.patch_annotations("pods", "q0",
+                          {ko.ANN_GROUP: None, ko.ANN_ASSIGNED: None,
+                           ko.ANN_ASSUME_TIME: None}, namespace="default")
+    sched.apply_events([("pods", "MODIFIED",
+                         api.get("pods", "q0", "default"))])
+    assert sched._cached_state is state
+    sched.sort(api.get("pods", "q1", "default"), nodes)
+    _index_matches_uncached(sched, state)
+
+
+def test_score_index_scores_equal_legacy_memo_scores():
+    """The index and the legacy (k, node) memo must hand back identical
+    scores and identical hit counters for the same sort sequence."""
+    def run(score_index: bool):
+        clock = _Clock()
+        api, _ = build_cluster(clock=clock)
+        sched = ExtenderScheduler(
+            api, ExtenderConfig(state_cache_s=1e12, bind_from_cache=True),
+            clock=clock)
+        try:
+            ExtenderScheduler.SCORE_INDEX = score_index
+            nodes = [f"node-{i}" for i in range(4)]
+            out = []
+            for i, k in enumerate((1, 2, 2, 4, 1)):
+                api.create("pods", make_pod(f"s{i}", chips=k))
+                out.append(sched.sort(api.get("pods", f"s{i}", "default"),
+                                      nodes))
+            return out, sched.metrics.counters.get("score_memo_hits", 0)
+        finally:
+            ExtenderScheduler.SCORE_INDEX = True
+
+    with_index = run(True)
+    legacy = run(False)
+    assert with_index == legacy
+
+
+def test_sort_best_equals_max_over_sort():
+    """sort_best must select exactly the entry max(sort(...), key=(Score,
+    Host)) selects — gang and single-pod shapes, traced and untraced —
+    or None precisely when nothing scores positive."""
+    from tputopo.extender.scheduler import BEST_SCORE_KEY
+    from tputopo.obs import Tracer
+
+    def check(sched, pod, nodes):
+        scores = sched.sort(pod, nodes)
+        legacy = max(scores, key=BEST_SCORE_KEY) if scores else None
+        got = sched.sort_best(pod, nodes)
+        if legacy is None or legacy["Score"] <= 0:
+            assert got is None or got == legacy  # same infeasible branch
+        else:
+            assert got == legacy
+
+    for tracer in (None, "on"):
+        clock = _Clock()
+        api, _ = build_cluster(clock=clock)
+        kwargs = {"clock": clock}
+        if tracer:
+            kwargs["tracer"] = Tracer(capacity=16, clock=clock)
+        sched = ExtenderScheduler(
+            api, ExtenderConfig(state_cache_s=1e12, bind_from_cache=True),
+            **kwargs)
+        nodes = [f"node-{i}" for i in range(4)]
+        api.create("pods", make_pod("single", chips=2))
+        check(sched, api.get("pods", "single", "default"), nodes)
+        gang_labels = {"tpu.dev/gang-id": "g", "tpu.dev/gang-size": "2"}
+        for m in range(2):
+            api.create("pods", make_pod(f"g-{m}", chips=4,
+                                        labels=gang_labels))
+        check(sched, api.get("pods", "g-0", "default"), nodes)
+        # Infeasible (too big) and empty-candidate shapes.
+        api.create("pods", make_pod("huge", chips=64))
+        check(sched, api.get("pods", "huge", "default"), nodes)
+        check(sched, api.get("pods", "single", "default"), [])
+
+
+# ---- leg 3: copy-free fakeapi write path -------------------------------------
+
+
+def test_nocopy_writes_structural_sharing_and_frozen_snapshots():
+    api = FakeApiServer(nocopy_writes=True)
+    api.create("pods", make_pod("p0", chips=2), echo=False)
+    before = api.get_nocopy("pods", "p0", "default")
+    rv_before = before["metadata"]["resourceVersion"]
+    patched = api.patch_annotations("pods", "p0", {"a": "1"}, "default")
+    after = api.get_nocopy("pods", "p0", "default")
+    # The write REPLACED the stored incarnation...
+    assert patched is after and after is not before
+    # ...sharing the untouched substructure...
+    assert after["spec"] is before["spec"]
+    assert after["status"] is before["status"]
+    # ...and the old reference is frozen at its resourceVersion.
+    assert before["metadata"]["resourceVersion"] == rv_before
+    assert "a" not in (before["metadata"].get("annotations") or {})
+    assert after["metadata"]["annotations"]["a"] == "1"
+    # bind_pod: fresh spec/status dicts, metadata bumped, store replaced.
+    bound = api.bind_pod("p0", "node-7", "default")
+    assert bound is api.get_nocopy("pods", "p0", "default")
+    assert bound["spec"]["nodeName"] == "node-7"
+    assert "nodeName" not in after["spec"]  # prior incarnation frozen
+    # delete: the popped object is not mutated by the delete's rv bump.
+    rv_bound = bound["metadata"]["resourceVersion"]
+    api.delete("pods", "p0", "default")
+    assert bound["metadata"]["resourceVersion"] == rv_bound
+
+
+def test_nocopy_writes_zero_deepcopies_on_the_write_path(monkeypatch):
+    real = copymod.deepcopy
+    calls = {"n": 0}
+
+    def counting(x, memo=None, _nil=[]):  # noqa: B006 — mirrors copy.deepcopy's real signature
+        calls["n"] += 1
+        return real(x, memo)
+
+    monkeypatch.setattr(copymod, "deepcopy", counting)
+    api = FakeApiServer(nocopy_writes=True)
+    calls["n"] = 0
+    api.create_many("pods", [make_pod(f"p{i}", chips=1) for i in range(3)])
+    api.patch_annotations("pods", "p0", {"a": "1"}, "default")
+    api.patch_labels("pods", "p1", {"l": "1"}, "default")
+    api.bind_pod("p2", "node-0", "default")
+    api.delete("pods", "p1", "default")
+    assert calls["n"] == 0  # the whole write path is copy-free unwatched
+    # Reads through the copying API still deepcopy (contract unchanged).
+    api.get("pods", "p0", "default")
+    assert calls["n"] == 1
+
+
+def test_nocopy_writes_keeps_meta_index_and_watch_semantics():
+    api = FakeApiServer(nocopy_writes=True)
+    api.create("pods", make_pod("g0", chips=1,
+                                labels={"tpu.dev/gang-id": "g"}),
+               echo=False)
+    api.bind_pod("g0", "node-1", "default")
+    # The meta index must track the REPLACED incarnation, not the stale one.
+    hits = api.list_by_meta("pods", "tpu.dev/gang-id", "g", copy=False)
+    assert [p["spec"].get("nodeName") for p in hits] == ["node-1"]
+    # Watch events are still deepcopied at emit once a consumer attaches.
+    _, rv = api.list_with_version("pods")
+    api.patch_annotations("pods", "g0", {"x": "1"}, "default")
+    events = [e for e in api.watch("pods", rv, timeout_s=0.05)
+              if e["type"] != "BOOKMARK"]
+    assert len(events) == 1
+    stored = api.get_nocopy("pods", "g0", "default")
+    assert events[0]["object"] is not stored
+    assert events[0]["object"]["metadata"]["annotations"]["x"] == "1"
+
+
+def test_assignment_index_tracks_group_annotation():
+    api = FakeApiServer()
+    clock = _Clock()
+    assert api.list_assignments() == []
+    _bind_pod(api, "held", "node-0", [(0, 0, 0)], clock)
+    api.create("pods", make_pod("pending", chips=2), echo=False)
+    assert [p["metadata"]["name"] for p in api.list_assignments()] \
+        == ["held"]
+    # Wipe removes it from the index; re-stamp restores it; delete drops it.
+    api.patch_annotations("pods", "held", {ko.ANN_GROUP: None}, "default")
+    assert api.list_assignments() == []
+    api.patch_annotations("pods", "held",
+                          {ko.ANN_GROUP: "0,0,0"}, "default")
+    assert [p["metadata"]["name"] for p in api.list_assignments()] \
+        == ["held"]
+    api.delete("pods", "held", "default")
+    assert api.list_assignments() == []
+
+
+# ---- leg 4: GC next-expiry watermark -----------------------------------------
+
+
+def _stale_pod(api, clock, name="stale-0", assume_t=0.0):
+    api.create("pods", make_pod(name, chips=2), echo=False)
+    api.patch_annotations("pods", name, {
+        ko.ANN_GROUP: "0,0,0;1,0,0",
+        ko.ANN_ASSUME_TIME: str(assume_t),
+        ko.ANN_ASSIGNED: "false",
+    }, "default")
+    api.bind_pod(name, "node-0", "default")
+
+
+def test_watermark_skips_provably_empty_sweeps():
+    clock = _Clock(t=100.0)
+    api, _ = build_cluster(clock=clock)
+    _stale_pod(api, clock, assume_t=90.0)  # 10 s old, TTL 60
+    metrics = Metrics()
+    gc = AssumptionGC(api, assume_ttl_s=60.0, clock=clock, metrics=metrics)
+    assert gc.sweep() == []  # first sweep always scans
+    assert metrics.counters.get("gc_sweeps_skipped", 0) == 0
+    clock.t = 120.0
+    assert gc.sweep() == []  # provably empty: skipped without a scan
+    assert metrics.counters["gc_sweeps_skipped"] == 1
+    assert metrics.counters["gc_sweeps"] == 2
+    clock.t = 151.0  # 90 + 60 < 151: the assumption expired — must scan
+    assert gc.sweep() == ["default/stale-0"]
+    anns = api.get("pods", "stale-0", "default")["metadata"]["annotations"]
+    assert ko.ANN_GROUP not in anns
+
+
+def test_watermark_kill_switch_scans_every_sweep():
+    clock = _Clock(t=100.0)
+    api, _ = build_cluster(clock=clock)
+    _stale_pod(api, clock, assume_t=90.0)
+    metrics = Metrics()
+    gc = AssumptionGC(api, assume_ttl_s=60.0, clock=clock, metrics=metrics)
+    try:
+        AssumptionGC.WATERMARK = False
+        assert gc.sweep() == []
+        clock.t = 120.0
+        assert gc.sweep() == []
+        assert "gc_sweeps_skipped" not in metrics.counters
+        clock.t = 151.0
+        assert gc.sweep() == ["default/stale-0"]
+    finally:
+        AssumptionGC.WATERMARK = True
+
+
+def test_failed_release_keeps_the_next_sweep_scanning():
+    """A victim whose release patch failed stays expired — the watermark
+    must keep the NEXT sweep scanning so the retry happens (the chaos
+    liveness contract)."""
+    from tputopo.k8s.retry import ApiUnavailable
+
+    class _FlakyPatch:
+        def __init__(self, api, failures):
+            self._api = api
+            self.failures = failures
+
+        def __getattr__(self, name):
+            return getattr(self._api, name)
+
+        def patch_annotations(self, *a, **kw):
+            if self.failures > 0:
+                self.failures -= 1
+                raise ApiUnavailable("injected")
+            return self._api.patch_annotations(*a, **kw)
+
+    clock = _Clock(t=1000.0)
+    api, _ = build_cluster(clock=clock)
+    _stale_pod(api, clock, assume_t=0.0)  # long expired
+    gc = AssumptionGC(_FlakyPatch(api, failures=1), assume_ttl_s=60.0,
+                      clock=clock)
+    assert gc.sweep() == []  # release failed transiently
+    clock.t += 1.0
+    assert gc.sweep() == ["default/stale-0"]  # NOT skipped: retried
+
+
+def test_gc_fallback_lister_for_index_less_readers():
+    """A reader without list_assignments (no assignment index) must fall
+    back to the whole-store scan with identical victims."""
+
+    class _Plain:
+        list_assignments = None  # getattr(...) or-falls-through
+
+        def __init__(self, api):
+            self._api = api
+
+        def __getattr__(self, name):
+            return getattr(self._api, name)
+
+    clock = _Clock(t=1000.0)
+    api, _ = build_cluster(clock=clock)
+    _stale_pod(api, clock, assume_t=0.0)
+    gc = AssumptionGC(_Plain(api), assume_ttl_s=60.0, clock=clock)
+    assert gc.sweep() == ["default/stale-0"]
+
+
+# ---- all four kill switches: the legacy paths stay byte-identical ------------
+
+
+def _run_small_trace(chaos=None):
+    from tputopo.sim.engine import run_trace
+    from tputopo.sim.trace import TraceConfig
+
+    report = run_trace(TraceConfig(seed=0, nodes=16, arrivals=60),
+                       ["ici", "naive"], chaos=chaos)
+    report.pop("throughput", None)
+    report.pop("phase_wall", None)
+    return json.dumps(report, sort_keys=True)
+
+
+@pytest.mark.parametrize("chaos", [None, "api-flake"])
+def test_all_kill_switches_off_report_is_byte_identical(chaos):
+    """Flipping every leg off must reproduce the optimized run's report
+    byte-for-byte (minus the wall blocks) — the four legs are pure
+    mechanics, never policy."""
+    from tputopo.sim.engine import SimEngine
+
+    on = _run_small_trace(chaos=chaos)
+    try:
+        ClusterState.FOLD_INPLACE = False
+        ExtenderScheduler.SCORE_INDEX = False
+        SimEngine.NOCOPY_WRITES = False
+        AssumptionGC.WATERMARK = False
+        off = _run_small_trace(chaos=chaos)
+    finally:
+        ClusterState.FOLD_INPLACE = True
+        ExtenderScheduler.SCORE_INDEX = True
+        SimEngine.NOCOPY_WRITES = True
+        AssumptionGC.WATERMARK = True
+    assert on == off

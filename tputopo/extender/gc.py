@@ -17,7 +17,9 @@ gangs in :attr:`stranded_gangs` instead of double-booking their chips.
 
 from __future__ import annotations
 
+import math
 import time
+from functools import partial
 
 from tputopo.k8s import objects as ko
 from tputopo.k8s.fakeapi import Conflict, NotFound
@@ -26,6 +28,17 @@ from tputopo.extender.state import _pod_assignment_of, list_pods_nocopy
 
 
 class AssumptionGC:
+    #: Kill switch for the next-expiry watermark (leg 4 of the fleet
+    #: hot-path pass): True lets :meth:`sweep` return without any API
+    #: read when no unconfirmed assumption can possibly have expired —
+    #: provable from the previous scan alone (every assumption stamped
+    #: since then is younger than that scan).  False scans every sweep,
+    #: the historical behavior byte-for-byte.  Skipped sweeps perform
+    #: zero API operations, so a chaos run's fault-draw stream is
+    #: untouched either way (listings never draw faults; only release
+    #: patches do, and a skipped sweep provably had none).
+    WATERMARK = True
+
     # ``api_server`` is deliberately untyped: the sweeper runs against
     # every reader/writer shape the control plane uses — FakeApiServer,
     # the REST KubeApiClient, the sim's copy-free facade, the chaos
@@ -36,6 +49,26 @@ class AssumptionGC:
         self.api = api_server
         self.assume_ttl_s = assume_ttl_s
         self.clock = clock
+        # Indexed candidate listing where the reader provides one
+        # (FakeApiServer's assignment-key index — O(assignments) — or
+        # the REST client's filtered spelling); readers without one fall
+        # back to the whole-store shim, bound HERE so the sweep itself
+        # never contains a full-store call — the sim/server hot paths
+        # always take the indexed arm, and the sweep's own
+        # _pod_assignment_of filter makes the two candidate sources
+        # victim-identical.
+        self._list_candidates = getattr(api_server, "list_assignments",
+                                        None) or partial(list_pods_nocopy,
+                                                         api_server)
+        # Next-expiry watermark: no unconfirmed assumption observed (or
+        # stampable) before this clock value.  -inf until the first scan,
+        # so a fresh sweeper always scans; min(oldest unconfirmed
+        # assumption, scan time) afterwards — assumptions stamped after a
+        # scan carry assume times >= that scan's clock, so
+        # ``now - ttl <= watermark`` proves an empty victim set.  A
+        # backdated hand-written stamp is still caught at most one TTL
+        # after the last scan (the scan-time bound decays).
+        self._watermark = -math.inf
         # Sweep-latency telemetry rides an injectable wall hook (the
         # clock=time.time default-arg idiom): it feeds the "gc" latency
         # series only — never expiry judgement, which is the injected
@@ -56,19 +89,33 @@ class AssumptionGC:
         """One pass: clear assignments for expired assumptions (and their
         whole gangs).  Returns the pod names released this pass.
 
-        The scan is direct: pods are filtered through the same
-        :func:`_pod_assignment_of` parse sync() uses and judged against
-        the TTL at one clock read — no :class:`ClusterState` build (the
-        full sync here was ~20% of fleet-scale sim wall once the baseline
-        policies stopped re-syncing; the sweep never needed allocators or
-        topology, only the assignment annotations).  Victim ORDER is the
-        old sync-derived order — expired assumptions in (assume_time,
-        namespace, name) order, then gang-expanded members grouped by
-        domain in node-list order — so release patch streams (and the
-        fault draws a chaos run assigns to them) are byte-stable across
-        the rewrite."""
+        Two layers of amortization replace the old per-TTL-period full
+        pod scan.  The **watermark** (:attr:`WATERMARK`) proves most
+        sweeps empty without a single API read: after a scan, the oldest
+        possibly-unconfirmed assumption is ``min(oldest unconfirmed seen,
+        scan time)`` — nothing can expire before that plus the TTL.  A
+        scanning sweep reads the **assignment index** where the reader
+        maintains one (``list_assignments``: only pods carrying the
+        chip-group annotation — O(assignments), a deep Pending queue
+        costs nothing) and judges candidates through the same
+        :func:`_pod_assignment_of` parse sync() uses, at one clock read.
+        Victim ORDER is the old sync-derived order — expired assumptions
+        in (assume_time, namespace, name) order, then gang-expanded
+        members grouped by domain in node-list order — so release patch
+        streams (and the fault draws a chaos run assigns to them) are
+        byte-stable across the rewrite."""
         t0 = self._wall()
         now = self.clock()
+        if self.WATERMARK and now - self.assume_ttl_s <= self._watermark:
+            # Provably nothing to reclaim: every unconfirmed assumption
+            # is younger than the TTL.  No listings, no patches — under
+            # chaos this is indistinguishable from the empty scan it
+            # replaces (list reads never draw faults).
+            if self.metrics is not None:
+                self.metrics.inc("gc_sweeps")
+                self.metrics.inc("gc_sweeps_skipped")
+                self.metrics.observe_ms("gc", (self._wall() - t0) * 1e3)
+            return []
         # TPU nodes only (the known-node gate sync applies), with each
         # slice's rank in node-name order — the domain iteration order the
         # gang expansion must reproduce.
@@ -86,23 +133,26 @@ class AssumptionGC:
             node_slice[node["metadata"]["name"]] = sid
             slice_rank.setdefault(sid, len(slice_rank))
         cands = []
-        # tpulint: disable=hot-path-scan -- amortized: one O(pods) annotation scan per TTL-period sweep (gc_period = assume_ttl/2), the documented cost of durable assumption reclaim
-        for pod in list_pods_nocopy(self.api):
+        for pod in self._list_candidates():
             pa = _pod_assignment_of(pod)
             if pa is not None and pa.node_name in node_slice:
                 cands.append(pa)
         cands.sort(key=lambda pa: (pa.assume_time, pa.namespace,
                                    pa.pod_name))
-        victims: dict[tuple[str, str], None] = {}
+        victims: dict[tuple[str, str], object] = {}
         gangs: set[tuple[str, str]] = set()  # (namespace, gang_id)
         live: list = []
+        oldest_unconfirmed = math.inf
         for pa in cands:
             if not pa.assigned and now - pa.assume_time > self.assume_ttl_s:
-                victims[(pa.namespace, pa.pod_name)] = None
+                victims[(pa.namespace, pa.pod_name)] = pa
                 if pa.gang_id:
                     gangs.add((pa.namespace, pa.gang_id))
             else:
                 live.append(pa)
+                if not pa.assigned:
+                    oldest_unconfirmed = min(oldest_unconfirmed,
+                                             pa.assume_time)
         # Gang expansion: release every still-unconfirmed member of an
         # expired gang together (a partial gang holds chips a complete gang
         # needs); confirmed members are running — flag, don't release.
@@ -118,11 +168,11 @@ class AssumptionGC:
                 if pa.assigned:
                     stranded.add(f"{pa.namespace}/{pa.gang_id}")
                 else:
-                    victims[(pa.namespace, pa.pod_name)] = None
+                    victims[(pa.namespace, pa.pod_name)] = pa
         self.stranded_gangs.extend(sorted(stranded))
         del self.stranded_gangs[:-100]
         released = []
-        for ns, name in victims:
+        for (ns, name), pa in victims.items():
             try:
                 self.api.patch_annotations(
                     "pods", name,
@@ -137,10 +187,13 @@ class AssumptionGC:
                 # Transient API failure or a racing writer on ONE victim
                 # must not abort the whole sweep (the other victims still
                 # need releasing) and must not kill the GC loop: skip it —
-                # the pod stays expired, so the next sweep retries.
+                # the pod stays expired, so the next sweep retries.  It
+                # also stays in the watermark: the next sweep must scan.
+                oldest_unconfirmed = min(oldest_unconfirmed, pa.assume_time)
                 if self.metrics is not None:
                     self.metrics.inc("gc_release_errors")
                 continue
+        self._watermark = min(oldest_unconfirmed, now)
         self.released.extend(released)
         del self.released[:-500]
         if self.metrics is not None:

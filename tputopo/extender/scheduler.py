@@ -28,6 +28,7 @@ import math
 import random
 import threading
 import time
+from operator import itemgetter
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING
 
@@ -56,6 +57,10 @@ LABEL_GANG_SIZE = "tpu.dev/gang-size"
 LABEL_ALLOW_MULTISLICE = "tpu.dev/allow-multislice"
 
 MAX_PRIORITY = 10  # kube-scheduler extender priority ceiling
+
+#: The one max-score selection rule every sort consumer applies —
+#: highest Score, host name as the deterministic tie-break (C-level key).
+BEST_SCORE_KEY = itemgetter("Score", "Host")
 
 
 @functools.lru_cache(maxsize=256)
@@ -316,6 +321,26 @@ class ExtenderScheduler:
 
     _GANG_PLAN_CACHE_MAX = 512
 
+    #: Kill switch for the incremental score index (leg 2 of the fleet
+    #: hot-path pass): the per-state ``{k: {node: score}}`` index read by
+    #: the sort loop and maintained by the SAME engine events the state
+    #: folds (only nodes of occupancy-changed domains re-score).  False
+    #: restores the historical flat ``(k, node)`` score memo plus its
+    #: per-fold filter-copy carry, byte-for-byte — hit counts
+    #: (``score_memo_hits``) and explain ``memo_hit`` flags are identical
+    #: under both shapes; only wall time moves.
+    SCORE_INDEX = True
+
+    @property
+    def _single_owner(self) -> bool:
+        """True when this scheduler provably holds the ONLY reference to
+        its cached derived state: informer-less ``bind_from_cache`` mode
+        (the sim engine's single-threaded single-writer deployment).
+        Only then may folds mutate in place — the threaded/informer
+        paths publish states to lock-free concurrent readers and must
+        keep the copy-on-write discipline."""
+        return self.informer is None and self.config.bind_from_cache
+
     # Even with an unchanged informer mirror, a derived state cannot be
     # reused forever: assumption-TTL expiry is judged by the clock at sync
     # time, not by watch events.  5 s keeps worst-case expiry staleness far
@@ -354,14 +379,28 @@ class ExtenderScheduler:
         if not events:
             return  # nothing changed; the cached state is already exact
         reasons: list[str] = []
-        new_state = state.with_events(events, reasons)
+        if self._single_owner:
+            # Single-owner fast path: fold by mutating the state we own
+            # (ClusterState.fold_inplace — its FOLD_INPLACE kill switch
+            # restores the COW clone byte-for-byte) and evict only the
+            # memo entries the fold's occupancy changes invalidate,
+            # instead of filter-copying every memo dict per fold.
+            pre_masks = ({sid: dom.allocator.used_mask
+                          for sid, dom in state.domains.items()}
+                         if ClusterState.FOLD_INPLACE else None)
+            new_state = state.fold_inplace(events, reasons)
+        else:
+            new_state = state.with_events(events, reasons)
         if new_state is None:
             self._count_delta_fallback(reasons)
             with self._cache_lock:
                 self._cached_state = None
         else:
             self.metrics.inc("state_delta_applied")
-            new_state = self._carry_state_memos(state, new_state)
+            if new_state is state:
+                self._evict_state_memos(state, pre_masks)
+            else:
+                new_state = self._carry_state_memos(state, new_state)
             with self._cache_lock:
                 if self._cached_state is state:
                     self._cached_state = new_state
@@ -388,15 +427,37 @@ class ExtenderScheduler:
                 # rebuild, which carries nothing), so one set membership
                 # per key replaces the two-method domain lookup that was
                 # the fold tail's top cost on thousand-node fleets.
+                # list(items()) first: a concurrent lock-free sort may
+                # still be inserting into the OLD state's memo, and the
+                # C-level list snapshot is atomic where a comprehension
+                # over a growing dict is not.
                 changed_nodes = {n for sid in changed
                                  for n in new.domains[sid].host_by_node}
-                kept = {key: v for key, v in memo.items()
+                kept = {key: v for key, v in list(memo.items())
                         if key[1] not in changed_nodes}
             else:
                 kept = dict(memo)
             if kept:
                 new._score_memo = kept
                 self.metrics.inc("score_memo_carried", len(kept))
+        sidx = getattr(old, "_score_index", None)
+        if sidx:
+            # The incremental score index (SCORE_INDEX shape), carried
+            # across a COW replacement with the same changed-domain
+            # filter as the flat memo above — hit behavior is identical,
+            # only the layout differs (the in-place eviction path is
+            # where the index pays off; see _evict_state_memos).  Same
+            # atomic-snapshot rule as the memo: concurrent sorts insert
+            # into the old state's buckets while this fold carries them.
+            if changed:
+                changed_nodes = {n for sid in changed
+                                 for n in new.domains[sid].host_by_node}
+                kept_idx = {k: {n: v for n, v in list(kd.items())
+                                if n not in changed_nodes}
+                            for k, kd in list(sidx.items())}
+            else:
+                kept_idx = {k: dict(kd) for k, kd in list(sidx.items())}
+            new._score_index = kept_idx
         cand = getattr(old, "_gang_cand_memo", None)
         if cand:
             kept = {key: v for key, v in cand.items()
@@ -404,6 +465,41 @@ class ExtenderScheduler:
             if kept:
                 new._gang_cand_memo = kept
         return new
+
+    def _evict_state_memos(self, state: ClusterState,
+                           pre_masks: dict[str, int]) -> None:
+        """The in-place twin of :meth:`_carry_state_memos`: after a
+        single-owner fold mutated ``state`` directly, evict exactly the
+        memo entries the COW path would have dropped — nodes of domains
+        whose occupancy mask moved since ``pre_masks`` was snapshotted —
+        in O(changed domains) instead of filter-copying every memo dict.
+        The gang context/member-list memos are dropped wholesale: the
+        COW clone never carried them (member listings can change on any
+        event, occupancy-moving or not), and in-place parity requires
+        the same."""
+        for attr in ("_gang_ctx_memo", "_gang_members_memo"):
+            if getattr(state, attr, None) is not None:
+                delattr(state, attr)
+        changed = {sid for sid, dom in state.domains.items()
+                   if dom.allocator.used_mask != pre_masks.get(sid)}
+        if not changed:
+            return
+        sidx = getattr(state, "_score_index", None)
+        if sidx:
+            for kd in sidx.values():
+                for sid in changed:
+                    for n in state.domains[sid].host_by_node:
+                        kd.pop(n, None)
+        memo = getattr(state, "_score_memo", None)
+        if memo:
+            changed_nodes = {n for sid in changed
+                             for n in state.domains[sid].host_by_node}
+            for key in [key for key in memo if key[1] in changed_nodes]:
+                del memo[key]
+        cand = getattr(state, "_gang_cand_memo", None)
+        if cand:
+            for key in [key for key in cand if key[0] in changed]:
+                del cand[key]
 
     def _count_delta_fallback(self, reasons: list[str] | str) -> None:
         """One forced full rebuild, attributed: the flat
@@ -664,8 +760,51 @@ class ExtenderScheduler:
         if explain_nodes is not None and gang_ctx is not None:
             plan_doms = self._plan_domains(state, gang_ctx["plan"])
         rejects_kept = rejects_omitted = 0
+        # Batch index reads for the non-gang score loop: the per-``k``
+        # bucket is resolved ONCE per sort and hits are counted locally
+        # (one metrics.inc at the end) — at fleet scale the loop runs
+        # O(nodes) times per member and the per-node method call plus
+        # counter increment were a measured slice of the sort tail.
+        kd = None
+        hits = 0
+        if self.SCORE_INDEX and gang is None and k > 0:
+            kd = self._score_index_for(state, k)
+        # Untraced fast paths: no explain bookkeeping and no generation
+        # pin means the per-node loop needs no branches at all — a gang
+        # sort's per-node rank scores are precomputed over the plan
+        # (O(plan) instead of O(nodes) calls: planned nodes are the only
+        # nonzero scores), and a single-pod sort is one index read per
+        # node.  Scores, index content, and hit counters are identical
+        # to the slow loop below — the fleet trace spends ~70k sorts per
+        # run in exactly this shape, where per-node call overhead was
+        # the measured sort-tail floor.
+        fast = explain_nodes is None and k > 0 and wanted_gen is None
         out = []
         with tr.phase("score") as sp:
+            if fast and gang is not None:
+                gang_scores = ({n: self._score_gang_node(gang_ctx, n)
+                                for n in gang_ctx["order"]}
+                               if gang_ctx is not None else {})
+                gs_get = gang_scores.get
+                out = [{"Host": n, "Score": gs_get(n, 0)}
+                       for n in node_names]
+                sp.count("nodes", len(node_names))
+                return out
+            if fast and kd is not None:
+                kd_get = kd.get
+                uncached = self._score_node_uncached
+                ap = out.append
+                for name in node_names:
+                    score = kd_get(name)
+                    if score is None:
+                        score = kd[name] = uncached(state, k, name)
+                    else:
+                        hits += 1
+                    ap({"Host": name, "Score": score})
+                sp.count("nodes", len(node_names))
+                if hits:
+                    self.metrics.inc("score_memo_hits", hits)
+                return out
             for name in node_names:
                 score = 0
                 reason = None
@@ -681,6 +820,18 @@ class ExtenderScheduler:
                         reason = ("gang_infeasible" if gang_ctx is None
                                   else self._gang_reject_reason(
                                       state, k, name, gang_ctx, plan_doms))
+                elif kd is not None:
+                    if explain_nodes is not None:
+                        memo_hit = name in kd
+                    score = kd.get(name)
+                    if score is None:
+                        score = kd[name] = self._score_node_uncached(
+                            state, k, name)
+                    else:
+                        hits += 1
+                    if (score == 0 and explain_nodes is not None
+                            and rejects_kept < self._EXPLAIN_REJECT_CAP):
+                        reason = self._zero_score_reason(state, k, name)
                 else:
                     if explain_nodes is not None:
                         memo = getattr(state, "_score_memo", None)
@@ -704,6 +855,8 @@ class ExtenderScheduler:
                         e["rejected"] = reason
                     explain_nodes.append(e)
             sp.count("nodes", len(node_names))
+        if hits:
+            self.metrics.inc("score_memo_hits", hits)
         if tr.enabled:
             md = pod.get("metadata", {})
             record = {
@@ -722,6 +875,80 @@ class ExtenderScheduler:
             tr.explain(record)
         return out
 
+    def sort_best(self, pod: dict, node_names: list[str]) -> dict | None:
+        """The sort verb reduced to its winner: the ``{"Host", "Score"}``
+        entry a ``max(sort(...), key=BEST_SCORE_KEY)`` would select, or
+        None when nothing scores positive (which every placement consumer
+        treats exactly like an empty candidate list).  Traced schedulers,
+        kill-switched score indexes, zero-chip pods, and generation pins
+        all DELEGATE to :meth:`sort` — explain records, phase spans, and
+        every counter stay byte-for-byte the verb's.  The untraced
+        steady-state shape skips materializing the O(nodes) score list:
+        a gang sort reads only the plan's rank scores, a single-pod sort
+        streams the score index — same index content, same
+        ``score_memo_hits``, same winner.  The sim's placement loop is
+        the consumer: at fleet saturation it was building (and max-ing
+        over) ~70M score dicts per run."""
+        k = ko.pod_requested_chips(pod)
+        if (self.tracer.enabled or not self.SCORE_INDEX or k <= 0
+                or _wanted_generation(pod) is not None):
+            scores = self.sort(pod, node_names)
+            return max(scores, key=BEST_SCORE_KEY) if scores else None
+        t0 = self._wall()
+        self.metrics.inc("sort_requests")
+        informer_reader = (self.informer if self.informer is not None
+                           and self.informer.synced else None)
+        state = self._state(allow_cache=True, reader=informer_reader)
+        gang = _gang_of(pod)
+        best_s = 0
+        best_n: str | None = None
+        if gang is not None:
+            gang_ctx = self._gang_context(
+                state, gang, k, None,
+                reader=informer_reader or self.api, pod=pod)
+            if gang_ctx is not None:
+                for n in gang_ctx["order"]:
+                    s = self._score_gang_node(gang_ctx, n)
+                    if s > best_s:
+                        best_s, best_n = s, n
+                    elif s and s == best_s and n > best_n:
+                        best_n = n
+                if best_n is not None and best_n not in node_names:
+                    # A planned node outside the candidate list (not a
+                    # sim shape — plans come from the same alive state):
+                    # recompute the max over the actual candidates, with
+                    # the same (Score, Host) tie-break as everywhere.
+                    gs = {n: self._score_gang_node(gang_ctx, n)
+                          for n in gang_ctx["order"]}
+                    best_s, best_n = 0, None
+                    for n in node_names:
+                        s = gs.get(n, 0)
+                        if s > best_s:
+                            best_s, best_n = s, n
+                        elif s and s == best_s and n > best_n:
+                            best_n = n
+        else:
+            kd = self._score_index_for(state, k)
+            kd_get = kd.get
+            uncached = self._score_node_uncached
+            hits = 0
+            for name in node_names:
+                s = kd_get(name)
+                if s is None:
+                    s = kd[name] = uncached(state, k, name)
+                else:
+                    hits += 1
+                if s > best_s:
+                    best_s, best_n = s, name
+                elif s and s == best_s and name > best_n:
+                    best_n = name
+            if hits:
+                self.metrics.inc("score_memo_hits", hits)
+        self.metrics.observe_ms("sort", (self._wall() - t0) * 1e3)
+        if best_s <= 0 or best_n is None:
+            return None
+        return {"Host": best_n, "Score": best_s}
+
     def _generation_ok(self, state: ClusterState, node_name: str,
                        wanted: str | None) -> bool:
         if wanted is None:
@@ -729,12 +956,37 @@ class ExtenderScheduler:
         dom = state.domain_of_node(node_name)
         return dom is not None and dom.topology.generation.name == wanted
 
+    def _score_index_for(self, state: ClusterState, k: int) -> dict[str, int]:
+        """The per-``k`` node->score bucket of the state's incremental
+        score index (SCORE_INDEX shape), created lazily.  The index lives
+        on the state instance, so it can never outlive the occupancy it
+        was computed from: full rebuilds start empty, COW replacements
+        carry it filtered (:meth:`_carry_state_memos`), and single-owner
+        in-place folds evict exactly the changed domains' nodes
+        (:meth:`_evict_state_memos`)."""
+        idx = getattr(state, "_score_index", None)
+        if idx is None:
+            idx = state._score_index = {}
+        kd = idx.get(k)
+        if kd is None:
+            kd = idx[k] = {}
+        return kd
+
     def _score_node(self, state: ClusterState, k: int, node_name: str) -> int:
         # Memoized on the state instance: a wave of same-sized pods sorts
         # back-to-back against one derived state (the informer-version
         # cache), and a node's score depends only on (state, k, node).
         # States are replaced wholesale (rebuild or bind delta clone), so
         # the memo can never outlive the facts it was computed from.
+        if self.SCORE_INDEX:
+            kd = self._score_index_for(state, k)
+            got = kd.get(node_name)
+            if got is None:
+                got = kd[node_name] = self._score_node_uncached(
+                    state, k, node_name)
+            else:
+                self.metrics.inc("score_memo_hits")
+            return got
         memo = getattr(state, "_score_memo", None)
         if memo is None:
             memo = state._score_memo = {}
@@ -844,6 +1096,14 @@ class ExtenderScheduler:
         contiguous box on the host grid so the union is ICI-contiguous
         (SURVEY.md §7: Link-scheduler analog in 3D).  Returns
         {node_name: placement} or None when the gang cannot fit."""
+        # Free-volume pre-gate: every member needs k distinct chips, so a
+        # domain with fewer than replicas*k free chips TOTAL can never
+        # host the gang — answer None before building candidate maps or
+        # the host-grid allocator.  At fleet saturation most domains fail
+        # here, which is what keeps a deeply queued gang's per-wake
+        # replan from walking every host of every domain.
+        if dom.allocator.free_count < replicas * k:
+            return None
         topo = dom.topology
         hb = topo.generation.host_bounds
         grid_dims = tuple(max(1, d // b) for d, b in zip(topo.dims, hb))
@@ -1788,12 +2048,25 @@ class ExtenderScheduler:
                 # own bind to the cached derived state so the next verb in the
                 # burst reuses it instead of re-syncing — the cache's coherence
                 # is exactly this delta, since no one else writes assignments.
-                new_state = (self._bind_delta_state(
-                    state, pod_name, namespace, node_name, placement, now,
-                    gang_id) if self.config.state_delta
-                    and state is self._cached_state else None)
+                # Single-owner by definition, so the delta folds IN PLACE
+                # (ClusterState.bind_inplace: an O(chips) note_bind instead
+                # of the _cow clone; its FOLD_INPLACE kill switch restores
+                # the copy-on-write clone byte-for-byte) and memo eviction
+                # touches only the bound domain.
+                new_state = None
+                if self.config.state_delta and state is self._cached_state:
+                    pre_masks = ({sid: dom.allocator.used_mask
+                                  for sid, dom in state.domains.items()}
+                                 if ClusterState.FOLD_INPLACE else None)
+                    new_state = state.bind_inplace(PodAssignment(
+                        pod_name=pod_name, namespace=namespace or "default",
+                        node_name=node_name, chips=list(placement.chips),
+                        assigned=False, assume_time=now, gang_id=gang_id))
                 if new_state is not None:
-                    new_state = self._carry_state_memos(state, new_state)
+                    if new_state is state:
+                        self._evict_state_memos(state, pre_masks)
+                    else:
+                        new_state = self._carry_state_memos(state, new_state)
                     self.metrics.inc("bind_state_delta")
                 with self._cache_lock:
                     self._cached_state = new_state

@@ -152,6 +152,14 @@ class SliceDomain:
 
 
 class ClusterState:
+    #: Kill switch for the single-owner in-place fold (leg 1 of the fleet
+    #: hot-path pass): False makes :meth:`fold_inplace` delegate to the
+    #: copy-on-write :meth:`with_events`/:meth:`with_bind` path, byte-for-
+    #: byte — the differential tests' comparator.  Class-level so a test
+    #: can flip the whole process; callers still decide *eligibility*
+    #: (only a provably single-owner state may fold in place).
+    FOLD_INPLACE = True
+
     def __init__(self, api_server: FakeApiServer, *,
                  cost_for_generation=None, assume_ttl_s: float = 60.0,
                  clock=time.time) -> None:
@@ -442,6 +450,72 @@ class ClusterState:
                 reasons.append(e.code)
             return None
         return new
+
+    def fold_inplace(self, events,
+                     reasons: list[str] | None = None) -> "ClusterState | None":
+        """Single-owner twin of :meth:`with_events`: fold the same event
+        vocabulary by MUTATING this state instead of paying the
+        copy-on-write clone (``_cow``'s O(active-pods) list/dict copies
+        were ~6.2k folds per fleet trace).  Only valid when the caller
+        holds the ONLY reference to this state — the sim engine's
+        bind-from-cache scheduler and the baseline policies' cached
+        states qualify; anything published to concurrent readers (the
+        extender's informer-coherent pair) must keep using
+        :meth:`with_events`.
+
+        Returns ``self`` on success.  Returns None when an event cannot
+        fold exactly (same reason vocabulary as :meth:`with_events`) —
+        and then this state may be PARTIALLY MUTATED and must be
+        discarded for a full sync, which is precisely what every delta
+        consumer already does on a None.
+
+        With :attr:`FOLD_INPLACE` off (the kill switch) this delegates
+        to the copy-on-write path byte-for-byte and returns the clone,
+        leaving ``self`` untouched — so call sites can stay shape-
+        agnostic (``new = state.fold_inplace(...)``) under either mode."""
+        if not ClusterState.FOLD_INPLACE:
+            return self.with_events(events, reasons)
+        if self.conflicts:
+            # Same verdict as with_events: conflicted occupancy
+            # attribution is order-dependent — only a re-sort answers.
+            if reasons is not None:
+                reasons.append("conflict")
+            return None
+        try:
+            for kind, etype, obj in events:
+                if etype == "BOOKMARK":
+                    continue
+                if kind == "pods":
+                    self._apply_pod_event(etype, obj)
+                elif kind == "nodes":
+                    self._apply_node_event(etype, obj)
+                else:
+                    raise _DeltaUnappliable(f"unknown kind {kind!r}")
+        except _DeltaUnappliable as e:
+            if reasons is not None:
+                reasons.append(e.code)
+            return None
+        return self
+
+    def bind_inplace(self, pa: PodAssignment) -> "ClusterState | None":
+        """Single-owner twin of :meth:`with_bind`: apply one just-committed
+        bind by mutating this state (an O(chips) :meth:`note_bind`) instead
+        of cloning.  Same ownership contract as :meth:`fold_inplace`; the
+        :attr:`FOLD_INPLACE` kill switch restores the copy-on-write clone
+        byte-for-byte.  Returns ``self`` (or the clone) on success, None
+        when the chips are not cleanly free here — ``mark_used`` validates
+        the whole batch before mutating, so a None leaves this state
+        UNCHANGED (unlike a failed fold) and the caller simply drops it."""
+        if not ClusterState.FOLD_INPLACE:
+            try:
+                return self.with_bind(pa)
+            except ValueError:
+                return None
+        try:
+            self.note_bind(pa)
+        except (ValueError, KeyError):
+            return None
+        return self
 
     # -- event folding internals (mutate a _cow clone only) ------------------
 

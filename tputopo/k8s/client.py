@@ -170,6 +170,19 @@ class KubeApiClient:
         return sorted(out, key=lambda o: (o["metadata"].get("namespace", ""),
                                           o["metadata"]["name"]))
 
+    def list_assignments(self) -> list[dict]:
+        """Pods carrying the chip-group assignment annotation — the GC
+        sweep's candidate listing.  A real apiserver has no annotation
+        index (field selectors cannot reach annotations), so this is a
+        client-side filtered LIST: the O(pods) cost lives here, at the
+        REST boundary where it is unavoidable, while indexed backends
+        (FakeApiServer) answer in O(assignments)."""
+        from tputopo.k8s.objects import ANN_GROUP
+
+        return self.list(
+            "pods",
+            lambda p: ANN_GROUP in (p["metadata"].get("annotations") or {}))
+
     def _list_paged(self, kind: str, label_selector: dict[str, str] | None,
                     chunk_limit: int) -> tuple[list[dict], str]:
         """Server-side selector push-down + apiserver chunking (limit /

@@ -22,6 +22,8 @@ import time
 from bisect import bisect_left, insort
 from typing import Callable, Iterable
 
+from tputopo.k8s.objects import ANN_GROUP
+
 
 class NotFound(KeyError):
     pass
@@ -164,9 +166,10 @@ class ObjectHandle:
     """A stable, copy-free reference to one stored object.
 
     Keyed by (kind, namespace, name), never by dict identity: the handle
-    survives annotation patches (the server mutates the stored dict in
-    place) AND delete/recreate cycles (a fresh dict under the same key —
-    e.g. a requeued sim job's recreated pods).  :meth:`fetch` is the
+    survives annotation patches (in-place mutation on the legacy write
+    path, wholesale replacement of the stored incarnation under
+    ``nocopy_writes``) AND delete/recreate cycles (a fresh dict under
+    the same key — e.g. a requeued sim job's recreated pods).  :meth:`fetch` is the
     handle-based variant of :meth:`FakeApiServer.get_nocopy` and carries
     the same contract: single-threaded readers only, NEVER mutate the
     result.  The sim engine holds one per gang member so its confirm /
@@ -192,8 +195,26 @@ class ObjectHandle:
 
 
 class FakeApiServer:
-    def __init__(self) -> None:
+    def __init__(self, *, nocopy_writes: bool = False) -> None:
         self._lock = threading.RLock()
+        # Copy-free write path (leg 3 of the fleet hot-path pass), OFF by
+        # default: when enabled, the mutating verbs (create/create_many
+        # staging, patch_annotations/patch_labels, bind_pod) build the
+        # new stored object by STRUCTURAL SHARING — a fresh top-level
+        # dict with a fresh metadata (and, where mutated, annotations/
+        # labels/spec/status) dict, every untouched sub-dict shared with
+        # the previous incarnation — and return the stored object itself
+        # instead of a deepcopy.  The aliasing contract flips from
+        # "patches mutate stored dicts in place" to the STRONGER "no
+        # stored dict is ever mutated once handed out": a nocopy reader's
+        # reference becomes a frozen snapshot of that resourceVersion.
+        # In exchange, write callers inherit the nocopy read contract
+        # (NEVER mutate a returned object or the staged input's shared
+        # sub-dicts) — the single-threaded sim engine qualifies and
+        # enables it; the threaded extender stack keeps the default,
+        # whose echoes remain caller-owned deep copies.  The lint nocopy
+        # rules and the runtime digest guard police the contract.
+        self.nocopy_writes = nocopy_writes
         # guarded-by: _lock|_watch_cond
         self._objects: dict[str, dict[tuple[str, str], dict]] = {
             "nodes": {},
@@ -240,16 +261,47 @@ class FakeApiServer:
         # informer mirror).  Values are the STORED dicts (same objects as
         # the store), so in-place annotation patches stay visible;
         # maintained on every create/delete and on the two metadata patch
-        # verbs.
+        # verbs — and refreshed on every structural-sharing replacement
+        # (nocopy_writes), where the stored dict identity changes per
+        # write.
         self._meta_index = MetaIndex()  # guarded-by: _lock|_watch_cond
+        # Assignment-key index: pod store keys currently carrying the
+        # chip-group assignment annotation (ko.ANN_GROUP).  The GC
+        # sweep's candidate universe is exactly these pods, so
+        # :meth:`list_assignments` answers in O(assignments) instead of
+        # the O(store) listing that made the per-TTL-period expiry scan a
+        # profiled fleet hot path.  Maintained at the same points as the
+        # meta index.
+        self._assign_keys: set[tuple[str, str]] = set()  # guarded-by: _lock|_watch_cond
 
     # ---- meta equality index ----------------------------------------------
 
     def _index_obj(self, kind: str, key: tuple[str, str], obj: dict) -> None:  # holds-lock: _lock
         self._meta_index.install(kind, key, obj)
+        if kind == "pods" and ANN_GROUP in (
+                obj["metadata"].get("annotations") or {}):
+            self._assign_keys.add(key)
 
     def _unindex_obj(self, kind: str, key: tuple[str, str], obj: dict) -> None:  # holds-lock: _lock
         self._meta_index.remove(kind, key, obj)
+        if kind == "pods":
+            self._assign_keys.discard(key)
+
+    def list_assignments(self) -> list[dict]:
+        """The pods currently carrying the chip-group assignment
+        annotation, as stored dicts in (namespace, name) order — the
+        indexed candidate listing behind the GC's expiry sweep (same
+        single-threaded read-only contract as :meth:`list_nocopy`).
+        O(assignments), not O(store): Pending arrivals never enter the
+        index, so a deep queue costs the sweep nothing."""
+        with self._lock:
+            store = self._objects["pods"]
+            out = [store[k] for k in sorted(self._assign_keys)]
+            if self.nocopy_guard:
+                for o in out:
+                    self._guard_check("pods", o)
+                    self._guard_record("pods", o)
+        return out
 
     def list_by_meta(self, kind: str, key: str, value: str,
                      copy: bool = True) -> list[dict]:
@@ -353,8 +405,22 @@ class FakeApiServer:
 
     # ---- CRUD -------------------------------------------------------------
 
+    @staticmethod
+    def _reincarnate(obj: dict) -> dict:
+        """THE structural-sharing incarnation every copy-free write
+        builds on: a fresh top-level + metadata dict (so the rv bump
+        never touches the source object — a caller's input at create, a
+        handed-out previous incarnation on the patch/bind/delete verbs),
+        everything else — spec, status, the annotation/label dicts
+        themselves — shared structurally.  Callers copy exactly the
+        sub-dicts they are about to mutate and nothing more; valid
+        because under ``nocopy_writes`` no incarnation is ever mutated
+        once handed out (every later write replaces wholesale), and
+        write callers promise the same for their inputs."""
+        return {**obj, "metadata": dict(obj["metadata"])}
+
     def create(self, kind: str, obj: dict, *, echo: bool = True) -> dict:
-        """Store a deep copy of ``obj`` (callers keep ownership of their
+        """Store a copy of ``obj`` (callers keep ownership of their
         input) and return the created object.
 
         ``echo=True`` (default, the K8s REST shape) returns an independent
@@ -362,21 +428,26 @@ class FakeApiServer:
         deepcopy per create on top of the store copy.  Callers that only
         need the identity/version of what they just created pass
         ``echo=False`` and get a metadata-only stub ({name, namespace,
-        resourceVersion}) built without copying the object at all."""
+        resourceVersion}) built without copying the object at all.
+
+        Under ``nocopy_writes`` the store copy is the structural-sharing
+        :meth:`_reincarnate` and the echo is the stored object itself —
+        the nocopy read contract (never mutate) extends to it."""
         with self._lock:
             md = obj["metadata"]
             k = _key(md.get("namespace"), md["name"])
             store = self._store(kind)
             if k in store:
                 raise Conflict(f"{kind} {k} already exists")
-            copy_ = copy.deepcopy(obj)
+            copy_ = self._reincarnate(obj) if self.nocopy_writes \
+                else copy.deepcopy(obj)
             self._bump(copy_)
             store[k] = copy_
             self._key_added(kind, k)
             self._index_obj(kind, k, copy_)
             self._emit("ADDED", kind, copy_)
             if echo:
-                return copy.deepcopy(copy_)
+                return copy_ if self.nocopy_writes else copy.deepcopy(copy_)
             return {"metadata": {
                 "name": md["name"],
                 "namespace": md.get("namespace"),
@@ -404,7 +475,8 @@ class FakeApiServer:
                 if k in store:
                     raise Conflict(f"{kind} {k} already exists")
             for obj, k in zip(objs, keys):
-                copy_ = copy.deepcopy(obj)
+                copy_ = self._reincarnate(obj) if self.nocopy_writes \
+                    else copy.deepcopy(obj)
                 self._bump(copy_)
                 store[k] = copy_
                 self._key_added(kind, k)
@@ -427,9 +499,11 @@ class FakeApiServer:
         read-only consumers (the sim engine's confirm path and policy
         place() re-fetched every member pod per event, and the deepcopy
         chain behind :meth:`get` was ~30% of sim wall).  Callers MUST NOT
-        mutate the returned dict; concurrent writers make the view racy
-        (annotation patches mutate stored dicts in place).  The threaded
-        extender stack keeps using :meth:`get`."""
+        mutate the returned dict; concurrent writers make the view racy —
+        on the legacy write path annotation patches mutate stored dicts
+        in place, while under ``nocopy_writes`` a held reference stays
+        frozen at its resourceVersion and silently goes stale instead.
+        The threaded extender stack keeps using :meth:`get`."""
         with self._lock:
             try:
                 obj = self._store(kind)[_key(namespace, name)]
@@ -466,9 +540,10 @@ class FakeApiServer:
         (tputopo.sim) drives thousands of ClusterState syncs per trace,
         and the deepcopy in :meth:`list` was ~80% of its wall clock.
         Callers MUST NOT mutate the returned dicts, and concurrent
-        writers make the view racy (annotation patches mutate stored
-        dicts in place); the threaded extender stack keeps using
-        :meth:`list`."""
+        writers make the view racy (in-place patches on the legacy
+        write path; frozen-but-stale snapshots under ``nocopy_writes``
+        — see :meth:`get_nocopy`); the threaded extender stack keeps
+        using :meth:`list`."""
         with self._lock:
             out = self._sorted_objects(kind)
             if self.nocopy_guard:
@@ -550,7 +625,12 @@ class FakeApiServer:
             # _bump (not a bare rv increment): the event's object must carry
             # the delete's own resourceVersion — the REST watch leg derives
             # its progress from object metadata, and a stale rv there makes
-            # the stream replay the trailing delete forever.
+            # the stream replay the trailing delete forever.  Under
+            # nocopy_writes the bump lands on a structurally-shared event
+            # incarnation: the popped object itself must stay frozen for
+            # any nocopy reader still holding it.
+            if self.nocopy_writes:
+                obj = self._reincarnate(obj)
             self._bump(obj)
             self._emit("DELETED", kind, obj)
 
@@ -578,18 +658,32 @@ class FakeApiServer:
                 )
             store_key = _key(namespace, name)
             self._unindex_obj(kind, store_key, obj)
-            anns = obj["metadata"].setdefault("annotations", {})
+            if self.nocopy_writes:
+                # Structural sharing: a NEW incarnation (_reincarnate)
+                # replaces the stored object wholesale; the previous one
+                # — and any nocopy reference to it — stays frozen at its
+                # resourceVersion.  Only the annotation dict is copied,
+                # never the whole pod.
+                new_obj = self._reincarnate(obj)
+                new_md = new_obj["metadata"]
+                anns = dict(new_md.get("annotations") or {})
+                new_md["annotations"] = anns
+            else:
+                new_obj = obj
+                anns = obj["metadata"].setdefault("annotations", {})
             for k, v in patch.items():
                 if v is None:
                     anns.pop(k, None)
                 else:
                     anns[k] = str(v)
-            self._index_obj(kind, store_key, obj)
-            self._bump(obj)
-            self._emit("MODIFIED", kind, obj)
+            if new_obj is not obj:
+                self._store(kind)[store_key] = new_obj
+            self._index_obj(kind, store_key, new_obj)
+            self._bump(new_obj)
+            self._emit("MODIFIED", kind, new_obj)
             self.events.append({"type": "patch", "kind": kind, "name": name,
                                 "patch": dict(patch)})
-            return copy.deepcopy(obj)
+            return new_obj if self.nocopy_writes else copy.deepcopy(new_obj)
 
     def patch_labels(self, kind: str, name: str, patch: dict[str, str | None],
                      namespace: str | None = None) -> dict:
@@ -603,16 +697,25 @@ class FakeApiServer:
                 self._guard_check(kind, obj)
             store_key = _key(namespace, name)
             self._unindex_obj(kind, store_key, obj)
-            labels = obj["metadata"].setdefault("labels", {})
+            if self.nocopy_writes:
+                new_obj = self._reincarnate(obj)
+                new_md = new_obj["metadata"]
+                labels = dict(new_md.get("labels") or {})
+                new_md["labels"] = labels
+            else:
+                new_obj = obj
+                labels = obj["metadata"].setdefault("labels", {})
             for k, v in patch.items():
                 if v is None:
                     labels.pop(k, None)
                 else:
                     labels[k] = str(v)
-            self._index_obj(kind, store_key, obj)
-            self._bump(obj)
-            self._emit("MODIFIED", kind, obj)
-            return copy.deepcopy(obj)
+            if new_obj is not obj:
+                self._store(kind)[store_key] = new_obj
+            self._index_obj(kind, store_key, new_obj)
+            self._bump(new_obj)
+            self._emit("MODIFIED", kind, new_obj)
+            return new_obj if self.nocopy_writes else copy.deepcopy(new_obj)
 
     # ---- binding (the extender's bind verb target) -------------------------
 
@@ -626,12 +729,26 @@ class FakeApiServer:
                 self._guard_check("pods", pod)
             if pod["spec"].get("nodeName"):
                 raise Conflict(f"pod {name} already bound to {pod['spec']['nodeName']}")
-            pod["spec"]["nodeName"] = node_name
-            pod["status"]["phase"] = "Scheduled"
-            self._bump(pod)
-            self._emit("MODIFIED", "pods", pod)
+            if self.nocopy_writes:
+                key = _key(namespace, name)
+                new_pod = self._reincarnate(pod)
+                new_pod["spec"] = dict(pod["spec"])
+                new_pod["spec"]["nodeName"] = node_name
+                new_pod["status"] = dict(pod.get("status") or {})
+                new_pod["status"]["phase"] = "Scheduled"
+                # Replacement changes the stored dict identity — the meta
+                # index values are the stored dicts, so reinstall.
+                self._unindex_obj("pods", key, pod)
+                self._store("pods")[key] = new_pod
+                self._index_obj("pods", key, new_pod)
+            else:
+                pod["spec"]["nodeName"] = node_name
+                pod["status"]["phase"] = "Scheduled"
+                new_pod = pod
+            self._bump(new_pod)
+            self._emit("MODIFIED", "pods", new_pod)
             self.events.append({"type": "bind", "name": name, "node": node_name})
-            return copy.deepcopy(pod)
+            return new_pod if self.nocopy_writes else copy.deepcopy(new_pod)
 
     # ---- convenience for tests --------------------------------------------
 

@@ -332,7 +332,8 @@ class Informer:
         themselves — for read-only consumers on the hot path (the
         extender's per-sort ClusterState rebuild measures ~5 ms of pure
         deepcopy on a 16-node cluster otherwise); such callers MUST NOT
-        mutate the returned dicts."""
+        mutate the returned dicts.  See :meth:`get_nocopy` for the full
+        aliasing contract the no-mutation rule rests on."""
         import copy as copymod
         with self._lock:
             objs = list(self._store[kind].values())
@@ -350,9 +351,9 @@ class Informer:
         — the informer half of :meth:`FakeApiServer.list_by_meta`
         (O(result) via the maintained index; unindexed keys raise
         KeyError).  ``copy=False`` returns the mirrored dicts under the
-        same read-only contract as ``list(copy=False)``; mirror entries
-        are replaced wholesale, never mutated, so each is a consistent
-        snapshot.  Sorted by (namespace, name)."""
+        same read-only contract as ``list(copy=False)`` — the
+        :meth:`get_nocopy` aliasing contract.  Sorted by
+        (namespace, name)."""
         import copy as copymod
         with self._lock:
             objs = self._meta_index.lookup(kind, key, value)
@@ -375,10 +376,27 @@ class Informer:
                    namespace: str | None = None) -> dict:
         """Get WITHOUT deepcopying the mirrored object — the same
         single-threaded/read-only contract as ``list(copy=False)`` and
-        :meth:`FakeApiServer.get_nocopy`.  Mirror entries are replaced
-        wholesale (never mutated in place), so the returned dict is a
-        consistent snapshot of the object at its resourceVersion; callers
-        MUST NOT mutate it.  The threaded extender verbs keep using
+        :meth:`FakeApiServer.get_nocopy`.
+
+        The aliasing contract, stated precisely (it is what every
+        ``copy=False`` read here relies on): the returned dict is a
+        consistent snapshot of the object at its resourceVersion because
+        NOBODY mutates an installed incarnation in place — the mirror
+        only ever replaces entries wholesale (``_apply``/``observe``/
+        ``_relist``), and every source feeding it hands over objects
+        that are frozen from the moment they arrive.  Watch events are
+        deepcopied at emit and REST watch objects are freshly decoded,
+        so those entries are mirror-owned; a write-through ``observe``
+        may instead install an object that ALIASES the API server's
+        stored incarnation (the fake server's bind/patch return).  Under
+        the server's structural-sharing write path (``nocopy_writes``)
+        that alias is still a frozen snapshot — the server builds a NEW
+        incarnation per write and never touches a handed-out one — so
+        the guarantee holds by the same no-in-place-mutation discipline
+        on both sides.  Under the legacy deepcopy write path the
+        observe() input is a caller-owned deep copy, so the entry is
+        mirror-owned there too.  Either way: callers MUST NOT mutate
+        the result, and the threaded extender verbs keep using
         :meth:`get`."""
         with self._lock:
             try:

@@ -96,6 +96,7 @@ COUNTERS = (
     "gc_assumptions_released",
     "gc_release_errors",
     "gc_sweeps",
+    "gc_sweeps_skipped",
 )
 
 #: Dynamic counter families: an f-string increment's literal prefix must

@@ -116,12 +116,15 @@ class _JobRun:
         self.started_t = -1.0
 
 
-def stage_nodes(cfg: TraceConfig) -> tuple[FakeApiServer, list[dict], dict]:
+def stage_nodes(cfg: TraceConfig,
+                nocopy_writes: bool = False) -> tuple[FakeApiServer, list[dict], dict]:
     """A fresh API server holding the trace's fleet: ``n_domains`` ICI
     domains of ``hosts_per_domain`` nodes each, annotated exactly like the
     device plugin would (same probe -> reporter pipeline), staged in bulk.
-    Returns (api, node_objects, chips_by_node)."""
-    api = FakeApiServer()
+    Returns (api, node_objects, chips_by_node).  ``nocopy_writes`` turns
+    on the server's structural-sharing write path (the engine is the
+    single-threaded single-writer the contract asks for)."""
+    api = FakeApiServer(nocopy_writes=nocopy_writes)
     probes = [
         _to_host_probe(_probe_python({"TPUTOPO_FAKE": f"{cfg.spec}@{w}"}))
         for w in range(cfg.hosts_per_domain)
@@ -204,6 +207,13 @@ class SimEngine:
     # reflected in the state it plans from.
     _COMPLETE, _REPAIR, _FAIL, _ARRIVAL, _GC, _DEFRAG = 0, 1, 2, 3, 4, 5
 
+    #: Kill switch for the copy-free fakeapi write path (leg 3 of the
+    #: fleet hot-path pass): the engine is the single-threaded sole
+    #: writer, so its server runs with ``nocopy_writes`` — writes build
+    #: the new stored object by structural sharing instead of deepcopy.
+    #: False restores the historical deepcopy write path byte-for-byte.
+    NOCOPY_WRITES = True
+
     def __init__(self, trace: Trace, policy_name: str, *,
                  assume_ttl_s: float = 60.0, gc_period_s: float = 30.0,
                  max_backfill_failures: int = 8,
@@ -215,7 +225,8 @@ class SimEngine:
         self.trace = trace
         self.cfg = trace.config
         self.clock = VirtualClock(0.0)
-        self.api, self._node_objects, self.chips_by_node = stage_nodes(self.cfg)
+        self.api, self._node_objects, self.chips_by_node = stage_nodes(
+            self.cfg, nocopy_writes=self.NOCOPY_WRITES)
         self._node_obj_by_name = {n["metadata"]["name"]: n
                                   for n in self._node_objects}
         self.node_names = sorted(self._node_obj_by_name)

@@ -198,14 +198,18 @@ class IciAwarePolicy(PlacementPolicy):
             # sort() only READS the pod — the nocopy contract holds.
             pod = (handles[m].fetch() if handles is not None
                    else self.api.get("pods", pod_name, "default"))
-            scores = self.sched.sort(pod, node_names)
+            # sort_best: the winner of the sort verb without
+            # materializing (and max-ing over) the O(nodes) score list —
+            # ~70M score dicts per fleet trace before this.  A traced
+            # scheduler delegates to the full sort() inside, so explain
+            # records are exactly the verb's.  None covers both "no
+            # candidate nodes" and "nothing scored positive" — the same
+            # infeasible branch either way.
+            best = self.sched.sort_best(pod, node_names)
             if self._trace_on and m == 0:
                 # Member 0's sort carries the full per-node breakdown the
                 # whole gang's plan was decided from.
                 sort_explain = self.tracer.last_explain
-            # scores is empty when every node is failed (alive == []).
-            best = (max(scores, key=lambda s: (s["Score"], s["Host"]))
-                    if scores else None)
             if best is None or best["Score"] <= 0:
                 # Member infeasible.  For a gang with members already
                 # bound this attempt, bind() on an infeasible plan would
@@ -397,6 +401,16 @@ class BaselinePolicy(PlacementPolicy):
         # mark_used calls during planning, and the engine invalidates on
         # every external mutation.
         self._cached_state: ClusterState | None = None
+        # Hoisted first-fit walk list: (node, domain, node_mask) triples
+        # in node_names order.  The triples are occupancy-INDEPENDENT
+        # (node->domain mapping and per-node masks are immutable after
+        # sync; node churn forces a full rebuild, which changes the state
+        # object), so the list stays valid as long as the same state
+        # object serves the same node list — which the in-place fold
+        # makes the steady state.  Re-deriving them was ~3M dict/property
+        # lookups per fleet trace (the walk's residual cost after the
+        # popcount gate).
+        self._walk_cache: tuple[ClusterState, list[str], list] | None = None
         # Engine events awaiting their fold (delta_fold mode): buffered
         # at invalidate(), applied in one with_events batch at the next
         # place().  Non-empty only while _cached_state is not None.
@@ -436,6 +450,7 @@ class BaselinePolicy(PlacementPolicy):
         self.inc("invalidate_full_drops")
         self.inc(f"invalidate_full_drop_{reason}")
         self._cached_state = None
+        self._walk_cache = None  # keyed on state identity — don't pin it
         self._pending_events.clear()
 
     def _state(self) -> ClusterState:
@@ -446,7 +461,14 @@ class BaselinePolicy(PlacementPolicy):
         if state is not None and self._pending_events:
             events, self._pending_events = self._pending_events, []
             reasons: list[str] = []
-            new = state.with_events(events, reasons)
+            # Single-owner in-place fold: this policy is the ONLY holder
+            # of its cached state (the note_bind docstring's contract),
+            # so the backlog folds by mutation — no per-fold
+            # copy-on-write clone.  ClusterState.FOLD_INPLACE=False
+            # restores the COW fold byte-for-byte; a None still means
+            # "discard and full-sync" under either mode (an in-place
+            # fold may leave the state partially mutated on failure).
+            new = state.fold_inplace(events, reasons)
             if new is None:
                 self._drop_cache(reasons[0] if reasons else "other")
                 state = None
@@ -477,38 +499,73 @@ class BaselinePolicy(PlacementPolicy):
         # per-node sort breakdown — which nodes the count-only rule
         # skipped and why, and where it stopped.
         walk: list[dict] | None = [] if self._trace_on else None
+        cached_walk = self._walk_cache
+        if (cached_walk is not None and cached_walk[0] is state
+                and cached_walk[1] == node_names):
+            groups = cached_walk[2]
+        else:
+            # Domain-grouped walk list: consecutive nodes sharing a
+            # domain collapse into one group, so the fast path below
+            # gates a WHOLE domain on one popcount (a node's free chips
+            # are a subset of its domain's — a domain without k free
+            # chips total cannot host any member) instead of 16 per-node
+            # gates.  Node order within and across groups is exactly
+            # node_names order, so first-fit picks the same node.
+            groups = []
+            for n in node_names:
+                dom = state.domain_of_node(n)
+                nmask = dom.node_masks.get(n, 0) if dom is not None else 0
+                if groups and groups[-1][0] is dom:
+                    groups[-1][1].append((n, nmask))
+                else:
+                    groups.append((dom, [(n, nmask)]))
+            self._walk_cache = (state, list(node_names), groups)
         for member in range(job.replicas):
             placed = None
-            for node in node_names:
-                dom = state.domain_of_node(node)
+            # Per-domain free-mask snapshot for this member's pass: the
+            # mask only moves when THIS plan marks chips (between
+            # members), so one property read per visited domain replaces
+            # one per visited node.
+            trace_walk = walk is not None and member == 0
+            for dom, group_nodes in groups:
                 if dom is None:
-                    if walk is not None and member == 0:
-                        walk.append({"node": node,
-                                     "rejected": "not_a_tpu_node"})
+                    if trace_walk:
+                        walk.extend({"node": node,
+                                     "rejected": "not_a_tpu_node"}
+                                    for node, _ in group_nodes)
                     continue
-                # Popcount gate before materializing anything: the
-                # first-fit walk visits O(nodes) mostly-full nodes per
-                # member, and building a coord frozenset per visit was
-                # the walk's whole cost at fleet scale.  Same nodes pass
-                # (popcount == len of the materialized set), so the
-                # decision stream is bit-identical.
-                free_mask = (dom.node_masks.get(node, 0)
-                             & dom.allocator.free_mask)
-                if free_mask.bit_count() < job.chips:
-                    if walk is not None and member == 0:
+                dom_free = dom.allocator.free_mask
+                if not trace_walk and dom_free.bit_count() < job.chips:
+                    continue  # no node of this domain can pass its gate
+                for node, node_mask in group_nodes:
+                    # Popcount gate before materializing anything: the
+                    # first-fit walk visits O(nodes) mostly-full nodes
+                    # per member, and building a coord frozenset per
+                    # visit was the walk's whole cost at fleet scale.
+                    # Same nodes pass (popcount == len of the
+                    # materialized set), so the decision stream is
+                    # bit-identical.
+                    free_mask = node_mask & dom_free
+                    if free_mask.bit_count() < job.chips:
+                        if trace_walk:
+                            walk.append(
+                                {"node": node,
+                                 "rejected": "insufficient_free_chips"})
+                        continue
+                    free_here = frozenset(
+                        dom.allocator.chips_of_mask(free_mask))
+                    picked = self.picker(dom.topology, free_here, job.chips)
+                    if picked is not None:
+                        placed = (node, tuple(picked), dom)
+                        if trace_walk:
+                            walk.append({"node": node,
+                                         "picked": len(picked)})
+                        break
+                    if trace_walk:
                         walk.append({"node": node,
-                                     "rejected": "insufficient_free_chips"})
-                    continue
-                free_here = frozenset(dom.allocator.chips_of_mask(free_mask))
-                picked = self.picker(dom.topology, free_here, job.chips)
-                if picked is not None:
-                    placed = (node, tuple(picked), dom)
-                    if walk is not None and member == 0:
-                        walk.append({"node": node, "picked": len(picked)})
+                                     "rejected": "picker_found_no_set"})
+                if placed is not None:
                     break
-                if walk is not None and member == 0:
-                    walk.append({"node": node,
-                                 "rejected": "picker_found_no_set"})
             if placed is None:
                 self._counters["infeasible"] += 1
                 for node, picked in plan:
