@@ -588,6 +588,168 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0,
     return out
 
 
+def bench_shards(nodes: int = 256, arrivals: int = 2000, seed: int = 0,
+                 counts: tuple = (1, 2, 4, 8),
+                 http_pods: int = 600) -> dict:
+    """Replicated-control-plane scenario (tputopo.extender.replicas) —
+    the ``shards`` block: how the control plane behaves when 1/2/4/8
+    extender replicas race on one API server.
+
+    Two legs.  The **sim leg** replays the 256/2000 fleet trace with the
+    ici policy sharded across N replicas (seeded wake interleaving,
+    delayed peer-bind delivery): sustained sorts/s, the bind-conflict
+    taxonomy, queue-wait p95, and the decision-quality axes vs the
+    single-replica stream (``baseline_ref``) — the acceptance check that
+    sharding costs <2 quality points.  The **http leg** is the real
+    thing: N ``python -m tputopo.extender`` server PROCESSES against one
+    REST-mocked API server, hammered by a concurrent sort/bind load
+    generator — aggregate sorts/s here scales with replica count because
+    each replica burns its own CPU (no shared GIL), and the conflict
+    rate is what racing kube-scheduler shards would see."""
+    from tputopo.sim.engine import run_trace, stage_nodes
+    from tputopo.sim.trace import TraceConfig
+
+    fleet_cfg = TraceConfig(seed=seed, nodes=nodes, arrivals=arrivals,
+                            offered_load=0.73)
+    sim_leg: dict = {}
+    baseline_axes = None
+    for n in counts:
+        rep = run_trace(fleet_cfg, ["ici"], flight_trace=False,
+                        replicas={"count": n} if n > 1 else None)
+        p = rep["policies"]["ici"]
+        sched = p["scheduler"]
+        wall = rep["throughput"]["wall_s"]
+        axes = {
+            "utilization": p["chip_utilization"]["time_weighted_mean"],
+            "fragmentation": p["fragmentation"]["time_weighted_mean"],
+            "bw_vs_ideal": p["ici_bw_score"]["mean_vs_ideal"],
+        }
+        rec: dict = {
+            "events_per_s": rep["throughput"]["events_per_s"],
+            "wall_s": wall,
+            "sorts": sched.get("sort_requests", 0),
+            "sorts_per_s": round(sched.get("sort_requests", 0) / wall, 1)
+            if wall > 0 else 0.0,
+            "binds": sched.get("bind_success", 0),
+            "queue_wait_p95_s": p["queue_wait_s"]["p95"],
+            "scheduled": p["jobs"]["scheduled"],
+            **axes,
+        }
+        rb = p.get("replicas")
+        if rb is not None:
+            rec["conflicts_by_cause"] = rb["conflicts_by_cause"]
+            rec["bind_conflicts"] = rb["bind_conflicts"]
+            binds = sched.get("bind_requests", 0)
+            rec["bind_conflict_rate"] = round(
+                rb["bind_conflicts"] / binds, 4) if binds else 0.0
+        if baseline_axes is None:
+            baseline_axes = axes
+        else:
+            # Absolute percentage-point deltas vs the single-replica
+            # stream — the <2-point decision-quality acceptance check.
+            rec["quality_delta_points_vs_single"] = {
+                k: round(abs(axes[k] - baseline_axes[k]) * 100, 3)
+                for k in axes
+            }
+        sim_leg[f"replicas_{n}"] = rec
+    out: dict = {
+        "trace": {"nodes": nodes, "arrivals": arrivals,
+                  "offered_load": 0.73},
+        "sim": sim_leg,
+        "baseline_ref": {"replicas": 1, **sim_leg["replicas_1"]},
+    }
+
+    # ---- http leg: real replica processes under generated load ------------
+    import os
+    import socket
+    import subprocess
+    import tempfile
+
+    try:
+        from tests.k8s_mock import MockKubeApi
+    except ImportError as e:
+        out["http"] = {"error": f"tests.k8s_mock unavailable: {e}"}
+        return out
+    from tputopo.extender.replicas import LoadGenerator
+    from tputopo.k8s import objects as ko
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def healthz_ok(port: int, deadline_s: float = 30.0) -> bool:
+        import urllib.request
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=2.0):
+                    return True
+            except OSError:
+                time.sleep(0.2)
+        return False
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    http_leg: dict = {}
+    for n in counts:
+        # Stage the fleet into a FRESH server per count (bound pods from
+        # the previous round must not leak across measurements).
+        api, node_objs, _chips = stage_nodes(
+            TraceConfig(seed=seed, nodes=nodes, arrivals=1))
+        node_names = sorted(nd["metadata"]["name"] for nd in node_objs)
+        pods = [ko.make_pod(f"load-{i:05d}", chips=1)
+                for i in range(http_pods)]
+        api.create_many("pods", pods)
+        procs = []
+        cfg_paths = []
+        try:
+            with MockKubeApi(api) as mock:
+                ports = [free_port() for _ in range(n)]
+                for i, port in enumerate(ports):
+                    fd, path = tempfile.mkstemp(suffix=".json",
+                                                prefix=f"shard{i}-")
+                    with os.fdopen(fd, "w") as f:
+                        json.dump({"shared_writers": True,
+                                   "replica_id": f"r{i}",
+                                   "trace_enabled": False,
+                                   "port": port}, f)
+                    cfg_paths.append(path)
+                    procs.append(subprocess.Popen(
+                        [sys.executable, "-m", "tputopo.extender",
+                         "--config", path, "--api-url", mock.base_url,
+                         "--host", "127.0.0.1"],
+                        cwd=repo_root, stdout=subprocess.DEVNULL,
+                        stderr=subprocess.DEVNULL))
+                if not all(healthz_ok(p) for p in ports):
+                    http_leg[f"replicas_{n}"] = {
+                        "error": "replica process failed to serve /healthz"}
+                    continue
+                gen = LoadGenerator(
+                    [f"http://127.0.0.1:{p}" for p in ports],
+                    node_names, concurrency=16)
+                http_leg[f"replicas_{n}"] = gen.run(pods)
+        except OSError as e:
+            http_leg[f"replicas_{n}"] = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            for proc in procs:
+                proc.terminate()
+            for proc in procs:
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            for path in cfg_paths:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+    out["http"] = http_leg
+    return out
+
+
 def bench_ab_gain() -> float:
     """Mean predicted-bandwidth advantage of topology-aware placement over
     count-only first-fit across randomized churn traces (the Gaia Exp.6
@@ -1644,6 +1806,12 @@ def main() -> None:
     extras["bandwidth_gain_vs_count_only"] = isolated(
         "ab_gain", bench_ab_gain, strict=True)
     extras["sim"] = isolated("sim", bench_sim, strict=True)
+    # Replicated control plane: the sim replica sweep (quality vs the
+    # single-replica stream) + the real-process HTTP load leg.  Not
+    # strict: the http leg spawns server subprocesses, and a sandboxed
+    # host failing to spawn them is an environment fact, not a
+    # correctness violation (per-count errors land in the block).
+    extras["shards"] = isolated("shards", bench_shards)
 
     try:
         preflight_cap = float(os.environ.get("BENCH_TPU_PREFLIGHT_S", "120"))

@@ -1,0 +1,485 @@
+"""Replicated control plane (tputopo.extender.replicas): racing extender
+shards, CAS-reconciled binds with classified conflicts, claim
+arbitration, recover() adopting peer binds, deterministic replicated sim
+runs, and the server-mode load rig."""
+
+import json
+
+import pytest
+
+from tests.cluster import build_cluster
+from tputopo.extender import ExtenderConfig, ExtenderScheduler
+from tputopo.extender.replicas import (DEFAULT_REPLICAS, LoadGenerator,
+                                       ReplicaSet, WakeSchedule,
+                                       start_replica_servers)
+from tputopo.extender.scheduler import BindError
+from tputopo.extender.state import ClusterState
+from tputopo.k8s import make_pod
+from tputopo.k8s import objects as ko
+from tputopo.obs import Tracer
+from tputopo.sim.engine import run_trace, stage_nodes
+from tputopo.sim.report import SCHEMA, SCHEMA_REPLICAS
+from tputopo.sim.trace import TraceConfig
+
+GANG = "tpu.dev/gang-id"
+SIZE = "tpu.dev/gang-size"
+
+
+class SetClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, dt: float) -> None:
+        self.t += max(0.0, dt)
+
+
+def _replica_sched(api, clock, rid: str, tracer=None) -> ExtenderScheduler:
+    """A sim-shaped replica shard: informer-less bind_from_cache with
+    shared_writers (claim arbitration on, single-owner folds off)."""
+    return ExtenderScheduler(
+        api, ExtenderConfig(state_cache_s=1e12, bind_from_cache=True,
+                            shared_writers=True, replica_id=rid),
+        clock=clock, tracer=tracer)
+
+
+def _canon(report: dict) -> str:
+    r = dict(report)
+    r.pop("throughput", None)
+    r.pop("phase_wall", None)
+    return json.dumps(r, sort_keys=True)
+
+
+# ---- WakeSchedule / ReplicaSet construction ---------------------------------
+
+
+def test_wake_schedule_rr_and_weighted_are_deterministic():
+    rr = WakeSchedule(3, seed=0, mode="rr")
+    assert [rr.next() for _ in range(7)] == [0, 1, 2, 0, 1, 2, 0]
+    a = WakeSchedule(4, seed=7, mode="weighted")
+    b = WakeSchedule(4, seed=7, mode="weighted")
+    seq = [a.next() for _ in range(64)]
+    assert seq == [b.next() for _ in range(64)]
+    assert set(seq) == {0, 1, 2, 3}  # every replica gets wakes
+    c = WakeSchedule(4, seed=8, mode="weighted")
+    assert seq != [c.next() for _ in range(64)]
+    # Skewed weights skew the draw toward the heavy replica.
+    w = WakeSchedule(2, seed=0, mode="weighted", weights=[9.0, 1.0])
+    draws = [w.next() for _ in range(200)]
+    assert draws.count(0) > 150
+    with pytest.raises(ValueError):
+        WakeSchedule(2, mode="nope")
+    with pytest.raises(ValueError):
+        WakeSchedule(2, mode="weighted", weights=[1.0])
+
+
+def test_replica_set_asserts_ownership_at_construction():
+    """The single-owner refusal: a shard still in in-place-fold mode (or
+    without shared_writers at all) is rejected outright — racing writers
+    plus in-place folds silently corrupt state."""
+    api, _ = build_cluster()
+    clock = SetClock()
+    unshared = ExtenderScheduler(
+        api, ExtenderConfig(state_cache_s=1e12, bind_from_cache=True),
+        clock=clock)
+    assert unshared._single_owner  # the sim engine's sole-writer mode
+    with pytest.raises(ValueError, match="shared_writers"):
+        ReplicaSet([unshared], clock=clock)
+    ok = _replica_sched(api, clock, "r0")
+    assert not ok._single_owner  # shared_writers downgrades to COW
+    ReplicaSet([ok], clock=clock)  # constructs fine
+
+
+def test_shared_writers_bind_publishes_cow_not_inplace():
+    """satellite: bind_from_cache's in-place fold must downgrade to
+    copy-on-write under shared_writers — the old cached state object
+    stays untouched after a bind."""
+    api, _ = build_cluster()
+    clock = SetClock(10.0)
+    sched = _replica_sched(api, clock, "r0")
+    api.create("pods", make_pod("p1", chips=2))
+    pod = api.get("pods", "p1", "default")
+    nodes = ["node-0", "node-1", "node-2", "node-3"]
+    sched.sort(pod, nodes)  # warm the cache
+    state0 = sched._cached_state
+    free0 = {sid: dom.allocator.free_mask
+             for sid, dom in state0.domains.items()}
+    sched.bind("p1", "default", "node-0")
+    assert sched._cached_state is not state0  # replaced, not mutated
+    assert {sid: dom.allocator.free_mask
+            for sid, dom in state0.domains.items()} == free0
+    assert sched.metrics.counters["bind_state_delta"] == 1
+    # The bound-by stamp rides every committed bind of an identified
+    # replica.
+    assert api.get("pods", "p1", "default")["metadata"]["annotations"][
+        ko.ANN_BOUND_BY] == "r0"
+
+
+# ---- crafted races ----------------------------------------------------------
+
+
+def test_two_replica_race_exactly_one_wins_loser_classified():
+    """Two shards plan the same chips from equally fresh views, then race
+    the bind: exactly one claim survives, the loser retreats with a
+    classified Conflict, and its explain records the cause."""
+    api, _ = build_cluster()
+    clock = SetClock(100.0)
+    a = _replica_sched(api, clock, "r0")
+    tracer = Tracer(capacity=8, clock=clock)
+    b = _replica_sched(api, clock, "r1", tracer=tracer)
+    api.create("pods", make_pod("pa", chips=4))
+    api.create("pods", make_pod("pb", chips=4))
+    nodes = ["node-0", "node-1", "node-2", "node-3"]
+    best_a = max(a.sort(api.get("pods", "pa", "default"), nodes),
+                 key=lambda s: (s["Score"], s["Host"]))
+    best_b = max(b.sort(api.get("pods", "pb", "default"), nodes),
+                 key=lambda s: (s["Score"], s["Host"]))
+    assert best_a["Host"] == best_b["Host"]  # same empty-fleet view
+    a.bind("pa", "default", best_a["Host"])
+    # B's cached view predates A's bind — same-instant race (the clock
+    # never moved): the loser classifies it lost_race.
+    with pytest.raises(BindError) as ei:
+        b.bind("pb", "default", best_b["Host"])
+    assert ei.value.reason == "conflict"
+    assert ei.value.cause == "lost_race"
+    assert b.metrics.counters["replica_bind_lost_race"] == 1
+    assert b.metrics.counters["bind_conflicts"] == 1
+    ex = tracer.last_explain
+    assert ex["conflict"]["cause"] == "lost_race"
+    assert ex["conflict"]["winner"] == "default/pa"
+    # Exactly one claim survives: the winner's annotations are intact,
+    # the loser's were wiped in the retreat, and API truth carries no
+    # overlapping claims.
+    pa = api.get("pods", "pa", "default")["metadata"]["annotations"]
+    pb = api.get("pods", "pb", "default")["metadata"]["annotations"]
+    assert pa.get(ko.ANN_GROUP) and pa.get(ko.ANN_BOUND_BY) == "r0"
+    assert ko.ANN_GROUP not in pb
+    assert ClusterState(api, clock=clock).sync().conflicts == []
+
+
+def test_stale_cache_race_between_gangs_classified_stale():
+    """The crafted gang race: replica A places gang ``g`` whole; replica
+    B — whose cached plan predates A's binds — planned gang ``h`` onto
+    the same host box and races its first member in.  B must lose with
+    cause ``stale_cache`` (the winning claim is older than B's attempt),
+    the gang stays un-double-booked, and B's NEXT attempt — from the
+    dropped-then-resynced view — places ``h`` cleanly on the free box."""
+    api, _ = build_cluster()
+    clock = SetClock(50.0)
+    a = _replica_sched(api, clock, "r0")
+    tracer = Tracer(capacity=8, clock=clock)
+    b = _replica_sched(api, clock, "r1", tracer=tracer)
+    for gang in ("g", "h"):
+        labels = {GANG: gang, SIZE: "2"}
+        for m in range(2):
+            api.create("pods", make_pod(f"{gang}-{m}", chips=4,
+                                        labels=labels))
+    nodes = ["node-0", "node-1", "node-2", "node-3"]
+    # Both replicas plan their gang against the same EMPTY fleet: the
+    # contiguous-host-box preference sends both to the same box.
+    sa = a.sort(api.get("pods", "g-0", "default"), nodes)
+    ga = max(sa, key=lambda s: (s["Score"], s["Host"]))["Host"]
+    sb = b.sort(api.get("pods", "h-0", "default"), nodes)
+    hb = max(sb, key=lambda s: (s["Score"], s["Host"]))["Host"]
+    assert ga == hb  # identical views -> identical first-member winner
+    a.bind("g-0", "default", ga)
+    a.bind("g-1", "default",
+           max(a.sort(api.get("pods", "g-1", "default"), nodes),
+               key=lambda s: (s["Score"], s["Host"]))["Host"])
+    clock.t = 51.0  # B's attempt happens AFTER A's claims landed
+    with pytest.raises(BindError) as ei:
+        b.bind("h-0", "default", hb)
+    assert ei.value.reason == "conflict"
+    assert ei.value.cause == "stale_cache"
+    assert b.metrics.counters["replica_stale_cache_aborts"] == 1
+    ex = tracer.last_explain
+    assert ex["conflict"]["cause"] == "stale_cache"
+    assert ex["conflict"]["winner"].startswith("default/g-")
+    # Exactly one gang holds the contested chips; nothing overlaps.
+    h0 = api.get("pods", "h-0", "default")["metadata"]["annotations"]
+    assert ko.ANN_GROUP not in h0
+    assert ClusterState(api, clock=clock).sync().conflicts == []
+    # The loser's pod sits bound-but-unclaimed (burned) until the job
+    # controller recreates it — model that, then the retry re-syncs from
+    # the dropped view and places gang h whole on the remaining nodes.
+    api.delete("pods", "h-0", "default")
+    api.create("pods", make_pod("h-0", chips=4,
+                                labels={GANG: "h", SIZE: "2"}))
+    b.invalidate_cached_state()
+    for m in range(2):
+        d = b.bind(f"h-{m}", "default",
+                   max(b.sort(api.get("pods", f"h-{m}", "default"), nodes),
+                       key=lambda s: (s["Score"], s["Host"]))["Host"])
+        assert d["gang"] == "h"
+    state = ClusterState(api, clock=clock).sync()
+    assert state.conflicts == []
+    assert sum(len(dm.assignments) for dm in state.domains.values()) == 4
+
+
+def test_injected_cas_conflict_classifies_ambiguous_not_lost_race():
+    """Review regression: a conflicting write that applied NOTHING (the
+    chaos layer's injected CAS 409 — shared_writers always arms it by
+    passing expect_version) leaves no surviving claim; calling that
+    'lost_race' would pollute the taxonomy with phantom peers."""
+    from tputopo.chaos import ChaosApi, FaultPlan
+
+    api, _ = build_cluster()
+    clock = SetClock(5.0)
+    chaos = ChaosApi(api, FaultPlan(
+        0, "api-flake", conflict_prob=1.0, unavailable_prob=0.0,
+        timeout_prob=0.0, ambiguous_timeout_prob=0.0, crash_prob=0.0,
+        node_flaps=0))
+    sched = ExtenderScheduler(
+        chaos, ExtenderConfig(state_cache_s=1e12, bind_from_cache=True,
+                              shared_writers=True, replica_id="r0"),
+        clock=clock)
+    api.create("pods", make_pod("p1", chips=2))
+    with pytest.raises(BindError) as ei:
+        sched.bind("p1", "default", "node-0")
+    assert ei.value.reason == "conflict"
+    assert ei.value.cause == "ambiguous_timeout"
+    assert sched.metrics.counters["replica_conflict_ambiguous"] == 1
+    assert "replica_bind_lost_race" not in sched.metrics.counters
+    # Nothing applied: the pod is untouched and a later attempt (the
+    # injected streak capped) binds cleanly.
+    p1 = api.get("pods", "p1", "default")
+    assert not p1["spec"].get("nodeName")
+
+
+def test_gc_release_wipes_bound_by_stamp():
+    """Review regression: the TTL GC's release is the backstop for a
+    failed retreat wipe — it must clear tpu.dev/bound-by with the claim,
+    or a released pod reads as still-owned by a replica."""
+    from tputopo.extender.gc import AssumptionGC
+
+    api, _ = build_cluster()
+    clock = SetClock(0.0)
+    sched = _replica_sched(api, clock, "r0")
+    api.create("pods", make_pod("ghost", chips=2))
+    nodes = ["node-0", "node-1", "node-2", "node-3"]
+    node = max(sched.sort(api.get("pods", "ghost", "default"), nodes),
+               key=lambda s: (s["Score"], s["Host"]))["Host"]
+    sched.bind("ghost", "default", node)
+    anns = api.get("pods", "ghost", "default")["metadata"]["annotations"]
+    assert anns[ko.ANN_BOUND_BY] == "r0"
+    clock.t = 1000.0  # past the TTL, never confirmed
+    gc = AssumptionGC(api, assume_ttl_s=60.0, clock=clock)
+    assert gc.sweep() == ["default/ghost"]
+    anns = api.get("pods", "ghost", "default")["metadata"]["annotations"]
+    assert ko.ANN_GROUP not in anns
+    assert ko.ANN_BOUND_BY not in anns
+
+
+def test_claim_check_ignores_expired_assumptions():
+    """An expired unconfirmed claim is NOT occupancy (sync's TTL rule):
+    the claim check must not retreat before a corpse the GC will wipe —
+    otherwise replicas stall on placements a single scheduler makes."""
+    api, _ = build_cluster()
+    clock = SetClock(0.0)
+    a = _replica_sched(api, clock, "r0")
+    api.create("pods", make_pod("ghost", chips=4))
+    nodes = ["node-0", "node-1", "node-2", "node-3"]
+    node = max(a.sort(api.get("pods", "ghost", "default"), nodes),
+               key=lambda s: (s["Score"], s["Host"]))["Host"]
+    a.bind("ghost", "default", node)  # assumed at t=0, never confirmed
+    clock.t = 1000.0  # far past the 60 s TTL
+    b = _replica_sched(api, clock, "r1")
+    api.create("pods", make_pod("fresh", chips=4))
+    d = b.bind("fresh", "default", node)  # same node, same chips
+    assert d["node"] == node
+    assert "bind_conflicts" not in b.metrics.counters
+
+
+# ---- recover() across replicas ----------------------------------------------
+
+
+def test_recover_adopts_gang_bound_by_peer():
+    """A replica's recover() completing an in-flight gang whose bound
+    members a DIFFERENT replica committed counts the adoption — the
+    all-or-nothing rule is cluster-wide, not per-replica."""
+    api, _ = build_cluster()
+    clock = SetClock(10.0)
+    a = _replica_sched(api, clock, "r0")
+    labels = {GANG: "g", SIZE: "2"}
+    api.create("pods", make_pod("g-0", chips=4, labels=labels))
+    api.create("pods", make_pod("g-1", chips=4, labels=labels))
+    nodes = ["node-0", "node-1", "node-2", "node-3"]
+    node0 = max(a.sort(api.get("pods", "g-0", "default"), nodes),
+                key=lambda s: (s["Score"], s["Host"]))["Host"]
+    a.bind("g-0", "default", node0)
+    # Replica r1 restarts (crash) and reconciles the half-bound gang.
+    b = _replica_sched(api, clock, "r1")
+    outcome = b.recover()
+    assert outcome["completed"] == ["default/g"]
+    assert b.metrics.counters["recover_foreign_bind_adopted"] == 1
+    anns0 = api.get("pods", "g-0", "default")["metadata"]["annotations"]
+    anns1 = api.get("pods", "g-1", "default")["metadata"]["annotations"]
+    assert anns0[ko.ANN_BOUND_BY] == "r0"  # the peer's bind, adopted as-is
+    assert anns1[ko.ANN_BOUND_BY] == "r1"  # completed by the recoverer
+    for m in range(2):
+        assert api.get("pods", f"g-{m}",
+                       "default")["spec"].get("nodeName")
+
+
+def test_recover_own_gang_counts_no_adoption():
+    api, _ = build_cluster()
+    clock = SetClock(10.0)
+    a = _replica_sched(api, clock, "r0")
+    labels = {GANG: "g", SIZE: "2"}
+    api.create("pods", make_pod("g-0", chips=4, labels=labels))
+    api.create("pods", make_pod("g-1", chips=4, labels=labels))
+    nodes = ["node-0", "node-1", "node-2", "node-3"]
+    node0 = max(a.sort(api.get("pods", "g-0", "default"), nodes),
+                key=lambda s: (s["Score"], s["Host"]))["Host"]
+    a.bind("g-0", "default", node0)
+    # The SAME replica identity restarts: its own binds are not foreign.
+    a2 = _replica_sched(api, clock, "r0")
+    outcome = a2.recover()
+    assert outcome["completed"] == ["default/g"]
+    assert "recover_foreign_bind_adopted" not in a2.metrics.counters
+
+
+def test_release_wipes_bound_by_stamp():
+    """A released gang member must not read as still-owned: the wipe
+    clears ANN_BOUND_BY with the claim."""
+    api, _ = build_cluster()
+    clock = SetClock(10.0)
+    a = _replica_sched(api, clock, "r0")
+    labels = {GANG: "g", SIZE: "2"}
+    api.create("pods", make_pod("g-0", chips=4, labels=labels))
+    api.create("pods", make_pod("g-1", chips=4, labels=labels))
+    nodes = ["node-0", "node-1", "node-2", "node-3"]
+    node0 = max(a.sort(api.get("pods", "g-0", "default"), nodes),
+                key=lambda s: (s["Score"], s["Host"]))["Host"]
+    a.bind("g-0", "default", node0)
+    # Capacity for the rest vanishes -> recover() must release.
+    for n in nodes:
+        if n != node0:
+            api.delete("nodes", n)
+    b = _replica_sched(api, clock, "r1")
+    outcome = b.recover()
+    assert outcome["released"] == ["default/g"]
+    anns0 = api.get("pods", "g-0", "default")["metadata"]["annotations"]
+    assert ko.ANN_GROUP not in anns0
+    assert ko.ANN_BOUND_BY not in anns0
+
+
+# ---- replicated sim runs ----------------------------------------------------
+
+
+def _cfg(**kw):
+    kw.setdefault("seed", 0)
+    kw.setdefault("nodes", 16)
+    kw.setdefault("arrivals", 60)
+    return TraceConfig(**kw)
+
+
+@pytest.mark.parametrize("count", [2, 4])
+def test_replicated_sim_runs_byte_identical(count):
+    cfg = _cfg()
+    ra = run_trace(cfg, ["ici", "naive"], replicas={"count": count})
+    rb = run_trace(cfg, ["ici", "naive"], replicas={"count": count})
+    rj = run_trace(cfg, ["ici", "naive"], replicas={"count": count},
+                   jobs=2)
+    assert _canon(ra) == _canon(rb) == _canon(rj)
+    assert ra["schema"] == SCHEMA_REPLICAS
+    assert ra["engine"]["replicas"]["count"] == count
+    blk = ra["policies"]["ici"]["replicas"]
+    assert blk["count"] == count
+    assert len(blk["wakes"]) == count and sum(blk["wakes"]) > 0
+    assert set(blk["conflicts_by_cause"]) == {"lost_race", "stale_cache",
+                                              "ambiguous_timeout"}
+    assert blk["bind_conflicts"] == sum(blk["conflicts_by_cause"].values())
+    # Baselines stay unreplicated comparators.
+    assert "replicas" not in ra["policies"]["naive"]
+    # The race taxonomy reaches the scheduler counter block too (the
+    # keep-list registration) whenever conflicts occurred.
+    if blk["bind_conflicts"]:
+        sched = ra["policies"]["ici"]["scheduler"]
+        assert (sched.get("replica_bind_lost_race", 0)
+                + sched.get("replica_stale_cache_aborts", 0)
+                + sched.get("replica_conflict_ambiguous", 0)
+                ) == blk["bind_conflicts"]
+    # Sharding must not lose jobs even fault-free: every arrival is
+    # terminal or still queued.
+    jobs = ra["policies"]["ici"]["jobs"]
+    assert jobs["arrived"] == (jobs["completed"] + jobs["ghost_reclaimed"]
+                               + jobs["unplaced_at_end"])
+
+
+def test_replicas_one_and_absent_are_byte_identical():
+    cfg = _cfg()
+    off = run_trace(cfg, ["ici"])
+    one = run_trace(cfg, ["ici"], replicas={"count": 1})
+    assert _canon(off) == _canon(one)
+    assert off["schema"] == SCHEMA
+    assert "replicas" not in off["policies"]["ici"]
+    assert "replicas" not in off["engine"]
+
+
+def test_chaos_replica_crashes_hold_invariants_and_determinism():
+    """The acceptance gate: replicas crash-restarting mid-gang-bind under
+    an API-fault profile end with ZERO invariant violations and zero lost
+    jobs, byte-deterministically."""
+    cfg = _cfg(arrivals=40)
+    for profile in ("api-flake", "replica-storm"):
+        ra = run_trace(cfg, ["ici"], chaos=profile,
+                       replicas={"count": 4})
+        rb = run_trace(cfg, ["ici"], chaos=profile,
+                       replicas={"count": 4}, jobs=1)
+        assert _canon(ra) == _canon(rb)
+        rec = ra["policies"]["ici"]
+        c = rec["chaos"]
+        assert c["invariants"]["ok"], (profile,
+                                       c["invariants"]["violations"])
+        jobs = rec["jobs"]
+        assert jobs["arrived"] == (jobs["completed"]
+                                   + jobs["ghost_reclaimed"]
+                                   + jobs["unplaced_at_end"]), profile
+    # The storm profile actually exercises per-replica crash-restarts.
+    storm = run_trace(cfg, ["ici"], chaos="replica-storm",
+                      replicas={"count": 4})
+    blk = storm["policies"]["ici"]["replicas"]
+    assert sum(blk["crash_restarts"]) >= 1
+
+
+# ---- server mode ------------------------------------------------------------
+
+
+def test_server_mode_replicas_race_without_double_booking():
+    """Real concurrent HTTP replicas + the load generator: every pod ends
+    bound-with-claim, burned (claim-race loser), or errored — and API
+    truth carries zero overlapping claims whatever the interleaving."""
+    api, node_objs, _ = stage_nodes(TraceConfig(seed=0, nodes=16,
+                                                arrivals=1))
+    node_names = sorted(n["metadata"]["name"] for n in node_objs)
+    pods = [make_pod(f"load-{i:03d}", chips=1) for i in range(24)]
+    api.create_many("pods", pods)
+    with start_replica_servers(api, 2) as servers:
+        assert len(servers.urls) == 2
+        for s in servers.schedulers:
+            assert s.config.shared_writers and not s._single_owner
+        gen = LoadGenerator(servers.urls, node_names, concurrency=4)
+        res = gen.run(pods, sort_rounds=1)
+    assert res["sort_storm"]["requests"] == 24
+    assert res["transport_errors"] == 0
+    assert res["binds_ok"] > 0
+    accounted = (res["binds_ok"] + res["pods_burned"]
+                 + res["bind_errors"] + res["infeasible"])
+    assert accounted == len(pods), res
+    state = ClusterState(api).sync()
+    assert state.conflicts == []
+    claimed = sum(len(d.assignments) for d in state.domains.values())
+    assert claimed == res["binds_ok"]
+    # Every surviving claim carries its binder's identity.
+    for pod in api.list("pods"):
+        anns = pod["metadata"].get("annotations", {})
+        if anns.get(ko.ANN_GROUP):
+            assert anns.get(ko.ANN_BOUND_BY) in ("r0", "r1")
+
+
+def test_default_replica_knobs_shape():
+    assert set(DEFAULT_REPLICAS) == {"count", "watch_delay_s", "schedule"}
+    assert DEFAULT_REPLICAS["count"] == 1

@@ -67,6 +67,19 @@ PROFILES: dict[str, dict] = {
         "watch_drop_prob": 0.2,
         "watch_reorder_prob": 0.2,
     },
+    # Replicated-control-plane focus (tputopo.extender.replicas): the
+    # extender crash-restarts mid-gang-bind OFTEN — with racing replicas,
+    # each restart's recover() reconciles against binds a peer completed
+    # or wiped meanwhile — over a light API flake so CAS-reconciled binds
+    # and claim arbitration stay hot at the same time.
+    "replica-storm": {
+        "crash_prob": 0.25,
+        "conflict_prob": 0.03,
+        "unavailable_prob": 0.01,
+        "timeout_prob": 0.01,
+        "ambiguous_timeout_prob": 0.01,
+        "node_flaps": 1,
+    },
 }
 
 DEFAULT_KNOBS: dict = {

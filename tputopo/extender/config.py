@@ -40,6 +40,25 @@ class ExtenderConfig:
     # sim's virtual-time engine, single-binary dev rigs); the deployed
     # shape keeps an informer and leaves this off.
     bind_from_cache: bool = False
+    # Replicated control plane (tputopo.extender.replicas): another
+    # scheduler replica may commit assignments against the same API server
+    # concurrently.  Three things change: (1) the bind verb's annotation
+    # patch becomes CAS-guarded (expect_version from the verb's own read),
+    # so a racing writer Conflicts cleanly instead of silently overwriting
+    # a peer's claim; (2) after the bind commits, the verb validates its
+    # chip claim against authoritative occupancy and RETREATS (wipes its
+    # own annotations, classified Conflict) when an earlier claim overlaps
+    # — the per-pod CAS cannot see cross-pod chip overlap, so this check
+    # is what keeps racing replicas from double-booking silicon; (3) the
+    # single-owner in-place state folds are disabled (_single_owner is
+    # False) — a cached state whose world has racing writers may only be
+    # maintained copy-on-write or dropped.
+    shared_writers: bool = False
+    # This replica's identity (e.g. "r0"), stamped into ANN_BOUND_BY on
+    # every bind it commits so recover() can tell its own in-flight binds
+    # from a peer's (the recover_foreign_bind_adopted counter).  Empty =
+    # no stamp — the single-scheduler annotation vocabulary is unchanged.
+    replica_id: str = ""
     # Incremental derived-state maintenance: fold watch/mutation events
     # into the cached ClusterState copy-on-write (O(event)) instead of
     # dropping it and re-syncing O(nodes+pods) on the next verb.  Falls

@@ -133,10 +133,18 @@ class AssumptionGC:
             node_slice[node["metadata"]["name"]] = sid
             slice_rank.setdefault(sid, len(slice_rank))
         cands = []
+        # Pods whose release wipe must also clear the replica identity
+        # stamp (tpu.dev/bound-by, replicated control plane): a released
+        # claim must not read as still-owned by a replica.  Presence-
+        # gated so single-scheduler patch streams stay byte-identical.
+        stamped: set[tuple[str, str]] = set()
         for pod in self._list_candidates():
             pa = _pod_assignment_of(pod)
             if pa is not None and pa.node_name in node_slice:
                 cands.append(pa)
+                if ko.ANN_BOUND_BY in (
+                        pod["metadata"].get("annotations") or {}):
+                    stamped.add((pa.namespace, pa.pod_name))
         cands.sort(key=lambda pa: (pa.assume_time, pa.namespace,
                                    pa.pod_name))
         victims: dict[tuple[str, str], object] = {}
@@ -173,13 +181,12 @@ class AssumptionGC:
         del self.stranded_gangs[:-100]
         released = []
         for (ns, name), pa in victims.items():
+            wipe: dict = {ko.ANN_GROUP: None, ko.ANN_ASSUME_TIME: None,
+                          ko.ANN_ASSIGNED: None, ko.ANN_PREDICTED_GBPS: None}
+            if (ns, name) in stamped:
+                wipe[ko.ANN_BOUND_BY] = None
             try:
-                self.api.patch_annotations(
-                    "pods", name,
-                    {ko.ANN_GROUP: None, ko.ANN_ASSUME_TIME: None,
-                     ko.ANN_ASSIGNED: None, ko.ANN_PREDICTED_GBPS: None},
-                    namespace=ns,
-                )
+                self.api.patch_annotations("pods", name, wipe, namespace=ns)
                 released.append(f"{ns}/{name}")
             except NotFound:
                 continue  # pod deleted meanwhile — already released

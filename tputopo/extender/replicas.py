@@ -1,0 +1,584 @@
+"""Replicated control plane: N racing extender shards over ONE API server.
+
+Production Kubernetes runs replicated schedulers that race on the API and
+reconcile through optimistic concurrency; everything in this repo used to
+funnel through a single :class:`ExtenderScheduler` and one ``_bind_lock``,
+so the ASSUME/ASSIGNED handshake's one real race (design.md:223-234) was
+never exercised by genuinely concurrent writers.  This module provides
+both deployment shapes:
+
+- **Sim mode** (:class:`ReplicaSet` + :class:`WakeSchedule`): N
+  independent scheduler instances, each with its own cached derived
+  state, interleaved deterministically on the virtual clock.  Peer binds
+  propagate to a replica's cache only after ``watch_delay_s`` virtual
+  seconds (the watch-latency model) — the stale window that produces
+  organic bind races.  Correctness never rests on cache freshness: every
+  replica runs ``shared_writers`` mode, where the bind verb CAS-guards
+  its claim patch and arbitrates its chip claim against authoritative
+  occupancy after commit (see ``ExtenderScheduler._claim_check``), so
+  exactly one racer keeps any contested chip and every Conflict is
+  classified (``lost_race`` / ``stale_cache`` / ``ambiguous_timeout``).
+
+- **Server mode** (:func:`start_replica_servers` + :class:`LoadGenerator`):
+  real concurrent HTTP replicas — each with its own informer mirror —
+  plus a closed-loop sort/bind load generator, the bench.py ``shards``
+  measurement rig.
+
+Ownership is asserted at construction: a replica scheduler must run with
+``shared_writers=True`` and must NOT be in single-owner in-place-fold
+mode — an in-place fold whose world has racing writers silently corrupts
+state (the ``_single_owner`` property enforces the downgrade; the
+ReplicaSet refuses miswired schedulers outright).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import urllib.request
+
+from tputopo.extender.scheduler import ExtenderScheduler, quantile
+from tputopo.k8s.fakeapi import NotFound
+from tputopo.k8s.retry import ApiTimeout, ApiUnavailable
+
+#: Default knobs for a replicated run (the sim's ``--replicas`` path
+#: merges user knobs over these).  ``watch_delay_s`` is the modeled watch
+#: latency: a peer's bind reaches this replica's cache only after that
+#: many virtual seconds — 0 makes replicas perfectly coherent (races only
+#: between same-instant wakes), larger widens the stale window.
+DEFAULT_REPLICAS = {
+    "count": 1,
+    "watch_delay_s": 0.5,
+    "schedule": "rr",
+}
+
+
+class WakeSchedule:
+    """Deterministic replica-wake interleaving: which replica serves the
+    next scheduling wake.  ``rr`` rotates round-robin (uniform, maximally
+    alternating — the default); ``weighted`` draws from a seeded stream
+    with optional per-replica weights (skewed load, e.g. one hot replica
+    racing several cold ones).  Seeded per trace, so a replicated sim run
+    replays byte-for-byte, ``--jobs 2`` included."""
+
+    MODES = ("rr", "weighted")
+
+    def __init__(self, count: int, seed: int = 0, mode: str = "rr",
+                 weights: list[float] | None = None) -> None:
+        if count < 1:
+            raise ValueError(f"need >= 1 replica, got {count}")
+        if mode not in self.MODES:
+            raise ValueError(f"unknown schedule mode {mode!r}; "
+                             f"want one of {self.MODES}")
+        if weights is not None and (len(weights) != count
+                                    or any(w <= 0 for w in weights)):
+            raise ValueError(f"weights must be {count} positive values")
+        self.count = count
+        self.mode = mode
+        self.weights = list(weights) if weights is not None else None
+        self._i = 0
+        # Distinct entropy tag folded with the trace seed (the FaultPlan
+        # construction, stdlib spelling): the wake stream is independent
+        # of the trace's and the fault plan's.
+        self._rng = random.Random((0x5EAD5 << 32) ^ (seed & 0xFFFFFFFF))
+        if self.weights is not None:
+            total = sum(self.weights)
+            acc = 0.0
+            self._cum = []
+            for w in self.weights:
+                acc += w / total
+                self._cum.append(acc)
+
+    def next(self) -> int:
+        if self.mode == "rr":
+            i = self._i % self.count
+            self._i += 1
+            return i
+        u = self._rng.random()
+        if self.weights is None:
+            return min(self.count - 1, int(u * self.count))
+        for i, c in enumerate(self._cum):
+            if u < c:
+                return i
+        return self.count - 1
+
+    def describe(self) -> dict:
+        out: dict = {"mode": self.mode, "count": self.count}
+        if self.weights is not None:
+            out["weights"] = list(self.weights)
+        return out
+
+
+class ReplicaSet:
+    """N racing scheduler replicas plus the deterministic machinery the
+    sim drives them with: the seeded wake schedule, the delayed-delivery
+    log that models per-replica watch latency, and per-replica wake/bind/
+    crash accounting (the report's ``replicas`` block).
+
+    The delivery model: every committed bind is logged with its commit
+    time; a replica folds a logged bind into its cached state only once
+    its own wake runs at ``commit_t + watch_delay_s`` or later — reading
+    the pod's CURRENT object (newest-wins upsert, exactly the informer
+    mirror's rule).  A fold that cannot apply drops that replica's cache;
+    the next verb re-syncs from API truth.  Correctness never depends on
+    this cache: the shared-writer bind verb arbitrates every claim
+    against the authoritative store."""
+
+    def __init__(self, schedulers: list[ExtenderScheduler], *, clock,
+                 seed: int = 0, schedule: str = "rr",
+                 watch_delay_s: float = 0.5,
+                 weights: list[float] | None = None) -> None:
+        if not schedulers:
+            raise ValueError("ReplicaSet needs at least one scheduler")
+        for i, s in enumerate(schedulers):
+            # Ownership asserted at construction (the single-owner
+            # refusal): an in-place-folding scheduler racing peers would
+            # silently corrupt its cached state, and a non-shared_writers
+            # one would skip both the CAS guard and claim arbitration —
+            # double-booking silicon on the first stale-cache race.
+            if not s.config.shared_writers:
+                raise ValueError(
+                    f"replica {i}: shared_writers must be True — racing "
+                    "binders without CAS-guarded claim arbitration "
+                    "double-book chips")
+            if s._single_owner:
+                raise ValueError(
+                    f"replica {i}: single-owner in-place fold mode is "
+                    "incompatible with racing writers")
+        self.schedulers = list(schedulers)
+        self.clock = clock
+        self.watch_delay_s = float(watch_delay_s)
+        self.schedule = WakeSchedule(len(schedulers), seed=seed,
+                                    mode=schedule, weights=weights)
+        n = len(schedulers)
+        self.wakes = [0] * n
+        self.binds = [0] * n
+        self.crash_restarts = [0] * n
+        self.delivered = [0] * n
+        self._active = 0
+        # (commit_t, namespace, pod_name) per committed member bind, in
+        # commit order; per-replica cursors advance monotonically.
+        self._log: list[tuple[float, str, str]] = []
+        self._cursor = [0] * n
+
+    @property
+    def count(self) -> int:
+        return len(self.schedulers)
+
+    @property
+    def active(self) -> int:
+        return self._active
+
+    # ---- the sim-facing surface -------------------------------------------
+
+    def begin_wake(self) -> ExtenderScheduler:
+        """Pick the replica serving this wake (seeded schedule), deliver
+        its due peer-bind events, and return its scheduler."""
+        i = self.schedule.next()
+        self._active = i
+        self.wakes[i] += 1
+        self.deliver(i)
+        return self.schedulers[i]
+
+    def deliver(self, i: int) -> int:
+        """Fold every logged bind whose watch delay has elapsed into
+        replica ``i``'s cached state (reading CURRENT pod objects — the
+        newest-wins upsert the informer mirror applies).  Unreadable
+        objects are skipped: the cache just stays stale there, which the
+        claim arbitration tolerates by construction."""
+        now = self.clock()
+        cur = self._cursor[i]
+        sched = self.schedulers[i]
+        events = []
+        while cur < len(self._log) and \
+                self._log[cur][0] + self.watch_delay_s <= now:
+            _, ns, name = self._log[cur]
+            cur += 1
+            try:
+                obj = sched.api.get("pods", name, ns)
+            except NotFound:
+                continue  # deleted meanwhile; the DELETED was broadcast
+            except (ApiUnavailable, ApiTimeout):
+                continue  # chaos-faulted read — stale is safe, skip
+            events.append(("pods", "MODIFIED", obj))
+        delivered = cur - self._cursor[i]
+        self._cursor[i] = cur
+        if events:
+            sched.apply_events(events)
+        self.delivered[i] += delivered
+        return delivered
+
+    def note_committed(self, decisions: list[dict],
+                       namespace: str = "default") -> None:
+        """Log a successful wake's member binds for delayed delivery to
+        peers (the committing replica's own cache already holds its bind
+        delta)."""
+        now = self.clock()
+        for d in decisions:
+            self._log.append((now, namespace, d["pod"]))
+        self.binds[self._active] += 1
+
+    def invalidate_all(self, events=None) -> None:
+        """Broadcast an out-of-band cluster mutation (arrivals, deletes,
+        GC wipes, node churn) to every replica's cache — the engine's
+        truth-keeping writes are immediate, only PEER BINDS ride the
+        delayed watch model."""
+        for s in self.schedulers:
+            if events is not None:
+                s.apply_events(events)
+            else:
+                s.invalidate_cached_state()
+
+    def restart_active(self, fresh: ExtenderScheduler) -> ExtenderScheduler:
+        """Replace the active replica's scheduler after an injected
+        crash (the peers keep their instances, caches, and in-flight
+        world — that is the point).  The fresh instance starts with an
+        empty cache and a delivery cursor at the log head: recovery
+        rebuilds from API truth, not from replayed history."""
+        i = self._active
+        self.schedulers[i] = fresh
+        self._cursor[i] = len(self._log)
+        self.crash_restarts[i] += 1
+        return fresh
+
+    # ---- reporting ---------------------------------------------------------
+
+    def block(self, merged_counters: dict) -> dict:
+        """The deterministic per-policy ``replicas`` report block: wake/
+        bind/crash distribution across replicas, total sorts, and the
+        conflict taxonomy (every Conflict a shared-writer bind raises is
+        classified and counted by the scheduler)."""
+        c = merged_counters
+        return {
+            "count": self.count,
+            "schedule": self.schedule.describe(),
+            "watch_delay_s": self.watch_delay_s,
+            "wakes": list(self.wakes),
+            "binds": list(self.binds),
+            "crash_restarts": list(self.crash_restarts),
+            "peer_binds_delivered": list(self.delivered),
+            "sorts": c.get("sort_requests", 0),
+            "bind_conflicts": c.get("bind_conflicts", 0),
+            "conflicts_by_cause": {
+                "lost_race": c.get("replica_bind_lost_race", 0),
+                "stale_cache": c.get("replica_stale_cache_aborts", 0),
+                "ambiguous_timeout": c.get("replica_conflict_ambiguous", 0),
+            },
+            "stale_cache_aborts": c.get("replica_stale_cache_aborts", 0),
+            "foreign_bind_adoptions": c.get("recover_foreign_bind_adopted",
+                                            0),
+        }
+
+
+# ---- server mode: real concurrent HTTP replicas ---------------------------
+
+
+class ReplicaServerSet:
+    """N live extender replicas over one API server — each with its own
+    informer mirror and HTTP front-end on an ephemeral port.  The
+    server-mode twin of :class:`ReplicaSet`; use as a context manager or
+    call :meth:`stop`."""
+
+    def __init__(self, replicas: list[tuple]) -> None:
+        self._replicas = replicas  # (scheduler, informer, http_server)
+
+    @property
+    def schedulers(self) -> list[ExtenderScheduler]:
+        return [r[0] for r in self._replicas]
+
+    @property
+    def urls(self) -> list[str]:
+        return [f"http://{r[2].address[0]}:{r[2].address[1]}"
+                for r in self._replicas]
+
+    def __enter__(self) -> "ReplicaServerSet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def stop(self) -> None:
+        for _sched, informer, server in self._replicas:
+            server.stop()
+            if informer is not None:
+                informer.stop()
+
+
+def start_replica_servers(api_server, count: int, *, base_config=None,
+                          host: str = "127.0.0.1",
+                          wait_synced_s: float = 10.0) -> ReplicaServerSet:
+    """Start ``count`` real extender replicas against ``api_server``:
+    per replica an :class:`~tputopo.k8s.informer.Informer`, an
+    :class:`ExtenderScheduler` in ``shared_writers`` mode with its own
+    ``replica_id``, and a threaded HTTP server on an ephemeral port.
+    The bench's ``shards`` rig and the server-mode tests drive these
+    concurrently — the genuine racing-writers deployment."""
+    import dataclasses
+
+    from tputopo.extender.config import ExtenderConfig
+    from tputopo.extender.server import ExtenderHTTPServer
+    from tputopo.k8s.informer import Informer
+
+    replicas: list[tuple] = []
+    try:
+        for i in range(count):
+            cfg = dataclasses.replace(base_config or ExtenderConfig(),
+                                      shared_writers=True,
+                                      replica_id=f"r{i}")
+            informer = Informer(api_server).start()
+            try:
+                informer.wait_synced(timeout=wait_synced_s)
+                sched = ExtenderScheduler(api_server, cfg,
+                                          informer=informer)
+                server = ExtenderHTTPServer(sched, cfg, host=host,
+                                            port=0).start()
+            except BaseException:
+                informer.stop()  # this replica's informer is already live
+                raise
+            replicas.append((sched, informer, server))
+    except BaseException:
+        # A later replica's startup failed (port exhaustion, API down):
+        # stop the already-live ones — leaked watch threads and server
+        # sockets would otherwise outlive the exception.
+        ReplicaServerSet(replicas).stop()
+        raise
+    return ReplicaServerSet(replicas)
+
+
+class LoadGenerator:
+    """Closed-loop sort+bind load against a set of extender replica URLs
+    — the heavy-traffic measurement rig behind bench.py's ``shards``
+    block.  ``concurrency`` worker threads each pull the next pending pod,
+    POST ``sort`` to a replica (rotating), pick the max-score host, and
+    POST ``bind`` — re-sorting on a *different* replica after a bind
+    conflict (up to ``bind_retries`` times), exactly what a racing
+    kube-scheduler shard does.  Latencies, conflict counts, and outcomes
+    aggregate under one lock; wall-clock numbers are telemetry by nature
+    (this never runs inside the sim's virtual time)."""
+
+    def __init__(self, urls: list[str], node_names: list[str], *,
+                 url_prefix: str = "/tputopo-scheduler",
+                 concurrency: int = 8, bind_retries: int = 6,
+                 timeout_s: float = 30.0) -> None:
+        if not urls:
+            raise ValueError("need at least one replica URL")
+        self.urls = list(urls)
+        self.node_names = list(node_names)
+        self.url_prefix = url_prefix
+        self.concurrency = max(1, concurrency)
+        self.bind_retries = max(0, bind_retries)
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._sort_ms: list[float] = []   # guarded-by: _lock
+        self._bind_ms: list[float] = []   # guarded-by: _lock
+        self._counts: dict[str, int] = {}  # guarded-by: _lock
+        self._work: list[dict] = []       # guarded-by: _lock
+        self._next_req = 0                # guarded-by: _lock
+
+    # ---- plumbing ----------------------------------------------------------
+
+    def _post(self, url: str, verb: str, payload: dict) -> tuple[object, float]:
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            f"{url}{self.url_prefix}/{verb}", data=body,
+            headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            out = json.loads(resp.read())
+        return out, (time.perf_counter() - t0) * 1e3
+
+    def _tally(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + by
+
+    def _take(self) -> tuple[int, dict | None]:
+        with self._lock:
+            if not self._work:
+                return self._next_req, None
+            self._next_req += 1
+            return self._next_req - 1, self._work.pop()
+
+    # ---- the workers -------------------------------------------------------
+
+    def _storm_worker(self) -> None:
+        """Sort-only storm: every request is one sort verb, no binds —
+        the aggregate-throughput phase.  Sorts are served from each
+        replica's informer mirror (zero API round-trips in steady state),
+        so this is the verb whose aggregate rate scales with replica
+        PROCESSES; binds all funnel through the one API server and
+        measure latency/contention instead."""
+        while True:
+            seq, pod = self._take()
+            if pod is None:
+                return
+            url = self.urls[seq % len(self.urls)]
+            try:
+                _, ms = self._post(url, "sort", {
+                    "Pod": pod, "NodeNames": self.node_names})
+            except OSError:
+                self._tally("transport_errors")
+                continue
+            with self._lock:
+                self._sort_ms.append(ms)
+                self._counts["sorts"] = self._counts.get("sorts", 0) + 1
+
+    def _worker(self) -> None:
+        while True:
+            seq, pod = self._take()
+            if pod is None:
+                return
+            url = self.urls[seq % len(self.urls)]
+            bound = False
+            for attempt in range(self.bind_retries + 1):
+                try:
+                    scores, ms = self._post(url, "sort", {
+                        "Pod": pod,
+                        "NodeNames": self.node_names,
+                    })
+                except OSError:
+                    self._tally("transport_errors")
+                    break
+                with self._lock:
+                    self._sort_ms.append(ms)
+                    self._counts["sorts"] = self._counts.get("sorts", 0) + 1
+                best = max(scores, key=lambda s: (s["Score"], s["Host"])) \
+                    if scores else None
+                if best is None or best["Score"] <= 0:
+                    self._tally("infeasible")
+                    break
+                md = pod["metadata"]
+                try:
+                    out, ms = self._post(url, "bind", {
+                        "PodName": md["name"],
+                        "PodNamespace": md.get("namespace", "default"),
+                        "Node": best["Host"],
+                    })
+                except OSError:
+                    self._tally("transport_errors")
+                    break
+                with self._lock:
+                    self._bind_ms.append(ms)
+                    self._counts["binds"] = self._counts.get("binds", 0) + 1
+                err = out.get("Error", "") if isinstance(out, dict) else ""
+                if not err:
+                    bound = True
+                    break
+                if "race" in err or "conflict" in err.lower():
+                    self._tally("bind_conflicts")
+                    if "claim on" in err or "already bound" in err:
+                        # Claim-arbitration loser (or a peer bound this
+                        # pod): the pod sits bound-but-unclaimed until a
+                        # job controller recreates it — no retry can
+                        # rebind it, so the request ends here (burned).
+                        self._tally("pods_burned")
+                        break
+                    # CAS-leg conflict: nothing applied — retry on the
+                    # NEXT replica (the conflicting one just proved its
+                    # view stale).
+                    url = self.urls[(seq + attempt + 1) % len(self.urls)]
+                    continue
+                if "no feasible" in err:
+                    # The sorted winner filled up between our sort and our
+                    # bind (concurrent workers pile onto one max-score
+                    # node) — a stale-sort race, not a capacity verdict:
+                    # re-sort against current occupancy and retry, exactly
+                    # what kube-scheduler's requeue does.
+                    self._tally("stale_sort_retries")
+                    continue
+                self._tally("bind_errors")
+                break
+            if bound:
+                self._tally("binds_ok")
+
+    # ---- entry -------------------------------------------------------------
+
+    def _run_phase(self, work: list[dict], storm: bool) -> float:
+        """One worker-pool pass over ``work``; returns the phase wall.
+        The two Thread targets are named literally (not via a variable)
+        so the lockset rule can enumerate them as thread roots and check
+        their shared-state discipline."""
+        with self._lock:
+            self._work = list(reversed(work))  # pop() serves input order
+            self._next_req = 0
+        if storm:
+            threads = [threading.Thread(target=self._storm_worker,
+                                        name=f"loadgen-{i}", daemon=True)
+                       for i in range(self.concurrency)]
+        else:
+            threads = [threading.Thread(target=self._worker,
+                                        name=f"loadgen-{i}", daemon=True)
+                       for i in range(self.concurrency)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    def _snapshot(self) -> tuple[list[float], list[float], dict]:
+        with self._lock:
+            return (sorted(self._sort_ms), sorted(self._bind_ms),
+                    dict(self._counts))
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._sort_ms = []
+            self._bind_ms = []
+            self._counts = {}
+
+    def run(self, pods: list[dict], *, sort_rounds: int = 2) -> dict:
+        """Two phases.  The **sort storm** fires ``sort_rounds`` pure
+        sort requests per pod across the racing workers — aggregate
+        sorts/s here is the scaling figure (each replica process scores
+        on its own CPU from its own informer mirror).  The **bind phase**
+        then drives every pod through sort+bind — latency under
+        contention, the bind-conflict rate, and outcome counts."""
+        out: dict = {
+            "replicas": len(self.urls),
+            "concurrency": self.concurrency,
+            "pods": len(pods),
+        }
+        if sort_rounds > 0:
+            self._reset()
+            wall = self._run_phase(list(pods) * sort_rounds,
+                                   storm=True)
+            sort_ms, _, counts = self._snapshot()
+            storm = {
+                "requests": counts.get("sorts", 0),
+                "wall_s": round(wall, 3),
+                "sorts_per_s": round(counts.get("sorts", 0) / wall, 1)
+                if wall > 0 else 0.0,
+                "transport_errors": counts.get("transport_errors", 0),
+            }
+            if sort_ms:
+                storm["p50_ms"] = round(quantile(sort_ms, 0.5), 3)
+                storm["p95_ms"] = round(quantile(sort_ms, 0.95), 3)
+            out["sort_storm"] = storm
+        self._reset()
+        wall_s = self._run_phase(pods, storm=False)
+        sort_ms, bind_ms, counts = self._snapshot()
+        out.update({
+            "wall_s": round(wall_s, 3),
+            "sorts": counts.get("sorts", 0),
+            "sorts_per_s": round(counts.get("sorts", 0) / wall_s, 1)
+            if wall_s > 0 else 0.0,
+            "binds_ok": counts.get("binds_ok", 0),
+            "bind_conflicts": counts.get("bind_conflicts", 0),
+            "pods_burned": counts.get("pods_burned", 0),
+            "stale_sort_retries": counts.get("stale_sort_retries", 0),
+            "bind_errors": counts.get("bind_errors", 0),
+            "infeasible": counts.get("infeasible", 0),
+            "transport_errors": counts.get("transport_errors", 0),
+        })
+        binds = counts.get("binds", 0)
+        out["bind_conflict_rate"] = round(
+            counts.get("bind_conflicts", 0) / binds, 4) if binds else 0.0
+        if sort_ms:
+            out["sort_p50_ms"] = round(quantile(sort_ms, 0.5), 3)
+            out["sort_p95_ms"] = round(quantile(sort_ms, 0.95), 3)
+        if bind_ms:
+            out["bind_p50_ms"] = round(quantile(bind_ms, 0.5), 3)
+            out["bind_p95_ms"] = round(quantile(bind_ms, 0.95), 3)
+        return out

@@ -42,7 +42,8 @@ from tputopo.k8s.retry import (ApiTimeout, ApiUnavailable, RetryPolicy,
 from tputopo.obs import NULL_TRACER, Tracer
 from tputopo.extender.config import ExtenderConfig
 from tputopo.extender.state import (ClusterState, PodAssignment, SliceDomain,
-                                    _assume_time_of, full_sync)
+                                    _assume_time_of, _pod_assignment_of,
+                                    full_sync, list_pods_nocopy)
 from tputopo.topology.model import ChipTopology, Coord
 from tputopo.topology.score import (_box_of, predict_allreduce_gbps,
                                     predict_multidomain_allreduce_gbps)
@@ -77,11 +78,23 @@ class BindError(RuntimeError):
     (``conflict`` / ``unavailable`` / ``timeout`` / ``gang_infeasible`` /
     ``wrong_node`` / ``not_found`` / ``already_bound`` / ``error``) — what
     the sim's retry-by-reason accounting and a caller deciding between
-    re-queue and re-plan key on, instead of parsing the message."""
+    re-queue and re-plan key on, instead of parsing the message.
 
-    def __init__(self, msg: str, reason: str = "error") -> None:
+    ``cause`` refines a ``conflict`` under replicated deployments
+    (``ExtenderConfig.shared_writers``): ``lost_race`` (a genuinely
+    concurrent peer claim won the arbitration), ``stale_cache`` (the
+    losing plan was built from a view that provably predated the winning
+    claim — a fresh sync would have avoided the collision), or
+    ``ambiguous_timeout`` (the post-conflict re-read could not determine
+    the winner; the TTL GC remains the backstop).  None outside
+    shared-writer mode — single-scheduler conflicts keep their historical
+    shape."""
+
+    def __init__(self, msg: str, reason: str = "error",
+                 cause: str | None = None) -> None:
         super().__init__(msg)
         self.reason = reason
+        self.cause = cause
 
 
 def quantile(sorted_xs, q: float):
@@ -236,6 +249,15 @@ class ExtenderScheduler:
         self.api = api_server
         self.config = config or ExtenderConfig()
         self.clock = clock
+        # Claim-arbitration listing (shared_writers mode): the indexed
+        # assignment-carrying-pods read where the API surface provides one
+        # (FakeApiServer/KubeApiClient.list_assignments — O(assignments)),
+        # with the whole-store shim as the constructor-bound fallback so
+        # the bind verb's own call graph never contains a full-store scan
+        # (the same binding trick AssumptionGC uses).
+        self._list_claims_raw = getattr(api_server, "list_assignments",
+                                        None) or functools.partial(
+                                            list_pods_nocopy, api_server)
         # Verb-latency telemetry rides an injectable wall hook (the
         # clock=time.time default-arg idiom, obs.Tracer style): the
         # values feed observe_ms/histograms only — never a decision — and
@@ -334,12 +356,18 @@ class ExtenderScheduler:
     @property
     def _single_owner(self) -> bool:
         """True when this scheduler provably holds the ONLY reference to
-        its cached derived state: informer-less ``bind_from_cache`` mode
-        (the sim engine's single-threaded single-writer deployment).
-        Only then may folds mutate in place — the threaded/informer
-        paths publish states to lock-free concurrent readers and must
-        keep the copy-on-write discipline."""
-        return self.informer is None and self.config.bind_from_cache
+        its cached derived state AND is the sole writer of assignments:
+        informer-less ``bind_from_cache`` mode (the sim engine's
+        single-threaded single-writer deployment).  Only then may folds
+        mutate in place — the threaded/informer paths publish states to
+        lock-free concurrent readers and must keep the copy-on-write
+        discipline, and ``shared_writers`` (replicated control plane)
+        voids the sole-writer premise outright: a racing peer's commits
+        make the in-place fold's invalidation contract unsatisfiable, so
+        shared-writer state maintenance downgrades to COW-or-drop
+        (tputopo.extender.replicas asserts this at construction)."""
+        return (self.informer is None and self.config.bind_from_cache
+                and not self.config.shared_writers)
 
     # Even with an unchanged informer mirror, a derived state cannot be
     # reused forever: assumption-TTL expiry is judged by the clock at sync
@@ -1489,12 +1517,18 @@ class ExtenderScheduler:
             anns = md.get("annotations", {})
             if not anns.get(ko.ANN_GROUP) or anns.get(ko.ANN_ASSIGNED) != "false":
                 continue
+            wipe: dict = {ko.ANN_GROUP: None, ko.ANN_ASSUME_TIME: None,
+                          ko.ANN_ASSIGNED: None, ko.ANN_PREDICTED_GBPS: None}
+            if self.config.replica_id or ko.ANN_BOUND_BY in anns:
+                # Replicated deployments stamp the binding replica's id;
+                # a release must clear it with the claim (a peer's wiped
+                # gang must not read as still-owned).  Conditional so the
+                # single-scheduler patch stream stays byte-identical.
+                wipe[ko.ANN_BOUND_BY] = None
             try:
                 self._api_call(
                     "release", self.api.patch_annotations,
-                    "pods", md["name"],
-                    {ko.ANN_GROUP: None, ko.ANN_ASSUME_TIME: None,
-                     ko.ANN_ASSIGNED: None, ko.ANN_PREDICTED_GBPS: None},
+                    "pods", md["name"], wipe,
                     namespace=md.get("namespace", "default"),
                     expect_version=md.get("resourceVersion"),
                 )
@@ -1629,6 +1663,15 @@ class ExtenderScheduler:
             bound = [p for p in members if p["spec"].get("nodeName")]
             if not bound or len(bound) >= info["size"]:
                 continue  # whole or untouched — not in flight
+            # Replicated control plane: an in-flight gang whose bound
+            # members were committed by a DIFFERENT replica is still ours
+            # to reconcile — completing it ADOPTS the peer's binds (the
+            # all-or-nothing rule is cluster-wide, not per-replica).
+            foreign = self.config.replica_id and any(
+                (p["metadata"].get("annotations", {}) or {})
+                .get(ko.ANN_BOUND_BY)
+                not in (None, "", self.config.replica_id)
+                for p in bound)
             # Completing requires the full roster: with a member pod
             # absent (deleted, or not yet recreated by the job
             # controller), binding everything that EXISTS would still
@@ -1652,6 +1695,8 @@ class ExtenderScheduler:
                     break
             if completed:
                 self.metrics.inc("crash_gangs_completed")
+                if foreign:
+                    self.metrics.inc("recover_foreign_bind_adopted")
                 outcome["completed"].append(f"{ns}/{gid}")
                 continue
             # Release-or-complete, never half: wipe every still-unconfirmed
@@ -1774,6 +1819,162 @@ class ExtenderScheduler:
             self.metrics.inc("bind_ambiguous_recovered")
             return cur
         return None
+
+    # ---- replicated-control-plane arbitration (shared_writers) -------------
+
+    def _own_claim_landed(self, pod_name: str, namespace: str,
+                          anns: dict) -> bool:
+        """After a Conflict on the CAS-guarded claim patch: True when the
+        pod already carries OUR exact claim (group + assume-time) — the
+        echo of an applied-then-timed-out patch replaying against its own
+        resourceVersion bump.  False on any read failure or a foreign
+        claim: the caller treats it as a genuine race."""
+        try:
+            cur = self._api_call("get", self.api.get, "pods", pod_name,
+                                 namespace)
+        except (NotFound, ApiUnavailable):
+            return False
+        cur_anns = cur.get("metadata", {}).get("annotations", {}) or {}
+        return (cur_anns.get(ko.ANN_GROUP) == anns[ko.ANN_GROUP]
+                and cur_anns.get(ko.ANN_ASSUME_TIME)
+                == anns[ko.ANN_ASSUME_TIME])
+
+    def _classify_conflict(self, pod_name: str, namespace: str,
+                           now: float) -> str:
+        """The structured cause of a bind Conflict under shared writers —
+        re-read the pod and judge what survives: a claim stamped strictly
+        BEFORE ``now`` existed when we planned, so our view was stale
+        (``stale_cache``); a same-instant (or unreadable-timestamp)
+        surviving claim is a genuinely concurrent race we lost
+        (``lost_race``); an unreachable re-read OR no surviving claim at
+        all (the conflicting write applied nothing — an injected/spurious
+        CAS 409, or the racer's claim was already wiped) leaves nothing
+        to arbitrate against (``ambiguous_timeout``; the retry decides).
+        Each cause is counted (replica_* counters)."""
+        try:
+            cur = self._api_call("get", self.api.get, "pods", pod_name,
+                                 namespace)
+        except (NotFound, ApiUnavailable):
+            self.metrics.inc("replica_conflict_ambiguous")
+            return "ambiguous_timeout"
+        cur_anns = cur.get("metadata", {}).get("annotations", {}) or {}
+        claimed = bool(cur.get("spec", {}).get("nodeName")
+                       or cur_anns.get(ko.ANN_GROUP))
+        if not claimed:
+            # Nothing survived the conflicting write: not a race anyone
+            # won — calling it lost_race would pollute the taxonomy with
+            # phantom peers (the chaos layer injects exactly this shape).
+            self.metrics.inc("replica_conflict_ambiguous")
+            return "ambiguous_timeout"
+        claim_t = None
+        try:
+            claim_t = float(cur_anns.get(ko.ANN_ASSUME_TIME, ""))
+        except (TypeError, ValueError):
+            claim_t = None
+        if claim_t is not None and math.isfinite(claim_t) and claim_t < now:
+            self.metrics.inc("replica_stale_cache_aborts")
+            return "stale_cache"
+        self.metrics.inc("replica_bind_lost_race")
+        return "lost_race"
+
+    def _list_claims(self, node_name: str, now: float) -> list[tuple]:
+        """Live chip claims on ``node_name`` as ``(assume_time, namespace,
+        pod_name, chip_set)`` tuples — the claim check's arbitration
+        universe.  A pod's chips must live on its node, so cross-pod
+        overlap is only possible between same-node claims.  Expired
+        unconfirmed assumptions are excluded by the same TTL judgement
+        sync() applies: their chips are NOT occupancy, and retreating
+        before a corpse the GC will wipe would stall placements a
+        single-scheduler deployment happily makes."""
+        out = []
+        for pod in self._list_claims_raw():
+            pa = _pod_assignment_of(pod)
+            if pa is None or pa.node_name != node_name:
+                continue
+            if not pa.assigned and \
+                    now - pa.assume_time > self.config.assume_ttl_s:
+                continue  # expired — not occupancy (sync's rule)
+            out.append((pa.assume_time, pa.namespace, pa.pod_name,
+                        {tuple(c) for c in pa.chips}))
+        return out
+
+    def _claim_check(self, pod_name: str, namespace: str, node_name: str,  # holds-lock: _bind_lock
+                     placement, now: float, tr) -> None:
+        """Post-commit chip-claim arbitration (shared_writers): raise a
+        classified ``conflict`` BindError — after retreating — when ANY
+        other live claim overlaps this bind's chips.  Why "any", with no
+        tie-break: at this check, an overlapping claim either committed
+        BEFORE ours (its own post-commit check has already run against a
+        world without our claim and passed — it keeps the chips; only we
+        can still retreat) or is concurrently in flight (each racer sees
+        the other and both retreat — wasteful but safe, and the jittered
+        retry re-plans from fresh truth).  A tie-break that ever lets the
+        LATER committer keep its claim would double-book: the earlier
+        winner has already stopped checking.  Cause: an overlapping
+        claim stamped strictly before ``now`` was knowable when we
+        planned (``stale_cache``); a same-instant claim is a genuinely
+        concurrent race (``lost_race``).  An unreadable claim universe
+        retreats conservatively (``ambiguous_timeout``) — a possibly-
+        double-booked chip must never survive on a read error."""
+        ns = namespace or "default"
+        mine = {tuple(c) for c in placement.chips}
+        winner = None
+        cause = None
+        try:
+            claims = self._list_claims(node_name, now)
+        except (ApiUnavailable, ApiTimeout):
+            cause = "ambiguous_timeout"
+            self.metrics.inc("replica_conflict_ambiguous")
+        if cause is None:
+            # Classify against the OLDEST overlapping claim (min by the
+            # (assume_time, ns, name) attribution order sync() uses):
+            # list_assignments returns (ns, name) order, and breaking on
+            # the first hit could report lost_race while an older claim
+            # proves the plan stale.
+            overlapping = [
+                (t, cns, cname, sorted(mine & chips))
+                for t, cns, cname, chips in claims
+                if (cns, cname) != (ns, pod_name) and mine & chips]
+            if not overlapping:
+                return  # claim holds
+            winner = min(overlapping)
+            if winner[0] < now:
+                cause = "stale_cache"
+                self.metrics.inc("replica_stale_cache_aborts")
+            else:
+                cause = "lost_race"
+                self.metrics.inc("replica_bind_lost_race")
+        # Retreat: wipe our own annotations so the chips are free again
+        # the moment any peer re-reads.  The pod itself stays bound-but-
+        # unclaimed — un-binding is the job controller's delete/recreate
+        # (the sim engine's reset path); the TTL GC backstops a failed
+        # wipe exactly like any other stale assumption.
+        wipe: dict = {ko.ANN_GROUP: None, ko.ANN_ASSUME_TIME: None,
+                      ko.ANN_ASSIGNED: None, ko.ANN_PREDICTED_GBPS: None}
+        if self.config.replica_id:
+            wipe[ko.ANN_BOUND_BY] = None
+        try:
+            self._api_call("release", self.api.patch_annotations, "pods",
+                           pod_name, wipe, namespace=ns)
+        except (NotFound, Conflict, ApiUnavailable):
+            self.metrics.inc("release_unavailable")
+        with self._cache_lock:
+            self._cached_state = None  # the view that planned this is wrong
+        self.metrics.inc("bind_errors")
+        self.metrics.inc("bind_conflicts")
+        if tr.enabled:
+            rec: dict = {"verb": "bind", "pod": f"{ns}/{pod_name}",
+                         "node": node_name,
+                         "conflict": {"cause": cause, "leg": "claim"}}
+            if winner is not None:
+                rec["conflict"]["winner"] = f"{winner[1]}/{winner[2]}"
+                rec["conflict"]["chips"] = [list(c) for c in winner[3]]
+            tr.explain(rec)
+        detail = (f"claim on {node_name} lost to {winner[1]}/{winner[2]} "
+                  f"(overlap {winner[3]})" if winner is not None
+                  else f"claim on {node_name} unverifiable")
+        raise BindError(f"bind race on {pod_name}: {detail}",
+                        reason="conflict", cause=cause)
 
     def _bind_locked(self, pod_name: str, namespace: str, node_name: str) -> dict:  # holds-lock: _bind_lock
         tr = self.tracer.start(
@@ -1932,10 +2133,38 @@ class ExtenderScheduler:
         }
         if gang_id is not None:
             anns[ko.ANN_GANG_ID] = gang_id
+        if self.config.replica_id:
+            # Replica identity on every committed bind (replicated control
+            # plane): recover() reads it to tell its own in-flight binds
+            # from a peer's.  Absent without a replica_id — the
+            # single-scheduler annotation vocabulary is byte-identical.
+            anns[ko.ANN_BOUND_BY] = self.config.replica_id
         with tr.phase("cas_patch"):
             try:
-                self._api_call("cas", self.api.patch_annotations, "pods",
-                               pod_name, anns, namespace)
+                try:
+                    # shared_writers: the claim patch is CAS-guarded on
+                    # the verb's own read — a peer that patched/bound this
+                    # pod meanwhile Conflicts cleanly instead of having
+                    # its claim silently overwritten (the overwrite would
+                    # leak the peer's chips AND stamp our group onto a
+                    # pod bound to the peer's node).  Single-scheduler
+                    # mode passes None: byte-identical to the historical
+                    # un-guarded patch.
+                    self._api_call(
+                        "cas", self.api.patch_annotations, "pods",
+                        pod_name, anns, namespace,
+                        expect_version=(
+                            pod["metadata"].get("resourceVersion")
+                            if self.config.shared_writers else None))
+                except Conflict:
+                    # CAS reconciliation: an ambiguous timeout on the
+                    # patch leg (applied, then timed out) replays against
+                    # its own bumped resourceVersion.  Re-read: our exact
+                    # claim present means the patch landed — anything
+                    # else is a genuine racing writer.
+                    if not self._own_claim_landed(pod_name, namespace, anns):
+                        raise
+                    self.metrics.inc("bind_ambiguous_recovered")
                 try:
                     bound_obj = self._api_call("cas", self.api.bind_pod,
                                                pod_name, node_name, namespace)
@@ -1952,8 +2181,27 @@ class ExtenderScheduler:
             except Conflict as e:
                 self.metrics.inc("bind_errors")
                 self.metrics.inc("bind_conflicts")
+                cause = None
+                if self.config.shared_writers:
+                    # Replicated control plane: every Conflict leaves the
+                    # verb CLASSIFIED (lost_race / stale_cache /
+                    # ambiguous_timeout) and the cached view dropped — a
+                    # conflicting peer claim proves the view wrong, and
+                    # the retry must re-plan from fresh truth.
+                    cause = self._classify_conflict(pod_name, namespace,
+                                                    now)
+                    with self._cache_lock:
+                        self._cached_state = None
+                    if tr.enabled:
+                        tr.explain({
+                            "verb": "bind",
+                            "pod": f"{namespace or 'default'}/{pod_name}",
+                            "node": node_name,
+                            "conflict": {"cause": cause,
+                                         "leg": "cas_patch"},
+                        })
                 raise BindError(f"bind race on {pod_name}: {e}",
-                                reason="conflict") from e
+                                reason="conflict", cause=cause) from e
             except NotFound as e:
                 self.metrics.inc("bind_errors")
                 raise BindError(f"bind race on {pod_name}: {e}",
@@ -1965,6 +2213,20 @@ class ExtenderScheduler:
                     f"api unavailable binding {pod_name}: {e}",
                     reason=("timeout" if isinstance(e, ApiTimeout)
                             else "unavailable")) from e
+        if self.config.shared_writers:
+            # Claim arbitration (replicated control plane): the per-pod
+            # CAS above cannot see CROSS-POD chip overlap — a peer
+            # binding a different pod onto the same chips from an equally
+            # stale view sails through its own CAS.  Validate this bind's
+            # claim against authoritative occupancy and retreat (wipe our
+            # annotations, classified BindError) when ANY other live
+            # claim overlaps — an earlier committer's check has already
+            # passed, so only we can still back out; a concurrently
+            # in-flight pair mutually retreats (safe, retried).  See
+            # _claim_check for why no tie-break is sound.
+            with tr.phase("claim"):
+                self._claim_check(pod_name, namespace, node_name,
+                                  placement, now, tr)
         # ``with``-managed span (release-on-all-paths rule): the former
         # manual __enter__/__exit__ pair leaked the span if anything in
         # the publish section raised — the with-form closes it on every
@@ -2044,24 +2306,38 @@ class ExtenderScheduler:
                     with self._cache_lock:
                         self._cached_state = None
             elif self.config.bind_from_cache:
-                # Informer-less assume cache (single-writer mode): apply our
-                # own bind to the cached derived state so the next verb in the
-                # burst reuses it instead of re-syncing — the cache's coherence
-                # is exactly this delta, since no one else writes assignments.
-                # Single-owner by definition, so the delta folds IN PLACE
-                # (ClusterState.bind_inplace: an O(chips) note_bind instead
-                # of the _cow clone; its FOLD_INPLACE kill switch restores
-                # the copy-on-write clone byte-for-byte) and memo eviction
-                # touches only the bound domain.
+                # Informer-less assume cache: apply our own bind to the
+                # cached derived state so the next verb in the burst
+                # reuses it instead of re-syncing.  In single-owner mode
+                # (the sole-writer sim engine) the delta folds IN PLACE
+                # (ClusterState.bind_inplace: an O(chips) note_bind
+                # instead of the _cow clone; its FOLD_INPLACE kill switch
+                # restores the copy-on-write clone byte-for-byte) and
+                # memo eviction touches only the bound domain.  Under
+                # shared_writers the sole-writer premise is void — racing
+                # replica commits this cache never sees make an in-place
+                # mutation a silent corruption — so the delta DOWNGRADES
+                # to the copy-on-write with_bind clone (the same COW
+                # discipline the informer path keeps for its lock-free
+                # readers); staleness vs peers is then caught by the bind
+                # verb's claim arbitration, never by trusting this cache.
                 new_state = None
+                pre_masks = None
                 if self.config.state_delta and state is self._cached_state:
-                    pre_masks = ({sid: dom.allocator.used_mask
-                                  for sid, dom in state.domains.items()}
-                                 if ClusterState.FOLD_INPLACE else None)
-                    new_state = state.bind_inplace(PodAssignment(
+                    pa = PodAssignment(
                         pod_name=pod_name, namespace=namespace or "default",
                         node_name=node_name, chips=list(placement.chips),
-                        assigned=False, assume_time=now, gang_id=gang_id))
+                        assigned=False, assume_time=now, gang_id=gang_id)
+                    if self._single_owner:
+                        pre_masks = ({sid: dom.allocator.used_mask
+                                      for sid, dom in state.domains.items()}
+                                     if ClusterState.FOLD_INPLACE else None)
+                        new_state = state.bind_inplace(pa)
+                    else:
+                        try:
+                            new_state = state.with_bind(pa)
+                        except ValueError:
+                            new_state = None  # stale view — drop below
                 if new_state is not None:
                     if new_state is state:
                         self._evict_state_memos(state, pre_masks)

@@ -45,6 +45,14 @@ ANN_ASSUME_TIME = "tpu.dev/assume-time"    # unix seconds, stamped at bind
 ANN_ASSIGNED = "tpu.dev/assigned"          # "false" at bind -> "true" at Allocate
 ANN_GANG_ID = "tpu.dev/gang-id"            # job-level token for gang scheduling
 ANN_PREDICTED_GBPS = "tpu.dev/predicted-allreduce-gbps"  # decision record
+ANN_BOUND_BY = "tpu.dev/bound-by"          # replica id that committed the bind
+                                           # (tputopo.extender.replicas) —
+                                           # stamped only when the extender
+                                           # carries a replica_id, so the
+                                           # single-scheduler vocabulary is
+                                           # byte-identical without one.
+                                           # recover() reads it to count
+                                           # adoptions of a peer's binds.
 
 # -- Priority tiers (tputopo.priority).  A pod (or every pod of a gang)
 #    declares its tier via this label/annotation; the value is either a

@@ -89,6 +89,14 @@ COUNTERS = (
     "crash_recoveries",
     "release_conflict_resolved",
     "release_unavailable",
+    # replicated control plane (tputopo.extender.replicas; the
+    # shared_writers bind verb's conflict taxonomy + recover()'s
+    # peer-bind adoption — incremented only when replicas race, so
+    # single-scheduler /metrics and sim report bytes never move)
+    "recover_foreign_bind_adopted",
+    "replica_bind_lost_race",
+    "replica_conflict_ambiguous",
+    "replica_stale_cache_aborts",
     # retry attribution (k8s/retry.py count_retries)
     "retry_api_timeout",
     "retry_api_unavailable",

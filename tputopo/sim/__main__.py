@@ -14,6 +14,7 @@ import json
 import sys
 import time
 
+from tputopo.extender.replicas import DEFAULT_REPLICAS, WakeSchedule
 from tputopo.sim.engine import DEFAULT_DEFRAG, DEFAULT_PREEMPT, run_trace
 from tputopo.sim.policies import available_policies
 from tputopo.sim.trace import TraceConfig
@@ -115,6 +116,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max duration (virtual s) a lower-tier job may "
                         "have and still start while a higher tier is "
                         "blocked (<= 0 disables backfill gating)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="shard the ici policy across N racing extender "
+                        "replicas over the one API server (tputopo."
+                        "extender.replicas): seeded wake interleaving, "
+                        "per-replica caches, delayed peer-bind delivery, "
+                        "CAS-reconciled binds with every Conflict "
+                        "classified; adds the per-policy replicas block "
+                        "(schema tputopo.sim/v6).  1 = the single-"
+                        "scheduler path, byte-identical to the flag "
+                        "being absent")
+    p.add_argument("--replica-watch-delay", type=float,
+                   default=DEFAULT_REPLICAS["watch_delay_s"],
+                   metavar="S",
+                   help="modeled watch latency: a peer's bind reaches a "
+                        "replica's cache only after this many virtual "
+                        "seconds (0 = coherent replicas; larger widens "
+                        "the stale-cache race window)")
+    p.add_argument("--replica-schedule", choices=WakeSchedule.MODES,
+                   default=DEFAULT_REPLICAS["schedule"],
+                   help="replica wake interleaving: 'rr' round-robin or "
+                        "'weighted' seeded random draw")
     p.add_argument("--chaos", default=None, metavar="PROFILE",
                    help="run under the seeded fault-injection layer "
                         "(tputopo.chaos): injected CAS conflicts, "
@@ -194,6 +216,15 @@ def main(argv: list[str] | None = None) -> int:
         preempt = {"max_moves": args.preempt_max_moves,
                    "max_chips_moved": args.preempt_max_chips,
                    "backfill_limit_s": args.backfill_limit}
+    if args.replicas < 1:
+        print(f"--replicas must be >= 1, got {args.replicas}",
+              file=sys.stderr)
+        return 2
+    replicas = None
+    if args.replicas > 1:
+        replicas = {"count": args.replicas,
+                    "watch_delay_s": args.replica_watch_delay,
+                    "schedule": args.replica_schedule}
     defrag = None
     if args.defrag:
         defrag = {"period_s": args.defrag_period,
@@ -221,6 +252,7 @@ def main(argv: list[str] | None = None) -> int:
                                    defrag=defrag,
                                    chaos=args.chaos,
                                    preempt=preempt,
+                                   replicas=replicas,
                                    return_states=True)
         prof.disable()
         buf = io.StringIO()
@@ -235,6 +267,7 @@ def main(argv: list[str] | None = None) -> int:
                                    defrag=defrag,
                                    chaos=args.chaos,
                                    preempt=preempt,
+                                   replicas=replicas,
                                    return_states=True)
     # tpulint: disable=determinism -- CLI wall timing feeds the throughput block only
     wall_s = time.perf_counter() - t0

@@ -25,6 +25,7 @@ import time
 
 from tputopo.defrag import DefragController
 from tputopo.deviceplugin.reporter import node_object_for_probe
+from tputopo.extender.replicas import DEFAULT_REPLICAS
 from tputopo.discovery.shim import _probe_python, _to_host_probe
 from tputopo.extender.gc import AssumptionGC
 from tputopo.obs import NULL_TRACER
@@ -221,6 +222,7 @@ class SimEngine:
                  defrag: dict | None = None,
                  chaos: str | dict | None = None,
                  preempt: dict | None = None,
+                 replicas: dict | None = None,
                  audit_every: int = 0) -> None:
         self.trace = trace
         self.cfg = trace.config
@@ -261,9 +263,20 @@ class SimEngine:
         # slow-tier smoke test guards).
         self.tracer = (ObsTracer(capacity=64, clock=self.clock)
                        if flight_trace else NULL_TRACER)
+        # Replicated control plane (tputopo.extender.replicas), opt-in:
+        # knobs merged over DEFAULT_REPLICAS; count <= 1 normalizes to
+        # None so `--replicas 1` and flag-absent run the identical
+        # single-scheduler code path (byte-for-byte, schema included).
+        self.replica_knobs = None
+        if replicas is not None:
+            knobs = {**DEFAULT_REPLICAS, **replicas}
+            if int(knobs["count"]) > 1:
+                self.replica_knobs = knobs
         self.policy = get_policy(policy_name, read_api, self.clock,
                                  assume_ttl_s, tracer=self.tracer,
-                                 fault_plan=self.fault_plan)
+                                 fault_plan=self.fault_plan,
+                                 replicas=self.replica_knobs,
+                                 seed=self.cfg.seed)
         # Chronological log of committed placements: (job, t, members)
         # always (cheap, deterministic — what the A/B first-divergence
         # finder compares); the policy's explain record attached when
@@ -475,6 +488,9 @@ class SimEngine:
             # pinned by their absence, same rule as defrag/chaos.
             tiers=self.tier_stats,
             preempt=self.preempt_counters,
+            # Replicated-control-plane block (None whenever the policy is
+            # unreplicated — its absence pins every prior schema's bytes).
+            replicas=self.policy.replicas_block(),
         )
 
     def run_events(self) -> None:
@@ -1179,13 +1195,13 @@ class RunState:
     __slots__ = ("policy_name", "horizon_s", "end_t", "metrics",
                  "placed_chips", "frag", "counters", "events_processed",
                  "phases", "phase_wall_ms", "decision_log", "defrag",
-                 "chaos", "tiers", "preempt")
+                 "chaos", "tiers", "preempt", "replicas")
 
     def __init__(self, *, policy_name, horizon_s, end_t, metrics,
                  placed_chips, frag, counters, events_processed,
                  phases=None, phase_wall_ms=None,
                  decision_log=None, defrag=None, chaos=None,
-                 tiers=None, preempt=None) -> None:
+                 tiers=None, preempt=None, replicas=None) -> None:
         self.policy_name = policy_name
         self.horizon_s = horizon_s
         self.end_t = end_t
@@ -1201,6 +1217,7 @@ class RunState:
         self.chaos = chaos
         self.tiers = tiers
         self.preempt = preempt
+        self.replicas = replicas
 
 
 def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
@@ -1235,6 +1252,13 @@ def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
     if rs.preempt is not None:
         # Deterministic targeted-preemption counters, --preempt only.
         out["preempt"] = dict(sorted(rs.preempt.items()))
+    if rs.replicas is not None:
+        # Replicated-control-plane block (schema tputopo.sim/v6,
+        # tputopo.extender.replicas) — present only when the policy ran
+        # sharded; unreplicated reports keep the prior shapes
+        # byte-for-byte.  Fully deterministic (seeded wake schedule,
+        # virtual-time delivery, counter sums).
+        out["replicas"] = rs.replicas
     return out
 
 
@@ -1271,11 +1295,11 @@ def _run_policy_worker(args) -> RunState:
     pinned by tests) so nothing heavyweight crosses the process boundary
     in either direction."""
     (cfg, name, assume_ttl_s, gc_period_s, flight_trace, defrag, chaos,
-     preempt) = args
+     preempt, replicas) = args
     engine = SimEngine(generate_trace(cfg), name,
                        assume_ttl_s=assume_ttl_s, gc_period_s=gc_period_s,
                        flight_trace=flight_trace, defrag=defrag,
-                       chaos=chaos, preempt=preempt)
+                       chaos=chaos, preempt=preempt, replicas=replicas)
     engine.run_events()
     return engine.run_state()
 
@@ -1286,6 +1310,7 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
               defrag: dict | None = None,
               chaos: str | None = None,
               preempt: dict | None = None,
+              replicas: dict | None = None,
               return_states: bool = False):
     """Replay one deterministic trace under each policy and build the
     A/B report.  Every policy sees the identical event stream.
@@ -1319,6 +1344,16 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
     ``tputopo.sim/v4``.  Off (the default) leaves report bytes exactly
     as before.
 
+    ``replicas`` (a knob dict merged over
+    :data:`tputopo.extender.replicas.DEFAULT_REPLICAS`; ``count`` > 1
+    activates) shards the ici policy across N racing extender replicas
+    (seeded wake interleaving, per-replica caches, delayed peer-bind
+    delivery — tputopo.extender.replicas).  The ici policy record gains
+    a deterministic ``replicas`` block (wake/bind distribution, the
+    conflict taxonomy) and the schema becomes ``tputopo.sim/v6``; the
+    knobs land under ``engine.replicas``.  ``count`` <= 1 or None runs
+    the single-scheduler path byte-for-byte.
+
     ``preempt`` (a knob dict merged over :data:`DEFAULT_PREEMPT`, or
     None) turns on targeted preemption + the backfill gate
     (tputopo.priority) in every engine.  A tiered trace (the ``mixed``
@@ -1334,8 +1369,14 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
                     if defrag is not None else None)
     preempt_knobs = ({**DEFAULT_PREEMPT, **preempt}
                      if preempt is not None else None)
+    replica_knobs = None
+    if replicas is not None:
+        knobs = {**DEFAULT_REPLICAS, **replicas}
+        if int(knobs["count"]) > 1:
+            replica_knobs = knobs
     work = [(cfg, name, assume_ttl_s, gc_period_s, flight_trace,
-             defrag_knobs, chaos, preempt_knobs) for name in policy_names]
+             defrag_knobs, chaos, preempt_knobs, replica_knobs)
+            for name in policy_names]
     if jobs > 1 and len(work) > 1:
         import multiprocessing as mp
 
@@ -1380,6 +1421,12 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
         engine_params["chaos"] = FaultPlan(cfg.seed, chaos).describe()
     if preempt_knobs is not None:
         engine_params["preempt"] = dict(sorted(preempt_knobs.items()))
+    if replica_knobs is not None:
+        # The resolved replica knobs — same rule as defrag/chaos/preempt:
+        # two replicated reports differing only in knobs must be
+        # distinguishable; absent on unreplicated runs so prior schema
+        # bytes stay pinned.
+        engine_params["replicas"] = dict(sorted(replica_knobs.items()))
     report = build_report(
         cfg.describe(), horizon, policies,
         engine_params=engine_params,
@@ -1389,6 +1436,7 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
         # class that carries tiers (the tier block appears either way).
         schema_priority=(preempt_knobs is not None
                          or any("tiers" in p for p in policies.values())),
+        schema_replicas=replica_knobs is not None,
         throughput={
             "events": events,  # deterministic
             "wall_s": round(wall_s, 3),

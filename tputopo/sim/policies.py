@@ -130,6 +130,13 @@ class PlacementPolicy:
         """Deterministic observability counters for the report."""
         return {}
 
+    def replicas_block(self) -> dict | None:
+        """The deterministic ``replicas`` report block (wake/bind/conflict
+        distribution across racing scheduler shards), or None for every
+        unreplicated policy — whose report bytes stay pinned by its
+        absence, the same rule as defrag/chaos/tiers."""
+        return None
+
 
 class IciAwarePolicy(PlacementPolicy):
     """The framework under test: sort -> max score -> bind, per member."""
@@ -179,11 +186,22 @@ class IciAwarePolicy(PlacementPolicy):
         else:
             self.sched.invalidate_cached_state()
 
+    def _wake_scheduler(self) -> ExtenderScheduler:
+        """The scheduler serving THIS place() wake.  The single-scheduler
+        base returns its one instance; the replicated subclass picks a
+        racing shard from its seeded wake schedule."""
+        return self.sched
+
+    def _wake_committed(self, decisions: list[dict]) -> None:
+        """Hook after a successful wake's decisions commit — the
+        replicated subclass logs the binds for delayed peer delivery."""
+
     def place(self, job: JobSpec, node_names: list[str],
               handles: list | None = None) -> list[dict] | None:
         self.last_none_reason = "infeasible"
         decisions = []
         sort_explain = None
+        sched = self._wake_scheduler()
         # Chaos: does the extender "die" mid-gang-bind this attempt?  The
         # crash point is drawn up front (deterministic stream position)
         # and hit after ``crash_at`` members are bound.
@@ -205,7 +223,7 @@ class IciAwarePolicy(PlacementPolicy):
             # records are exactly the verb's.  None covers both "no
             # candidate nodes" and "nothing scored positive" — the same
             # infeasible branch either way.
-            best = self.sched.sort_best(pod, node_names)
+            best = sched.sort_best(pod, node_names)
             if self._trace_on and m == 0:
                 # Member 0's sort carries the full per-node breakdown the
                 # whole gang's plan was decided from.
@@ -229,7 +247,7 @@ class IciAwarePolicy(PlacementPolicy):
                         f"(member {m} of {job.replicas})")
                 return None
             try:
-                d = self.sched.bind(pod_name, "default", best["Host"])
+                d = sched.bind(pod_name, "default", best["Host"])
             except BindError as e:
                 # All-or-nothing: the scheduler released any assumptions;
                 # report "does not fit now" to the engine, attributed by
@@ -252,7 +270,18 @@ class IciAwarePolicy(PlacementPolicy):
             self._last_explain = {"policy": self.name,
                                   "sort": sort_explain,
                                   "bind": self.tracer.last_explain}
+        self._wake_committed(decisions)
         return decisions
+
+    def _restart_scheduler(self) -> ExtenderScheduler:
+        """Kill the crashed scheduler instance and stand up its
+        replacement (counters carried so the report sees run totals).
+        The replicated subclass restarts only the ACTIVE shard — the
+        racing peers keep their instances and caches."""
+        for name, v in self.sched.metrics.counters.items():
+            self._counter_carry[name] = self._counter_carry.get(name, 0) + v
+        self.sched = self._make_scheduler()
+        return self.sched
 
     def _crash_restart(self, job: JobSpec,
                        handles: list | None) -> list[dict] | None:
@@ -264,10 +293,8 @@ class IciAwarePolicy(PlacementPolicy):
         the full decision list, reconstructed from API state) or released
         (return None; the engine's reset path requeues it cleanly)."""
         self.fault_plan.record("crash_restart")
-        for name, v in self.sched.metrics.counters.items():
-            self._counter_carry[name] = self._counter_carry.get(name, 0) + v
-        self.sched = self._make_scheduler()
-        self.sched.recover()
+        sched = self._restart_scheduler()
+        sched.recover()
         decisions = []
         for m in range(job.replicas):
             pod_name = f"{job.name}-{m}"
@@ -281,7 +308,7 @@ class IciAwarePolicy(PlacementPolicy):
                 # all-or-nothing holds, the engine requeues.
                 self.last_none_reason = "crash_recovery"
                 return None
-            d = self.sched._replay_decision(pod, pod["spec"]["nodeName"])
+            d = sched._replay_decision(pod, pod["spec"]["nodeName"])
             decisions.append({
                 "pod": pod_name, "node": d["node"], "slice": d["slice"],
                 "chips": [tuple(c) for c in d["chips"]],
@@ -292,6 +319,7 @@ class IciAwarePolicy(PlacementPolicy):
             self._last_explain = {"policy": self.name,
                                   "crash_recovered": True,
                                   "job": job.name}
+        self._wake_committed(decisions)
         return decisions
 
     def explain_last(self) -> dict | None:
@@ -339,6 +367,96 @@ class IciAwarePolicy(PlacementPolicy):
         # Into the LIVE scheduler's Metrics: _merged_counters folds it
         # with the crash carry, so the report sees run totals either way.
         self.sched.metrics.inc(name, by)
+
+
+class ReplicatedIciPolicy(IciAwarePolicy):
+    """The ici policy sharded across N racing ``ExtenderScheduler``
+    replicas over the one API server (tputopo.extender.replicas).  Each
+    wake is served by the replica the seeded :class:`WakeSchedule` picks;
+    every replica keeps its OWN cached derived state, and a peer's binds
+    reach it only after the modeled watch delay — the stale window that
+    makes the ASSUME/ASSIGNED handshake's optimistic concurrency real.
+    Correctness rides the shared-writer bind verb (CAS-guarded claim
+    patch + post-commit claim arbitration), never cache freshness; the
+    engine's own out-of-band mutations broadcast to every replica
+    immediately (they model the job controller, which the engine IS).
+
+    Only the ici policy replicates: the baselines remain single-instance
+    comparators, so the A/B still answers "what does sharding the real
+    extender cost/buy" against an unchanged reference."""
+
+    def __init__(self, api, clock, assume_ttl_s, tracer=None,
+                 fault_plan=None, replicas: dict | None = None,
+                 seed: int = 0) -> None:
+        from tputopo.extender.replicas import DEFAULT_REPLICAS, ReplicaSet
+
+        knobs = {**DEFAULT_REPLICAS, **(replicas or {})}
+        self._rknobs = knobs
+        self._slot = 0  # replica index _make_scheduler is building for
+        super().__init__(api, clock, assume_ttl_s, tracer=tracer,
+                         fault_plan=fault_plan)
+        scheds = [self.sched]
+        for i in range(1, int(knobs["count"])):
+            self._slot = i
+            scheds.append(self._make_scheduler())
+        self.rset = ReplicaSet(
+            scheds, clock=clock, seed=seed,
+            schedule=str(knobs["schedule"]),
+            watch_delay_s=float(knobs["watch_delay_s"]),
+            weights=knobs.get("weights"))
+
+    def _make_scheduler(self) -> ExtenderScheduler:
+        """One replica shard: shared_writers (CAS-guarded binds + claim
+        arbitration, single-owner folds downgraded to COW), a stamped
+        replica identity, and a per-replica retry-jitter seed so racing
+        shards never back off in lockstep."""
+        from tputopo.obs import NULL_TRACER
+
+        return ExtenderScheduler(
+            self.api, ExtenderConfig(assume_ttl_s=self.assume_ttl_s,
+                                     state_cache_s=1e12,
+                                     bind_from_cache=True,
+                                     shared_writers=True,
+                                     replica_id=f"r{self._slot}"),
+            clock=self.clock,
+            tracer=self.tracer if self.tracer is not None else NULL_TRACER,
+            retry_rng=random.Random(0x7E7 + self._slot))
+
+    def _wake_scheduler(self) -> ExtenderScheduler:
+        return self.rset.begin_wake()
+
+    def _wake_committed(self, decisions: list[dict]) -> None:
+        self.rset.note_committed(decisions)
+
+    def _restart_scheduler(self) -> ExtenderScheduler:
+        """Crash-restart the ACTIVE shard only: its peers keep racing
+        with their instances and caches untouched (the robustness core —
+        recovery must reconcile against binds a different replica
+        completed or wiped meanwhile)."""
+        i = self.rset.active
+        old = self.rset.schedulers[i]
+        for name, v in old.metrics.counters.items():
+            self._counter_carry[name] = self._counter_carry.get(name, 0) + v
+        self._slot = i
+        fresh = self.rset.restart_active(self._make_scheduler())
+        if i == 0:
+            self.sched = fresh  # keep the base-class alias (inc_chaos sink)
+        return fresh
+
+    def invalidate(self, events=None) -> None:
+        # Engine truth-keeping writes broadcast to every replica's cache;
+        # only PEER BINDS ride the delayed watch model.
+        self.rset.invalidate_all(events)
+
+    def _merged_counters(self) -> dict:
+        out = dict(self._counter_carry)
+        for s in self.rset.schedulers:
+            for k, v in s.metrics.counters.items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    def replicas_block(self) -> dict | None:
+        return self.rset.block(self._merged_counters())
 
 
 class BaselinePolicy(PlacementPolicy):
@@ -689,8 +807,18 @@ def available_policies() -> list[str]:
 
 
 def get_policy(name: str, api, clock, assume_ttl_s: float,
-               tracer=None, fault_plan=None) -> PlacementPolicy:
+               tracer=None, fault_plan=None, replicas: dict | None = None,
+               seed: int = 0) -> PlacementPolicy:
+    """``replicas`` (a knob dict over
+    :data:`tputopo.extender.replicas.DEFAULT_REPLICAS` with count > 1)
+    shards the ici policy across racing extender replicas; count <= 1 or
+    None keeps the single-scheduler instance byte-for-byte.  Baselines
+    ignore it — they stay the unreplicated comparators."""
     if name == "ici":
+        if replicas is not None and int(replicas.get("count", 1)) > 1:
+            return ReplicatedIciPolicy(api, clock, assume_ttl_s,
+                                       tracer=tracer, fault_plan=fault_plan,
+                                       replicas=replicas, seed=seed)
         return IciAwarePolicy(api, clock, assume_ttl_s, tracer=tracer,
                               fault_plan=fault_plan)
     picker = BASELINE_PICKERS.get(name)

@@ -43,7 +43,14 @@ Schema (``tputopo.sim/v2``)::
                     "preemption_disruption": {"jobs_preempted",
                     "pods_evicted", "chips_moved", "lost_virtual_s"}}},
                                                     # v5 (tiered trace)
-          "preempt": {<targeted-preemption counters>}  # v5 (--preempt)
+          "preempt": {<targeted-preemption counters>},  # v5 (--preempt)
+          "replicas": {"count", "schedule", "watch_delay_s", "wakes",
+                       "binds", "crash_restarts", "peer_binds_delivered",
+                       "sorts", "bind_conflicts",
+                       "conflicts_by_cause": {"lost_race", "stale_cache",
+                                              "ambiguous_timeout"},
+                       "stale_cache_aborts", "foreign_bind_adoptions"}
+                                                    # v6 (--replicas > 1)
         }, ...
       },
       "ab": {"policies": [...], "deltas": {<metric>: a_minus_b},
@@ -94,6 +101,16 @@ SCHEMA_CHAOS = "tputopo.sim/v4"
 #: All v5 content is deterministic virtual-time fact — part of the
 #: byte-determinism contract.
 SCHEMA_PRIORITY = "tputopo.sim/v5"
+#: v6 = the above plus the replicated-control-plane surfaces
+#: (tputopo.extender.replicas): the ici policy's ``replicas`` block
+#: (wake/bind/crash distribution across racing scheduler shards, the
+#: bind-conflict taxonomy by cause, peer-bind delivery counts) and the
+#: ``engine.replicas`` knob record — emitted ONLY when ``--replicas``
+#: shards the control plane (count > 1).  Unreplicated runs keep
+#: emitting the v2..v5 shapes byte-for-byte.  All v6 content is
+#: deterministic (seeded wake schedule, virtual-time watch delivery) —
+#: part of the byte-determinism contract.
+SCHEMA_REPLICAS = "tputopo.sim/v6"
 
 #: The extender counters the report's per-policy ``scheduler`` block
 #: keeps (the ici policy filters its merged Metrics through this — plus
@@ -115,6 +132,13 @@ SCHEDULER_COUNTER_KEEP = (
     # appear (the keep filter is presence-gated), so sim report bytes
     # only move when an extender actually planned preemptions.
     "preempt_plans_considered", "preempt_plans_found",
+    # Replicated control plane (tputopo.extender.replicas): the bind
+    # race taxonomy and recover()'s peer-bind adoptions.  Presence-gated
+    # like the preempt pair — an unreplicated run never increments them,
+    # so every prior schema's bytes stay pinned.
+    "recover_foreign_bind_adopted",
+    "replica_bind_lost_race", "replica_conflict_ambiguous",
+    "replica_stale_cache_aborts",
 )
 
 
@@ -310,9 +334,11 @@ def build_report(trace_desc: dict, horizon_s: float,
                  phase_wall: dict | None = None,
                  schema_defrag: bool = False,
                  schema_chaos: bool = False,
-                 schema_priority: bool = False) -> dict:
+                 schema_priority: bool = False,
+                 schema_replicas: bool = False) -> dict:
     out = {
-        "schema": (SCHEMA_PRIORITY if schema_priority
+        "schema": (SCHEMA_REPLICAS if schema_replicas
+                   else SCHEMA_PRIORITY if schema_priority
                    else SCHEMA_CHAOS if schema_chaos
                    else SCHEMA_DEFRAG if schema_defrag else SCHEMA),
         "trace": trace_desc,
