@@ -600,7 +600,9 @@ def bench_shards(nodes: int = 256, arrivals: int = 2000, seed: int = 0,
     delayed peer-bind delivery): sustained sorts/s, the bind-conflict
     taxonomy, queue-wait p95, and the decision-quality axes vs the
     single-replica stream (``baseline_ref``) — the acceptance check that
-    sharding costs <2 quality points.  The **http leg** is the real
+    sharding costs <2 quality points — plus a pod->replica affinity A/B
+    at the contended counts (4/8), recording the conflict-rate delta
+    hash-sharding the queue buys.  The **http leg** is the real
     thing: N ``python -m tputopo.extender`` server PROCESSES against one
     REST-mocked API server, hammered by a concurrent sort/bind load
     generator — aggregate sorts/s here scales with replica count because
@@ -613,9 +615,15 @@ def bench_shards(nodes: int = 256, arrivals: int = 2000, seed: int = 0,
                             offered_load=0.73)
     sim_leg: dict = {}
     baseline_axes = None
-    for n in counts:
+
+    def sim_rec(n: int, affinity: bool = False) -> dict:
+        knobs = None
+        if n > 1:
+            knobs = {"count": n}
+            if affinity:
+                knobs["affinity"] = True
         rep = run_trace(fleet_cfg, ["ici"], flight_trace=False,
-                        replicas={"count": n} if n > 1 else None)
+                        replicas=knobs)
         p = rep["policies"]["ici"]
         sched = p["scheduler"]
         wall = rep["throughput"]["wall_s"]
@@ -642,6 +650,14 @@ def bench_shards(nodes: int = 256, arrivals: int = 2000, seed: int = 0,
             binds = sched.get("bind_requests", 0)
             rec["bind_conflict_rate"] = round(
                 rb["bind_conflicts"] / binds, 4) if binds else 0.0
+        rec["_axes"] = axes
+        return rec
+
+    axes_by_n: dict[int, dict] = {}
+    for n in counts:
+        rec = sim_rec(n)
+        axes = rec.pop("_axes")
+        axes_by_n[n] = axes
         if baseline_axes is None:
             baseline_axes = axes
         else:
@@ -652,6 +668,28 @@ def bench_shards(nodes: int = 256, arrivals: int = 2000, seed: int = 0,
                 for k in axes
             }
         sim_leg[f"replicas_{n}"] = rec
+    # Pod->replica affinity A/B at the contended counts: hash-sharding
+    # the pending queue should cut the conflict rate where racing is
+    # worst, at unchanged decision quality — the recorded
+    # conflict_rate_delta is (affinity - schedule-rotating), negative
+    # when affinity helps, and the quality deltas vs the rotating leg
+    # make any quality cost visible next to the conflict win.
+    for n in (4, 8):
+        if n not in counts:
+            continue
+        rec = sim_rec(n, affinity=True)
+        aff_axes = rec.pop("_axes")
+        base = sim_leg[f"replicas_{n}"]
+        rec["conflict_rate_delta"] = round(
+            rec.get("bind_conflict_rate", 0.0)
+            - base.get("bind_conflict_rate", 0.0), 4)
+        rec["conflicts_delta"] = (rec.get("bind_conflicts", 0)
+                                  - base.get("bind_conflicts", 0))
+        rec["quality_delta_points_vs_rotating"] = {
+            k: round(abs(aff_axes[k] - axes_by_n[n][k]) * 100, 3)
+            for k in aff_axes
+        }
+        sim_leg[f"replicas_{n}_affinity"] = rec
     out: dict = {
         "trace": {"nodes": nodes, "arrivals": arrivals,
                   "offered_load": 0.73},

@@ -1,0 +1,33 @@
+# lint-corpus-relpath: tputopo/corpus/ownership_bad.py
+"""KNOWN-BAD ownership-flow corpus: in-place mutation reachable from
+shared-writer contexts — a direct fold under a ReplicaSet scheduler, and
+one hidden behind virtual dispatch."""
+
+
+class Scheduler:
+    def apply_events(self, state, events):
+        # BAD: unguarded in-place fold on a scheduler ReplicaSet races
+        return state.fold_inplace(events)
+
+
+class FastScheduler(Scheduler):
+    def apply_events(self, state, events):
+        # BAD: the override reached only through virtual dispatch
+        return state.bind_inplace(events)
+
+
+class ReplicaSet:
+    def __init__(self, schedulers: list[Scheduler]):
+        self.schedulers = list(schedulers)
+
+    def deliver(self, state, events):
+        for s in self.schedulers:
+            s.apply_events(state, events)
+
+
+def start_replicas(make_config, api):
+    cfg = make_config(shared_writers=True)
+    # BAD: a shared-writer construction context handing out the
+    # structural-sharing store
+    server = api(nocopy_writes=True)
+    return cfg, server

@@ -1,0 +1,36 @@
+# lint-corpus-relpath: tputopo/corpus/ownership_ok.py
+"""Corrected ownership-flow corpus: the shared-writer paths fold
+copy-on-write, and the in-place primitive survives only inside the
+sanctioned ``_single_owner`` downgrade branch."""
+
+
+class Scheduler:
+    def __init__(self):
+        self._single_owner = False
+
+    def apply_events(self, state, events):
+        if self._single_owner:
+            # the documented downgrade arm: statically dead under
+            # shared writers, so the closure never traverses it
+            return state.fold_inplace(events)
+        return state.with_events(events)
+
+    def bind(self, state, pa):
+        new = (state.bind_inplace(pa) if self._single_owner
+               else state.with_bind(pa))
+        return new
+
+
+class ReplicaSet:
+    def __init__(self, schedulers: list[Scheduler]):
+        self.schedulers = list(schedulers)
+
+    def deliver(self, state, events):
+        for s in self.schedulers:
+            s.apply_events(state, events)
+
+
+def start_replicas(make_config, api):
+    cfg = make_config(shared_writers=True)
+    server = api(nocopy_writes=False)  # the deepcopy write path
+    return cfg, server

@@ -1,0 +1,39 @@
+# lint-corpus-relpath: tputopo/sim/report.py
+"""KNOWN-BAD schema-additivity corpus (masquerading as the canonical
+report module): a pinned key no builder emits any more, a feature-gated
+key emitted unconditionally, and an inline version literal that never
+became a contract constant."""
+
+SCHEMA = "tputopo.sim/v2"
+
+SCHEMA_KEY_MANIFEST = {
+    "tputopo.sim/v2": {
+        # BAD: 'removed_block' is pinned here but build_report below no
+        # longer emits it — a consumer pinned to v2 just lost a key
+        "top": ("schema", "policies", "removed_block"),
+        "top_gated": ("throughput",),
+        "policy": ("jobs",),
+    },
+}
+
+
+def build_report(policies, throughput=None):
+    out = {
+        "schema": SCHEMA,
+        "policies": policies,
+    }
+    # BAD: 'throughput' is feature-gated in the manifest but emitted
+    # unconditionally — the feature-off report gains the key
+    out["throughput"] = dict(throughput or {})
+    return out
+
+
+class MetricsCollector:
+    def report(self):
+        return {"jobs": 0}
+
+
+def emit_next():
+    # BAD: a new version literal typed inline instead of being routed
+    # through a SCHEMA_* contract constant
+    return {"schema": "tputopo.sim/v9"}

@@ -1,0 +1,34 @@
+# lint-corpus-relpath: tputopo/sim/report.py
+"""Corrected schema-additivity corpus: every emitted key is pinned, the
+gated key is emitted only when its feature ran, and every version string
+is a contract constant."""
+
+SCHEMA = "tputopo.sim/v2"
+SCHEMA_NEXT = "tputopo.sim/v9"
+
+SCHEMA_KEY_MANIFEST = {
+    "tputopo.sim/v2": {
+        "top": ("schema", "policies"),
+        "top_gated": ("throughput",),
+        "policy": ("jobs",),
+    },
+    "tputopo.sim/v9": {"policy_gated": ("replicas",)},
+}
+
+
+def build_report(policies, throughput=None):
+    out = {
+        "schema": SCHEMA,
+        "policies": policies,
+    }
+    if throughput is not None:
+        out["throughput"] = dict(throughput)
+    return out
+
+
+class MetricsCollector:
+    def report(self, replicas=None):
+        out = {"jobs": 0}
+        if replicas is not None:
+            out["replicas"] = replicas
+        return out

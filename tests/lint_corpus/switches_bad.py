@@ -1,0 +1,28 @@
+# lint-corpus-relpath: tputopo/corpus/switches_bad.py
+"""KNOWN-BAD kill-switch-audit corpus: an unregistered switch, a dead
+off-path, a never-read flag, and a switch-guarded counter defeating
+presence gating."""
+
+
+class Engine:
+    # BAD: class-level feature flag with no SWITCH_REGISTRY entry and no
+    # `# kill-switch:` directive
+    ROGUE_FAST_PATH = True
+
+    ORPHAN = True  # kill-switch: registered but wired to nothing  # BAD
+
+    TURBO = True  # kill-switch: demo switch with a dead off-path
+
+    def __init__(self):
+        # the eager seed that defeats presence gating below
+        self._counters = {"turbo_folds": 0}
+
+    def run(self):
+        # BAD: TURBO's only read — no else and nothing after, so the
+        # off-path is dead and byte-identity is unfalsifiable
+        if self.TURBO:
+            # BAD: switch-guarded increment of an eagerly-seeded counter
+            self.inc("turbo_folds")
+
+    def inc(self, name):
+        self._counters[name] = self._counters.get(name, 0) + 1

@@ -1,0 +1,37 @@
+# lint-corpus-relpath: tputopo/corpus/switches_ok.py
+"""Corrected kill-switch-audit corpus: directive-registered switches,
+both branch directions live, counters presence-gated (no eager seed of
+switch-guarded names)."""
+
+
+class Engine:
+    TURBO = True  # kill-switch: the fast fold leg; off = historical path
+
+    def __init__(self):
+        self._counters = {"folds": 0}  # seeded, but never switch-guarded
+
+    def run(self, state, events):
+        if not self.TURBO:
+            return self.slow(state, events)
+        self.inc("turbo_folds")  # lazily counted: off-path bytes unchanged
+        return self.fast(state, events)
+
+    def slow(self, state, events):
+        self.inc("folds")
+        return state
+
+    def fast(self, state, events):
+        self.inc("folds")
+        return state
+
+    def inc(self, name):
+        self._counters[name] = self._counters.get(name, 0) + 1
+
+
+class Store:
+    # Delegation: the class-level switch feeds a registered constructor
+    # switch (the fake API's nocopy_writes), whose reads are audited.
+    NOCOPY = True  # kill-switch: structural-sharing store writes
+
+    def __init__(self, server):
+        self.api = server(nocopy_writes=self.NOCOPY)

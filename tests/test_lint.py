@@ -448,7 +448,9 @@ class TestCli:
         for rule in ("determinism", "clock", "nocopy", "lock",
                      "single-def", "waiver",
                      "lockset", "release-on-all-paths", "effect-purity",
-                     "hot-path-scan"):
+                     "hot-path-scan",
+                     "ownership-flow", "kill-switch-audit",
+                     "schema-additivity"):
             assert rule in res.stdout
 
     def test_select_subset_runs_clean_on_repo(self):
@@ -474,13 +476,14 @@ def test_whole_repo_runs_clean():
     violation or waives it with a reason — never deletes this test."""
     findings, run = run_lint(root=REPO_ROOT)
     assert findings == [], "\n".join(f.render() for f in findings)
-    # the fourteen project checkers were all active
+    # the seventeen project checkers were all active
     assert {c.rule for c in run.checkers} == {
         "determinism", "clock", "nocopy", "lock", "single-def",
         "lock-order", "clock-flow", "nocopy-flow", "except-contract",
         "counter-drift",
         "lockset", "release-on-all-paths", "effect-purity",
-        "hot-path-scan"}
+        "hot-path-scan",
+        "ownership-flow", "kill-switch-audit", "schema-additivity"}
     # every waiver in the tree carries a reason (reasonless ones would be
     # active findings above; this pins the invariant explicitly)
     for mod in run.modules:
@@ -524,22 +527,25 @@ def test_whole_repo_waiver_budget_is_pinned():
         # scheduler _state fallback waivers AND BaselinePolicy.place's
         # invalidate-drop sync, the ROADMAP fleet-scale bottleneck this
         # budget tracked as debt until the baselines folded deltas);
-        # the defrag-period demand listing; and 2 gated
-        # preemption-planning reads.  The GC expiry-scan waiver is
-        # DELETED (fleet hot-path PR): the sweep reads the server's
-        # assignment-key index (list_assignments, O(assignments)) behind
-        # a next-expiry watermark, and the O(store) fallback exists only
-        # for index-less readers bound at construction — no full-store
-        # primitive remains in the sweep's hot-closure code.
-        "hot-path-scan": 4,
+        # the defrag-period demand listing; and the gated preemption-
+        # planning state sync.  The GC expiry-scan waiver was DELETED by
+        # the fleet hot-path PR (list_assignments index + watermark);
+        # the preemption VICTIM-LISTING waiver is DELETED by the
+        # contract-lint PR — _try_preempt reads the same assignment-key
+        # index (every victim holds chips, so its pod carries the
+        # chip-group annotation; plan_preemption's fail-closed default
+        # protects anything outside it), with the whole-store shim only
+        # as the index-less-reader fallback bound at construction.
+        "hot-path-scan": 3,
     }, by_rule
-    # 18 waived findings total (19 before the fleet hot-path PR deleted
-    # the GC expiry-scan waiver; 21 before the incremental-baseline PR
-    # deleted the BaselinePolicy full-drop waiver and collapsed the two
-    # scheduler cache-miss fallbacks onto full_sync's single site): the
-    # waivers above each suppress exactly one finding (none is stale —
-    # core flags unused waivers).
-    assert len(run.waived) == 18, [f.render() for f in run.waived]
+    # 17 waived findings total (18 before the contract-lint PR deleted
+    # the preemption victim-listing waiver; 19 before the fleet
+    # hot-path PR deleted the GC expiry-scan waiver; 21 before the
+    # incremental-baseline PR deleted the BaselinePolicy full-drop
+    # waiver and collapsed the two scheduler cache-miss fallbacks onto
+    # full_sync's single site): the waivers above each suppress exactly
+    # one finding (none is stale — core flags unused waivers).
+    assert len(run.waived) == 17, [f.render() for f in run.waived]
 
 
 # ---- call graph (ISSUE 8 tentpole substrate) ---------------------------------
@@ -1273,11 +1279,14 @@ class TestCliOutputs:
         assert doc["files"] > 100
         assert "lock-order" in doc["rules"] and "clock-flow" in doc["rules"]
         assert "lockset" in doc["rules"] and "hot-path-scan" in doc["rules"]
-        assert len(doc["waived"]) == 18
+        assert "ownership-flow" in doc["rules"]
+        assert "kill-switch-audit" in doc["rules"]
+        assert "schema-additivity" in doc["rules"]
+        assert len(doc["waived"]) == 17
         # rule_version + by_rule: the CI artifact's attribution fields.
         assert doc["rule_version"]["lockset"] >= 1
         assert set(doc["rule_version"]) == set(doc["rules"])
-        assert doc["by_rule"]["hot-path-scan"]["waived"] == 4
+        assert doc["by_rule"]["hot-path-scan"]["waived"] == 3
         assert all(set(v) == {"findings", "waived", "duration_s"}
                    for v in doc["by_rule"].values())
 

@@ -17,8 +17,9 @@ from pathlib import Path
 import pytest
 
 from tputopo.lint import (EffectPurityChecker, HotPathChecker,
-                          LocksetChecker, ReleasePathsChecker,
-                          default_checkers)
+                          KillSwitchChecker, LocksetChecker,
+                          OwnershipFlowChecker, ReleasePathsChecker,
+                          SchemaAdditivityChecker, default_checkers)
 from tputopo.lint.cfg import build_cfg, own_exprs
 from tputopo.lint.core import LintRun
 from tputopo.lint.dataflow import run_forward
@@ -628,6 +629,375 @@ class TestHotPathFixtures:
 
 # ---- the seeded corpus -------------------------------------------------------
 
+# ---- ownership-flow (ISSUE 15) -----------------------------------------------
+
+class TestOwnershipFlowChecker:
+    def check(self, *sources):
+        findings, _ = lint_sources([OwnershipFlowChecker()], *sources)
+        return [f for f in findings if f.rule == "ownership-flow"]
+
+    def test_replicaset_scheduler_direct_inplace_call(self):
+        """The acceptance fixture: a direct in-place call added under a
+        ReplicaSet scheduler is caught."""
+        findings = self.check(("tputopo/x/fix.py", """\
+            class Scheduler:
+                def apply_events(self, state, events):
+                    return state.fold_inplace(events)
+
+            class ReplicaSet:
+                def __init__(self, schedulers: list[Scheduler]):
+                    self.schedulers = list(schedulers)
+        """))
+        assert len(findings) == 1
+        assert "fold_inplace" in findings[0].message
+        assert "Scheduler.apply_events" in findings[0].message
+
+    def test_reachability_through_virtual_dispatch(self):
+        """A base-method call widens to every subclass override — the
+        in-place call hiding in an override is still reached."""
+        findings = self.check(("tputopo/x/fix.py", """\
+            class Base:
+                def fold(self, state, events):
+                    return state.with_events(events)
+
+            class Fast(Base):
+                def fold(self, state, events):
+                    return state.note_bind(events)
+
+            class Driver:
+                def __init__(self, b: Base):
+                    self.b = b
+                    make(shared_writers=True)
+
+                def drive(self, state, events):
+                    return self.b.fold(state, events)
+
+            def make(**kw):
+                return kw
+        """))
+        assert len(findings) == 1
+        assert "note_bind" in findings[0].message
+        assert "Fast.fold" in findings[0].message
+
+    def test_single_owner_guard_prunes_the_downgrade_arm(self):
+        findings = self.check(("tputopo/x/fix.py", """\
+            class Scheduler:
+                def __init__(self):
+                    self._single_owner = False
+
+                def apply_events(self, state, events):
+                    if self._single_owner:
+                        return state.fold_inplace(events)
+                    return state.with_events(events)
+
+            class ReplicaSet:
+                def __init__(self, schedulers: list[Scheduler]):
+                    self.schedulers = list(schedulers)
+        """))
+        assert findings == []
+
+    def test_shared_writer_root_directive(self):
+        findings = self.check(("tputopo/x/fix.py", """\
+            def racer(state, pa):  # shared-writer-root: test rig
+                return state.bind_inplace(pa)
+        """))
+        assert len(findings) == 1
+        assert "bind_inplace" in findings[0].message
+
+    def test_nocopy_writes_construction_in_shared_context(self):
+        findings = self.check(("tputopo/x/fix.py", """\
+            def boot(api, make_config):
+                cfg = make_config(shared_writers=True)
+                return api(nocopy_writes=True), cfg
+        """))
+        assert len(findings) == 1
+        assert "nocopy_writes" in findings[0].message
+
+    def test_single_owner_context_is_out_of_scope(self):
+        """A policy that never constructs a shared-writer world may
+        fold in place (the baselines' whole premise)."""
+        findings = self.check(("tputopo/x/fix.py", """\
+            class Baseline:
+                def place(self, state, events):
+                    return state.fold_inplace(events)
+        """))
+        assert findings == []
+
+    def test_real_replicas_downgrade_path_stays_clean(self):
+        """Regression pin: replicas.py + the scheduler/state/policy
+        stack it drives run ownership-flow CLEAN — the _single_owner
+        downgrade branches are the only in-place reachability, and the
+        rule proves them pruned.  A future unguarded in-place call on
+        any replica path fails here before CI's lint job."""
+        findings = self.check(
+            *[(rel, (REPO_ROOT / rel).read_text())
+              for rel in ("tputopo/extender/replicas.py",
+                          "tputopo/extender/scheduler.py",
+                          "tputopo/extender/state.py",
+                          "tputopo/extender/config.py",
+                          "tputopo/sim/policies.py")])
+        assert findings == [], [f.render() for f in findings]
+
+    def test_real_replicas_closure_is_not_vacuous(self):
+        """The clean verdict above must come from PRUNING, not from the
+        closure missing the scheduler: the shared closure contains the
+        bind/apply_events verbs whose guarded arms hold the in-place
+        calls."""
+        from tputopo.lint.callgraph import graph_for, subclass_overrides
+        from tputopo.lint.core import Module
+        from tputopo.lint.ownership import (OwnershipFlowChecker as OFC,
+                                            _single_owner_guarded_calls)
+
+        mods = [Module.parse(rel, (REPO_ROOT / rel).read_text())
+                for rel in ("tputopo/extender/replicas.py",
+                            "tputopo/extender/scheduler.py",
+                            "tputopo/extender/state.py",
+                            "tputopo/extender/config.py",
+                            "tputopo/sim/policies.py")]
+        graph = graph_for(mods)
+        checker = OFC()
+        roots = checker._roots(graph, {m.relpath: m for m in mods})
+        overrides = subclass_overrides(graph)
+        memo = {}
+
+        def guarded(fn):
+            if fn.key not in memo:
+                memo[fn.key] = _single_owner_guarded_calls(fn.node)
+            return memo[fn.key]
+
+        parent = graph.closure_with_parents(
+            roots, expand=lambda c: overrides.get(c.key, ()),
+            skip_site=lambda fn, s: id(s.node) in guarded(fn))
+        names = {k[1] for k in parent}
+        assert "ExtenderScheduler.apply_events" in names
+        assert "ExtenderScheduler.bind" in names
+        assert "ReplicaSet.deliver" in names
+        # ...and the primitives stayed OUT: that is the proof.
+        assert "ClusterState.fold_inplace" not in names
+        assert "ClusterState.bind_inplace" not in names
+        assert "ClusterState.note_bind" not in names
+
+
+# ---- kill-switch-audit (ISSUE 15) --------------------------------------------
+
+class TestKillSwitchChecker:
+    def check(self, *sources):
+        findings, _ = lint_sources([KillSwitchChecker()], *sources)
+        return [f for f in findings if f.rule == "kill-switch-audit"]
+
+    def test_unregistered_switch_is_flagged(self):
+        findings = self.check(("tputopo/x/fix.py", """\
+            class Engine:
+                FAST = True
+
+                def run(self):
+                    if not self.FAST:
+                        return self.slow()
+                    return 1
+
+                def slow(self):
+                    return 0
+        """))
+        assert len(findings) == 1
+        assert "unregistered" in findings[0].message
+
+    def test_directive_registers_and_both_directions_pass(self):
+        findings = self.check(("tputopo/x/fix.py", """\
+            class Engine:
+                FAST = True  # kill-switch: test switch
+
+                def run(self):
+                    if not self.FAST:
+                        return self.slow()
+                    return 1
+
+                def slow(self):
+                    return 0
+        """))
+        assert findings == []
+
+    def test_dead_off_path_is_flagged(self):
+        """An `if FLAG:` that is the last statement with no else: the
+        off direction does nothing distinguishable — the byte-identity
+        contract is unfalsifiable."""
+        findings = self.check(("tputopo/x/fix.py", """\
+            class Engine:
+                FAST = True  # kill-switch: test switch
+
+                def run(self):
+                    if self.FAST:
+                        return 1
+        """))
+        assert len(findings) == 1
+        assert "one branch direction" in findings[0].message
+
+    def test_never_read_switch_is_flagged(self):
+        findings = self.check(("tputopo/x/fix.py", """\
+            class Engine:
+                FAST = True  # kill-switch: test switch
+        """))
+        assert len(findings) == 1
+        assert "never read" in findings[0].message
+
+    def test_polymorphic_flag_family_is_not_a_switch(self):
+        """Tracer.enabled / NullTracer.enabled: same attr in several
+        classes is dispatch, not a mode switch."""
+        findings = self.check(("tputopo/x/fix.py", """\
+            class Tracer:
+                enabled = True
+
+            class NullTracer:
+                enabled = False
+        """))
+        assert findings == []
+
+    def test_delegation_into_registered_ctor_switch_covers(self):
+        """SimEngine.NOCOPY_WRITES feeds FakeApiServer(nocopy_writes=…)
+        — the ctor switch's reads carry the audit."""
+        findings = self.check(("tputopo/x/fix.py", """\
+            class Store:
+                NOCOPY = True  # kill-switch: structural-sharing writes
+
+                def __init__(self, server):
+                    self.api = server(nocopy_writes=self.NOCOPY)
+        """))
+        assert findings == []
+
+    def test_eagerly_seeded_guarded_counter_is_flagged(self):
+        findings = self.check(("tputopo/x/fix.py", """\
+            class Engine:
+                FAST = True  # kill-switch: test switch
+
+                def __init__(self):
+                    self._counters = {"fast_hits": 0}
+
+                def run(self):
+                    if not self.FAST:
+                        return self.slow()
+                    self.inc("fast_hits")
+                    return 1
+
+                def slow(self):
+                    return 0
+
+                def inc(self, name):
+                    self._counters[name] = 1
+        """))
+        assert len(findings) == 1
+        assert "eagerly seeded" in findings[0].message
+
+    def test_real_registry_round_trips(self):
+        """The shipped registry must exactly cover the tree: all six
+        switches discovered/registered, read, and both-directions live
+        — a new class-level flag needs a registry entry (or directive)
+        in the same PR, and a removed switch must retire its entry."""
+        findings = self.check(
+            *[(rel, (REPO_ROOT / rel).read_text())
+              for rel in ("tputopo/extender/state.py",
+                          "tputopo/extender/scheduler.py",
+                          "tputopo/extender/gc.py",
+                          "tputopo/sim/engine.py",
+                          "tputopo/sim/policies.py",
+                          "tputopo/k8s/fakeapi.py")])
+        assert findings == [], [f.render() for f in findings]
+
+
+# ---- schema-additivity (ISSUE 15) --------------------------------------------
+
+class TestSchemaAdditivityChecker:
+    def check(self, *sources):
+        findings, _ = lint_sources([SchemaAdditivityChecker()], *sources)
+        return [f for f in findings if f.rule == "schema-additivity"]
+
+    def test_removed_manifest_key_is_flagged(self):
+        findings = self.check(("tputopo/sim/report.py", """\
+            SCHEMA = "tputopo.sim/v2"
+
+            SCHEMA_KEY_MANIFEST = {
+                "tputopo.sim/v2": {"top": ("schema", "vanished")},
+            }
+
+            def build_report(policies):
+                out = {"schema": SCHEMA}
+                return out
+        """))
+        assert any("'vanished'" in f.message and "no builder emits"
+                   in f.message for f in findings)
+
+    def test_gated_key_emitted_unconditionally_is_flagged(self):
+        findings = self.check(("tputopo/sim/report.py", """\
+            SCHEMA = "tputopo.sim/v2"
+
+            SCHEMA_KEY_MANIFEST = {
+                "tputopo.sim/v2": {"top": ("schema",),
+                                   "top_gated": ("throughput",)},
+            }
+
+            def build_report(policies, throughput=None):
+                out = {"schema": SCHEMA}
+                out["throughput"] = dict(throughput or {})
+                return out
+        """))
+        assert any("emitted unconditionally" in f.message
+                   for f in findings)
+
+    def test_unmanifested_key_and_inline_version_literal(self):
+        findings = self.check(("tputopo/sim/report.py", """\
+            SCHEMA = "tputopo.sim/v2"
+
+            SCHEMA_KEY_MANIFEST = {
+                "tputopo.sim/v2": {"top": ("schema",)},
+            }
+
+            def build_report(policies):
+                out = {"schema": SCHEMA}
+                out["surprise"] = 1
+                return out
+
+            def next_version():
+                return "tputopo.sim/v9"
+        """))
+        msgs = [f.message for f in findings]
+        assert any("absent from SCHEMA_KEY_MANIFEST" in m for m in msgs)
+        assert any("not routed through the contract constants" in m
+                   for m in msgs)
+
+    def test_formerly_unconditional_key_turning_gated_is_flagged(self):
+        findings = self.check(("tputopo/sim/report.py", """\
+            SCHEMA = "tputopo.sim/v2"
+
+            SCHEMA_KEY_MANIFEST = {
+                "tputopo.sim/v2": {"top": ("schema", "policies")},
+            }
+
+            def build_report(policies=None):
+                out = {"schema": SCHEMA}
+                if policies is not None:
+                    out["policies"] = policies
+                return out
+        """))
+        assert any("removal in disguise" in f.message for f in findings)
+
+    def test_real_manifest_round_trips(self):
+        """The shipped manifest must exactly describe what report.py +
+        engine.py emit: the dead-off-path / removed-key / unmanifested
+        checks all pass on the real builders."""
+        findings = self.check(
+            *[(rel, (REPO_ROOT / rel).read_text())
+              for rel in ("tputopo/sim/report.py",
+                          "tputopo/sim/engine.py")])
+        assert findings == [], [f.render() for f in findings]
+
+    def test_scoped_run_without_engine_builder_stays_quiet(self):
+        """A run holding only report.py must not report engine-emitted
+        policy keys as 'removed' — absence of a builder is scope, not a
+        removal."""
+        findings = self.check(
+            ("tputopo/sim/report.py",
+             (REPO_ROOT / "tputopo/sim/report.py").read_text()))
+        assert findings == [], [f.render() for f in findings]
+
+
 def _corpus_sources(name: str):
     path = CORPUS / name
     text = path.read_text(encoding="utf-8")
@@ -641,6 +1011,9 @@ CORPUS_RULES = [
     ("release-on-all-paths", ReleasePathsChecker, "release"),
     ("effect-purity", EffectPurityChecker, "effects"),
     ("hot-path-scan", HotPathChecker, "hotpath"),
+    ("ownership-flow", OwnershipFlowChecker, "ownership"),
+    ("kill-switch-audit", KillSwitchChecker, "switches"),
+    ("schema-additivity", SchemaAdditivityChecker, "schema"),
 ]
 
 

@@ -419,6 +419,82 @@ def test_replicas_one_and_absent_are_byte_identical():
     assert "replicas" not in off["engine"]
 
 
+def test_wake_schedule_affinity_pins_keys_without_draining_stream():
+    """A keyed wake under affinity goes to its stable crc32 shard and
+    does NOT consume the seeded schedule stream; keyless wakes (and
+    affinity-off schedules) draw exactly the pre-affinity sequence."""
+    from tputopo.extender.replicas import affinity_shard
+
+    aff = WakeSchedule(4, seed=0, mode="rr", affinity=True)
+    plain = WakeSchedule(4, seed=0, mode="rr")
+    assert aff.next_for("job-007") == affinity_shard("job-007", 4)
+    assert aff.next_for("job-007") == aff.next_for("job-007")  # stable
+    # The rr stream is untouched by the keyed draws above.
+    assert [aff.next_for(None) for _ in range(4)] == [0, 1, 2, 3]
+    # Affinity OFF ignores keys entirely — byte-identical scheduling.
+    assert [plain.next_for("job-007"), plain.next_for("x")] == [0, 1]
+    assert "affinity" not in plain.describe()
+    assert aff.describe()["affinity"] is True
+
+
+def test_replica_affinity_sim_deterministic_and_schema_additive():
+    """--replica-affinity: byte-deterministic incl. --jobs 2, marker
+    keys present only when ON, and the conflict taxonomy still sums."""
+    cfg = _cfg()
+    knobs = {"count": 4, "affinity": True}
+    ra = run_trace(cfg, ["ici"], replicas=knobs)
+    rj = run_trace(cfg, ["ici"], replicas=knobs, jobs=2)
+    assert _canon(ra) == _canon(rj)
+    assert ra["schema"] == SCHEMA_REPLICAS
+    assert ra["engine"]["replicas"]["affinity"] is True
+    blk = ra["policies"]["ici"]["replicas"]
+    assert blk["schedule"]["affinity"] is True
+    assert blk["bind_conflicts"] == sum(blk["conflicts_by_cause"].values())
+    jobs = ra["policies"]["ici"]["jobs"]
+    assert jobs["arrived"] == (jobs["completed"] + jobs["ghost_reclaimed"]
+                               + jobs["unplaced_at_end"])
+    # Affinity-off runs carry neither marker — the v6 bytes stay pinned.
+    off = run_trace(cfg, ["ici"], replicas={"count": 4})
+    assert "affinity" not in off["engine"]["replicas"]
+    assert "affinity" not in off["policies"]["ici"]["replicas"]["schedule"]
+    # The point of the feature: hash-sharding the queue must not RAISE
+    # the conflict count on the standard small trace (it cut it 58 -> 44
+    # at the time of writing; pin the direction, not the figure).
+    assert (blk["bind_conflicts"]
+            <= off["policies"]["ici"]["replicas"]["bind_conflicts"])
+
+
+def test_load_generator_affinity_routes_binds_to_hash_shard():
+    """Behavioral pin for the _worker start-shard routing: driven with
+    concurrency=1 (no races, so no conflict retries rotate off-shard),
+    EVERY bound pod's tpu.dev/bound-by must be its crc32 hash shard —
+    a regression to seq-rotation binds ~half the pods elsewhere.  The
+    run record carries the replica_affinity marker; the default stays
+    unmarked."""
+    from tputopo.extender.replicas import affinity_shard
+
+    api, node_objs, _ = stage_nodes(TraceConfig(seed=0, nodes=16,
+                                                arrivals=1))
+    node_names = sorted(n["metadata"]["name"] for n in node_objs)
+    pods = [make_pod(f"load-{i:03d}", chips=1) for i in range(12)]
+    api.create_many("pods", pods)
+    with start_replica_servers(api, 2) as servers:
+        gen = LoadGenerator(servers.urls, node_names, concurrency=1,
+                            replica_affinity=True)
+        res = gen.run(pods, sort_rounds=0)
+    assert res["replica_affinity"] is True
+    assert res["binds_ok"] == len(pods) and res["bind_conflicts"] == 0, res
+    shards = {affinity_shard(p["metadata"]["name"], 2) for p in pods}
+    assert shards == {0, 1}  # the keys actually exercise both replicas
+    for pod in api.list("pods"):
+        anns = pod["metadata"].get("annotations", {})
+        assert anns.get(ko.ANN_GROUP), pod["metadata"]["name"]
+        want = f"r{affinity_shard(pod['metadata']['name'], 2)}"
+        assert anns.get(ko.ANN_BOUND_BY) == want, (
+            pod["metadata"]["name"], anns.get(ko.ANN_BOUND_BY), want)
+    assert not LoadGenerator(servers.urls, node_names).replica_affinity
+
+
 def test_chaos_replica_crashes_hold_invariants_and_determinism():
     """The acceptance gate: replicas crash-restarting mid-gang-bind under
     an API-fault profile end with ZERO invariant violations and zero lost

@@ -38,6 +38,7 @@ import random
 import threading
 import time
 import urllib.request
+import zlib
 
 from tputopo.extender.scheduler import ExtenderScheduler, quantile
 from tputopo.k8s.fakeapi import NotFound
@@ -52,7 +53,20 @@ DEFAULT_REPLICAS = {
     "count": 1,
     "watch_delay_s": 0.5,
     "schedule": "rr",
+    # "affinity": True — pod->replica affinity (hash-shard each pending
+    # pod to a preferred replica) is OPT-IN and deliberately absent from
+    # the defaults: the resolved knob dict lands in the report's
+    # engine.replicas record, and affinity-off runs must keep emitting
+    # the v6 bytes unchanged (the key appears only when the flag does).
 }
+
+
+def affinity_shard(key: str, count: int) -> int:
+    """The preferred replica for a pod/gang key: a stable, seedless
+    crc32 hash (NOT Python's randomized ``hash``), so every racing
+    shard — and every replay, ``--jobs N`` included — agrees on the
+    owner without coordination."""
+    return zlib.crc32(key.encode("utf-8")) % max(1, count)
 
 
 class WakeSchedule:
@@ -66,7 +80,8 @@ class WakeSchedule:
     MODES = ("rr", "weighted")
 
     def __init__(self, count: int, seed: int = 0, mode: str = "rr",
-                 weights: list[float] | None = None) -> None:
+                 weights: list[float] | None = None,
+                 affinity: bool = False) -> None:
         if count < 1:
             raise ValueError(f"need >= 1 replica, got {count}")
         if mode not in self.MODES:
@@ -78,6 +93,7 @@ class WakeSchedule:
         self.count = count
         self.mode = mode
         self.weights = list(weights) if weights is not None else None
+        self.affinity = bool(affinity)
         self._i = 0
         # Distinct entropy tag folded with the trace seed (the FaultPlan
         # construction, stdlib spelling): the wake stream is independent
@@ -104,10 +120,26 @@ class WakeSchedule:
                 return i
         return self.count - 1
 
+    def next_for(self, key: str | None) -> int:
+        """The replica serving the next wake.  Affinity mode pins a
+        keyed wake (a pending pod/gang) to its hash shard — racing
+        shards then mostly stop planning the same pod against the same
+        chips, which is what cuts the conflict rate at high replica
+        counts — WITHOUT consuming the seeded schedule stream (keyless
+        wakes keep drawing from it, and affinity-off behavior is
+        byte-identical to :meth:`next` by construction)."""
+        if self.affinity and key is not None:
+            return affinity_shard(key, self.count)
+        return self.next()
+
     def describe(self) -> dict:
         out: dict = {"mode": self.mode, "count": self.count}
         if self.weights is not None:
             out["weights"] = list(self.weights)
+        if self.affinity:
+            # Presence-gated: affinity-off replicas blocks keep the v6
+            # bytes unchanged.
+            out["affinity"] = True
         return out
 
 
@@ -129,7 +161,8 @@ class ReplicaSet:
     def __init__(self, schedulers: list[ExtenderScheduler], *, clock,
                  seed: int = 0, schedule: str = "rr",
                  watch_delay_s: float = 0.5,
-                 weights: list[float] | None = None) -> None:
+                 weights: list[float] | None = None,
+                 affinity: bool = False) -> None:
         if not schedulers:
             raise ValueError("ReplicaSet needs at least one scheduler")
         for i, s in enumerate(schedulers):
@@ -151,7 +184,8 @@ class ReplicaSet:
         self.clock = clock
         self.watch_delay_s = float(watch_delay_s)
         self.schedule = WakeSchedule(len(schedulers), seed=seed,
-                                    mode=schedule, weights=weights)
+                                    mode=schedule, weights=weights,
+                                    affinity=affinity)
         n = len(schedulers)
         self.wakes = [0] * n
         self.binds = [0] * n
@@ -173,10 +207,11 @@ class ReplicaSet:
 
     # ---- the sim-facing surface -------------------------------------------
 
-    def begin_wake(self) -> ExtenderScheduler:
-        """Pick the replica serving this wake (seeded schedule), deliver
+    def begin_wake(self, key: str | None = None) -> ExtenderScheduler:
+        """Pick the replica serving this wake — the seeded schedule, or
+        the pod/gang ``key``'s hash shard under affinity mode — deliver
         its due peer-bind events, and return its scheduler."""
-        i = self.schedule.next()
+        i = self.schedule.next_for(key)
         self._active = i
         self.wakes[i] += 1
         self.deliver(i)
@@ -361,7 +396,8 @@ class LoadGenerator:
     def __init__(self, urls: list[str], node_names: list[str], *,
                  url_prefix: str = "/tputopo-scheduler",
                  concurrency: int = 8, bind_retries: int = 6,
-                 timeout_s: float = 30.0) -> None:
+                 timeout_s: float = 30.0,
+                 replica_affinity: bool = False) -> None:
         if not urls:
             raise ValueError("need at least one replica URL")
         self.urls = list(urls)
@@ -370,6 +406,12 @@ class LoadGenerator:
         self.concurrency = max(1, concurrency)
         self.bind_retries = max(0, bind_retries)
         self.timeout_s = timeout_s
+        # Pod->replica affinity on the BIND path: each pod's sort+bind
+        # cycle starts at its hash shard (and conflict retries rotate
+        # from there), so racing workers stop piling one pod's bind
+        # race onto arbitrary replicas.  The sort storm stays rotating
+        # — it measures aggregate throughput, not contention.
+        self.replica_affinity = bool(replica_affinity)
         self._lock = threading.Lock()
         self._sort_ms: list[float] = []   # guarded-by: _lock
         self._bind_ms: list[float] = []   # guarded-by: _lock
@@ -429,7 +471,10 @@ class LoadGenerator:
             seq, pod = self._take()
             if pod is None:
                 return
-            url = self.urls[seq % len(self.urls)]
+            start = (affinity_shard(pod["metadata"]["name"],
+                                    len(self.urls))
+                     if self.replica_affinity else seq)
+            url = self.urls[start % len(self.urls)]
             bound = False
             for attempt in range(self.bind_retries + 1):
                 try:
@@ -476,8 +521,9 @@ class LoadGenerator:
                         break
                     # CAS-leg conflict: nothing applied — retry on the
                     # NEXT replica (the conflicting one just proved its
-                    # view stale).
-                    url = self.urls[(seq + attempt + 1) % len(self.urls)]
+                    # view stale), rotating from the pod's start shard.
+                    url = self.urls[(start + attempt + 1)
+                                    % len(self.urls)]
                     continue
                 if "no feasible" in err:
                     # The sorted winner filled up between our sort and our
@@ -540,6 +586,8 @@ class LoadGenerator:
             "concurrency": self.concurrency,
             "pods": len(pods),
         }
+        if self.replica_affinity:
+            out["replica_affinity"] = True
         if sort_rounds > 0:
             self._reset()
             wall = self._run_phase(list(pods) * sort_rounds,

@@ -24,7 +24,10 @@ from tputopo.lint.locks import LockGuardChecker
 from tputopo.lint.lockset import LocksetChecker
 from tputopo.lint.nocopy import NocopyChecker
 from tputopo.lint.nocopyflow import NocopyFlowChecker
+from tputopo.lint.ownership import OwnershipFlowChecker
 from tputopo.lint.releasepaths import ReleasePathsChecker
+from tputopo.lint.schema import SchemaAdditivityChecker
+from tputopo.lint.switches import KillSwitchChecker
 
 __all__ = [
     "Checker", "Finding", "LintRun", "Module",
@@ -34,6 +37,8 @@ __all__ = [
     "LockOrderChecker", "NocopyFlowChecker",
     "LocksetChecker", "ReleasePathsChecker", "EffectPurityChecker",
     "HotPathChecker",
+    "OwnershipFlowChecker", "KillSwitchChecker",
+    "SchemaAdditivityChecker",
     "default_checkers", "run_lint",
 ]
 
@@ -42,8 +47,10 @@ def default_checkers() -> list[Checker]:
     """Fresh instances of every project checker (cross-module checkers
     keep state, so runs must not share instances).  The first five are
     the per-function rules from PR 7; the next five are the whole-program
-    call-graph rules from PR 8; the last four are the path-sensitive
-    dataflow rules (lint/cfg.py + lint/dataflow.py)."""
+    call-graph rules from PR 8; then the four path-sensitive dataflow
+    rules (lint/cfg.py + lint/dataflow.py); the last three are the
+    contract rules from ISSUE 15 (shared-writer ownership flow, the
+    kill-switch registry audit, schema additivity)."""
     return [
         DeterminismChecker(),
         ClockDisciplineChecker(),
@@ -59,6 +66,9 @@ def default_checkers() -> list[Checker]:
         ReleasePathsChecker(),
         EffectPurityChecker(),
         HotPathChecker(),
+        OwnershipFlowChecker(),
+        KillSwitchChecker(),
+        SchemaAdditivityChecker(),
     ]
 
 

@@ -139,6 +139,40 @@ _RULE_DOC: dict[str, tuple[str, str]] = {
         "BaselinePolicy.place's full ClusterState sync after an "
         "invalidate drop is the ROADMAP fleet-scale bottleneck — "
         "waived with the ROADMAP pointer, so the debt is CI-tracked."),
+    "ownership-flow": (
+        "roots: shared_writers=True constructors (and their whole "
+        "class), ReplicaSet methods + the scheduler class its "
+        "`schedulers` annotation names; register more with "
+        "`# shared-writer-root: <reason>`.  The positive branch of a "
+        "`_single_owner` test is the sanctioned downgrade arm — calls "
+        "there are pruned.  waive: `# tpulint: disable=ownership-flow "
+        "-- <reason>` (deliberate test rigs only)",
+        "ExtenderScheduler.bind's bind_inplace and apply_events' "
+        "fold_inplace both sit inside `if self._single_owner:` — the "
+        "closure proves fold_inplace/bind_inplace/note_bind and "
+        "nocopy_writes=True stores unreachable from every replica "
+        "context, so PR 14's runtime refusals are backstops now."),
+    "kill-switch-audit": (
+        "register switches in tputopo/lint/switches.py SWITCH_REGISTRY "
+        "or with `# kill-switch: <reason>` on the assignment; both "
+        "branch directions must stay live (delegating into a "
+        "registered constructor switch counts).  waive: `# tpulint: "
+        "disable=kill-switch-audit -- <reason>`",
+        "ClusterState.FOLD_INPLACE, ExtenderScheduler.SCORE_INDEX, "
+        "AssumptionGC.WATERMARK, SimEngine.NOCOPY_WRITES, "
+        "BaselinePolicy.delta_fold and FakeApiServer's nocopy_writes "
+        "constructor switch are the registered vocabulary; "
+        "SimEngine.NOCOPY_WRITES covers its off-path by delegation "
+        "into the fakeapi constructor switch."),
+    "schema-additivity": (
+        "pin every emitted report key in report.py's "
+        "SCHEMA_KEY_MANIFEST (gated keys under *_gated); route every "
+        "`tputopo.sim/vN` literal through a SCHEMA_* constant.  waive: "
+        "`# tpulint: disable=schema-additivity -- <reason>`",
+        "the v6 replicas block is pinned policy_gated and emitted only "
+        "when `--replicas` sharded the run — removing a v2 key, or "
+        "emitting `defrag` unconditionally, is a finding at the "
+        "manifest pin / emit site."),
 }
 
 
@@ -285,7 +319,8 @@ def main(argv=None) -> int:
         description="Project-contract static analysis "
                     "(determinism / clock / nocopy / lock / single-def + "
                     "whole-program lock-order / clock-flow / nocopy-flow "
-                    "/ except-contract / counter-drift).")
+                    "/ except-contract / counter-drift + ownership-flow "
+                    "/ kill-switch-audit / schema-additivity).")
     parser.add_argument("paths", nargs="*",
                         help="files or directories (default: tputopo/ "
                              "and tests/ under the repo root)")

@@ -46,7 +46,7 @@ from typing import Iterable
 from tputopo.lint.core import Module, dotted_name
 
 __all__ = ["FunctionInfo", "ClassInfo", "CallSite", "CallGraph",
-           "graph_for"]
+           "graph_for", "subclass_overrides"]
 
 
 @dataclass
@@ -552,15 +552,18 @@ class CallGraph:
         return [f for f in self.functions.values()
                 if f.relpath.startswith(prefixes) or f.relpath in files]
 
-    def closure_with_parents(self, roots, expand=None
+    def closure_with_parents(self, roots, expand=None, skip_site=None
                              ) -> dict[tuple, tuple | None]:
         """Forward closure over resolved call edges from ``roots``:
         ``{function key: parent key (None for a root)}`` — the parent
         chain doubles as one example entry path for findings.
         ``expand(callee)`` may return extra FunctionInfos a call also
-        reaches (virtual-dispatch widening).  Shared by the lockset and
-        hot-path-scan root closures so path rendering and reachability
-        can never drift between them."""
+        reaches (virtual-dispatch widening); ``skip_site(caller, site)``
+        — when given — prunes propagation through a call site the
+        analysis has proven unreachable in its context (the ownership
+        rule's sanctioned single-owner downgrade branches).  Shared by
+        the lockset, hot-path-scan and ownership-flow root closures so
+        path rendering and reachability can never drift between them."""
         parent: dict[tuple, tuple | None] = {k: None for k in roots}
         work = list(roots)
         while work:
@@ -571,6 +574,8 @@ class CallGraph:
             targets = []
             for site in self.callees(fn):
                 if site.callee is None:
+                    continue
+                if skip_site is not None and skip_site(fn, site):
                     continue
                 targets.append(site.callee)
                 if expand is not None:
@@ -614,6 +619,35 @@ class CallGraph:
                 if stop is None or not stop(site.caller):
                     work.append(ck)
         return out
+
+
+def subclass_overrides(graph: CallGraph) -> dict[tuple, list]:
+    """``method key -> overriding FunctionInfos in subclasses`` — the
+    virtual-dispatch widening every closure-backed rule shares: a call
+    resolving to a base-class method also reaches every subclass
+    override (the sim's ``policy.place`` polymorphism is precisely how
+    an expensive or forbidden path hides from a naive closure).
+    Memoized on the graph so hot-path-scan and ownership-flow pay one
+    build between them."""
+    got = getattr(graph, "_overrides_memo", None)
+    if got is not None:
+        return got
+    by_class: dict[tuple, list[ClassInfo]] = {}
+    for ci in graph.classes.values():
+        for b in ci.mro()[1:]:
+            by_class.setdefault(b.key, []).append(ci)
+    out: dict[tuple, list] = {}
+    for ci_key, subs in by_class.items():
+        base = graph.classes.get(ci_key)
+        if base is None:
+            continue
+        for name, meth in base.methods.items():
+            overrides = [s.methods[name] for s in subs
+                         if name in s.methods]
+            if overrides:
+                out.setdefault(meth.key, []).extend(overrides)
+    graph._overrides_memo = out
+    return out
 
 
 #: One-entry build cache: every graph-backed checker in a run sees the

@@ -30,7 +30,9 @@ from tputopo.lint.core import Checker, Finding, Module
 #: The repository's contract constants: (canonical module, constant names).
 DEFAULT_CANON: tuple[tuple[str, tuple[str, ...]], ...] = (
     ("tputopo/sim/report.py",
-     ("SCHEMA", "SCHEMA_DEFRAG", "SCHEMA_CHAOS", "SCHEDULER_COUNTER_KEEP")),
+     ("SCHEMA", "SCHEMA_DEFRAG", "SCHEMA_CHAOS", "SCHEMA_PRIORITY",
+      "SCHEMA_REPLICAS", "SCHEMA_KEY_MANIFEST",
+      "SCHEDULER_COUNTER_KEEP")),
     ("tputopo/extender/server.py", ("_PREFIX",)),
 )
 

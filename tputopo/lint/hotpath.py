@@ -37,8 +37,8 @@ import ast
 import re
 from typing import Iterable
 
-from tputopo.lint.callgraph import (CallGraph, ClassInfo, FunctionInfo,
-                                    graph_for)
+from tputopo.lint.callgraph import (CallGraph, FunctionInfo, graph_for,
+                                    subclass_overrides)
 from tputopo.lint.core import Checker, Finding, Module
 
 _ROOT_RE = re.compile(r"#\s*hot-path-root:\s*(?P<reason>.*\S)")
@@ -106,29 +106,9 @@ class HotPathChecker(Checker):
                 roots[fn.key] = f"declared: {m.group('reason')}"
         return roots
 
-    @staticmethod
-    def _subclass_overrides(graph: CallGraph) -> dict[tuple, list]:
-        """method key -> overriding FunctionInfos in subclasses (virtual
-        dispatch widening)."""
-        by_class: dict[tuple, list[ClassInfo]] = {}
-        for ci in graph.classes.values():
-            for b in ci.mro()[1:]:
-                by_class.setdefault(b.key, []).append(ci)
-        out: dict[tuple, list] = {}
-        for ci_key, subs in by_class.items():
-            base = graph.classes.get(ci_key)
-            if base is None:
-                continue
-            for name, meth in base.methods.items():
-                overrides = [s.methods[name] for s in subs
-                             if name in s.methods]
-                if overrides:
-                    out.setdefault(meth.key, []).extend(overrides)
-        return out
-
     def _closure(self, graph: CallGraph, roots: dict[tuple, str]
                  ) -> dict[tuple, tuple | None]:
-        overrides = self._subclass_overrides(graph)
+        overrides = subclass_overrides(graph)  # shared widening memo
         return graph.closure_with_parents(
             roots, expand=lambda callee: overrides.get(callee.key, ()))
 

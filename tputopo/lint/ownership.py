@@ -1,0 +1,345 @@
+"""The ``ownership-flow`` checker: in-place state mutation is unreachable
+from every shared-writer context.
+
+PR 13's single-owner fast paths (``ClusterState.fold_inplace`` /
+``bind_inplace`` / ``note_bind``, the fake API's structural-sharing
+``nocopy_writes`` write path) are only sound when the caller provably
+holds the ONLY reference to the mutated state and is the sole writer of
+assignments.  PR 14's replicated control plane voids that premise —
+racing peers commit binds this cache never sees — and guards it at
+runtime: ``ExtenderScheduler._single_owner`` downgrades folds to
+copy-on-write, and ``ReplicaSet`` refuses miswired schedulers at
+construction.  This rule turns the premise into a lint-time proof, with
+those runtime checks demoted to backstops:
+
+- **Shared-writer roots** are (1) any ``def`` whose body constructs a
+  shared-writer world — a call carrying a literal ``shared_writers=True``
+  keyword (``start_replica_servers``, the sim's replicated-shard
+  factory); (2) every method of a ``ReplicaSet`` class and of the
+  scheduler class its ``schedulers`` parameter annotation names (the
+  "ReplicaSet-constructed schedulers" — ``ExtenderScheduler`` runs in
+  BOTH worlds, so its whole surface must be safe under the shared one);
+  (3) any function that constructs a ``ReplicaSet``; (4) any ``def``
+  carrying a ``# shared-writer-root: <reason>`` directive.
+- The **shared closure** is everything reachable from a root through the
+  call graph, virtual dispatch widened (a call into a base method also
+  reaches every subclass override), MINUS call sites inside the positive
+  branch of a ``_single_owner`` test — the documented downgrade guard:
+  on a shared-writer path that branch is statically dead, and pruning it
+  is precisely what makes the proof non-vacuous for code that serves
+  both worlds.
+- **In-place primitives** are flagged at their call sites inside the
+  closure: ``fold_inplace`` / ``bind_inplace`` / ``note_bind`` (resolved
+  or by their unambiguous attribute names) and any call passing a
+  literal ``nocopy_writes=True`` (handing racing writers a structural-
+  sharing store).  A method calling a sibling primitive of its OWN class
+  is exempt — that is the primitive's implementation (``bind_inplace``
+  delegating to ``note_bind``), not an ownership violation.
+
+Every finding names the entry path from its shared-writer root.  There
+is deliberately no amortization story here: an in-place mutation under a
+racing writer is a correctness bug, never a perf trade — waive only for
+deliberate test rigs, with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from tputopo.lint.callgraph import (CallGraph, FunctionInfo, graph_for,
+                                    subclass_overrides)
+from tputopo.lint.core import Checker, Finding, Module
+
+_ROOT_RE = re.compile(r"#\s*shared-writer-root:\s*(?P<reason>.*\S)")
+
+#: Attribute names of the single-owner in-place mutation primitives —
+#: unambiguous in this codebase, so an unresolved ``state.fold_inplace``
+#: still counts (the call graph cannot type every local).
+INPLACE_ATTRS = frozenset({"fold_inplace", "bind_inplace", "note_bind"})
+
+#: The keyword that turns on the fake API's structural-sharing write
+#: path; a shared-writer context constructing one hands every racing
+#: writer the same mutable store incarnations.
+NOCOPY_WRITES_KW = "nocopy_writes"
+
+#: The attribute/property spelling of the sanctioned runtime downgrade
+#: guard: a call site inside the POSITIVE branch of a test reading it is
+#: the single-owner arm, statically dead under shared writers.
+SINGLE_OWNER_GUARD = "_single_owner"
+
+#: The class that assembles racing schedulers; its methods, its
+#: construction sites, and the scheduler class its ``schedulers``
+#: parameter annotation names are all shared-writer roots.
+REPLICA_SET_CLASS = "ReplicaSet"
+
+
+def _guard_names(expr: ast.AST) -> set[str]:
+    """Bare/attribute names a test expression reads (``self._single_owner``
+    -> ``_single_owner``)."""
+    out: set[str] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute):
+            out.add(node.attr)
+        elif isinstance(node, ast.Name):
+            out.add(node.id)
+    return out
+
+
+def _terminates(body: list) -> bool:
+    return bool(body) and isinstance(body[-1], (ast.Return, ast.Raise,
+                                                ast.Continue, ast.Break))
+
+
+def _single_owner_guarded_calls(fn_node: ast.AST) -> set[int]:
+    """ids of Call nodes on the SINGLE-OWNER side of an
+    ``if ... _single_owner ...:`` test (or a ternary) — the documented
+    downgrade arm the shared closure must not traverse.  Polarity-aware:
+    a plain test guards its body (and ternary body arm); a negated test
+    (``if not ... _single_owner ...:``) guards its orelse (ternary
+    orelse arm) — and, when the negated body terminates (the
+    early-return downgrade idiom ``if not self._single_owner: return
+    state.with_events(...)``), the sibling statements after the ``if``
+    as well.  The SHARED arm is always analyzed: an in-place call under
+    ``if not self._single_owner:`` is flagged, never pruned."""
+    guarded: set[int] = set()
+
+    def mark(node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                guarded.add(id(sub))
+
+    def negated(test: ast.AST) -> bool:
+        return isinstance(test, ast.UnaryOp) \
+            and isinstance(test.op, ast.Not)
+
+    def visit_block(body: list) -> None:
+        for i, sub in enumerate(body):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.ClassDef)):
+                continue  # nested scopes are their own functions
+            if isinstance(sub, ast.If) \
+                    and SINGLE_OWNER_GUARD in _guard_names(sub.test):
+                if negated(sub.test):
+                    for s in sub.orelse:
+                        mark(s)
+                    visit_block(sub.body)  # the shared arm: analyze
+                    if _terminates(sub.body):
+                        for s in body[i + 1:]:
+                            mark(s)
+                        return
+                else:
+                    for s in sub.body:
+                        mark(s)
+                    visit_block(sub.orelse)  # the shared arm: analyze
+                continue
+            visit_expr(sub)
+            for field in ("body", "orelse", "finalbody"):
+                inner = getattr(sub, field, None)
+                if isinstance(inner, list):
+                    visit_block(inner)
+            for h in getattr(sub, "handlers", ()) or ():
+                visit_block(h.body)
+
+    def visit_expr(stmt: ast.AST) -> None:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(sub, ast.IfExp) \
+                    and SINGLE_OWNER_GUARD in _guard_names(sub.test):
+                mark(sub.orelse if negated(sub.test) else sub.body)
+
+    visit_block(list(getattr(fn_node, "body", [])))
+    return guarded
+
+
+def _annotation_element_class(graph: CallGraph, fn: FunctionInfo,
+                              param: str):
+    """The repo class named by a ``list[X]`` / ``Sequence[X]`` / bare
+    ``X`` annotation on ``param`` of ``fn`` (the ReplicaSet constructor's
+    ``schedulers``), or None."""
+    scope = graph.scopes.get(fn.relpath)
+    if scope is None:
+        return None
+    a = fn.node.args
+    for p in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+        if p.arg != param or p.annotation is None:
+            continue
+        ann = p.annotation
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(ann, ast.Subscript):
+            ann = ann.slice  # list[X] -> X
+        return graph._resolve_class_expr(ann, scope)
+    return None
+
+
+class OwnershipFlowChecker(Checker):
+    rule = "ownership-flow"
+    description = ("in-place mutation primitives (ClusterState."
+                   "fold_inplace/bind_inplace/note_bind, nocopy_writes "
+                   "stores) must be unreachable from every shared-writer "
+                   "context (shared_writers=True constructors, ReplicaSet "
+                   "schedulers, # shared-writer-root: defs) outside the "
+                   "sanctioned _single_owner downgrade branches")
+
+    version = 1
+
+    def __init__(self) -> None:
+        self._mods: list[Module] = []
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(("tputopo/", "tests/"))
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        self._mods.append(mod)
+        return ()
+
+    # ---- roots -------------------------------------------------------------
+
+    def _roots(self, graph: CallGraph, by_path) -> dict[tuple, str]:
+        roots: dict[tuple, str] = {}
+        overrides = subclass_overrides(graph)
+        replica_classes = [ci for ci in graph.classes.values()
+                           if ci.qualname.rsplit(".", 1)[-1]
+                           == REPLICA_SET_CLASS
+                           and ci.relpath.startswith("tputopo/")]
+        sched_classes = []
+        for ci in replica_classes:
+            for meth in ci.methods.values():
+                roots.setdefault(meth.key, "ReplicaSet method")
+            init = ci.methods.get("__init__")
+            if init is not None:
+                sc = _annotation_element_class(graph, init, "schedulers")
+                if sc is not None:
+                    sched_classes.append(sc)
+        for sc in sched_classes:
+            for meth in sc.methods.values():
+                roots.setdefault(meth.key,
+                                 f"ReplicaSet-driven {sc.qualname}")
+                # Subclass overrides of a racing scheduler's verbs race
+                # exactly the same way.
+                for ov in overrides.get(meth.key, ()):
+                    roots.setdefault(ov.key,
+                                     f"ReplicaSet-driven {sc.qualname} "
+                                     "override")
+        replica_inits = {ci.methods["__init__"].key
+                         for ci in replica_classes
+                         if "__init__" in ci.methods}
+        for fn in graph.functions.values():
+            if not fn.relpath.startswith("tputopo/"):
+                continue
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                context = None
+                if any(kw.arg == "shared_writers"
+                       and isinstance(kw.value, ast.Constant)
+                       and kw.value.value is True
+                       for kw in node.keywords):
+                    context = "constructs shared_writers=True"
+                callee = graph.resolve(node, fn)
+                if callee is not None and callee.key in replica_inits:
+                    context = "constructs ReplicaSet"
+                if context is None:
+                    continue
+                roots.setdefault(fn.key, context)
+                # A METHOD assembling a shared-writer world makes its
+                # whole class a shared-writer context: every verb of
+                # that class (inherited surface included) runs against
+                # the racing schedulers it built — the replicated sim
+                # policy's place() drives the shard _make_scheduler
+                # constructed.  Sibling subclasses are NOT pulled in:
+                # they are different deployment contexts.
+                if fn.cls is not None:
+                    for c in fn.cls.mro():
+                        for meth in c.methods.values():
+                            roots.setdefault(
+                                meth.key,
+                                f"method of shared-writer class "
+                                f"{fn.cls.qualname}")
+            mod = by_path.get(fn.relpath)
+            if mod is not None and "shared-writer-root" in mod.source:
+                m = _ROOT_RE.search(mod.comment_on_or_above(fn.node.lineno))
+                if m is not None:
+                    roots[fn.key] = f"declared: {m.group('reason')}"
+        return roots
+
+    # ---- the analysis ------------------------------------------------------
+
+    def _primitive(self, graph: CallGraph, fn: FunctionInfo,
+                   call: ast.Call) -> str | None:
+        """A display name when ``call`` is an in-place primitive the
+        shared closure must never reach."""
+        for kw in call.keywords:
+            if kw.arg == NOCOPY_WRITES_KW \
+                    and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is True:
+                return "nocopy_writes=True construction"
+        attr = call.func.attr if isinstance(call.func, ast.Attribute) \
+            else None
+        callee = graph.resolve(call, fn)
+        name = None
+        if callee is not None:
+            meth = callee.qualname.rsplit(".", 1)[-1]
+            if meth in INPLACE_ATTRS:
+                name = meth
+                # Internal delegation: the primitive's own class calling
+                # a sibling primitive IS the implementation.
+                if fn.cls is not None and callee.cls is not None \
+                        and callee.cls.key in {c.key for c in fn.cls.mro()}:
+                    return None
+        if name is None and attr in INPLACE_ATTRS:
+            name = attr
+            if fn.cls is not None:
+                own = fn.cls.find_method(attr)
+                if own is not None:
+                    return None  # self/sibling delegation, unresolved form
+        return f"{name}()" if name else None
+
+    def finalize(self) -> Iterable[Finding]:
+        mods, self._mods = self._mods, []
+        graph = graph_for(mods)
+        by_path = {m.relpath: m for m in mods}
+        roots = self._roots(graph, by_path)
+        if not roots:
+            return
+        overrides = subclass_overrides(graph)
+        guarded_memo: dict[tuple, set[int]] = {}
+
+        def guarded(fn: FunctionInfo) -> set[int]:
+            got = guarded_memo.get(fn.key)
+            if got is None:
+                got = guarded_memo[fn.key] = \
+                    _single_owner_guarded_calls(fn.node)
+            return got
+
+        parent = graph.closure_with_parents(
+            roots,
+            expand=lambda callee: overrides.get(callee.key, ()),
+            skip_site=lambda fn, site: id(site.node) in guarded(fn))
+        for key in sorted(parent):
+            fn = graph.functions.get(key)
+            if fn is None or not fn.relpath.startswith("tputopo/"):
+                continue
+            dead = guarded(fn)
+            for site in graph.callees(fn):
+                if id(site.node) in dead:
+                    continue  # the sanctioned single-owner downgrade arm
+                prim = self._primitive(graph, fn, site.node)
+                if prim is None:
+                    continue
+                via = graph.render_entry_path(parent, key)
+                yield Finding(
+                    fn.relpath, site.node.lineno, site.node.col_offset,
+                    self.rule,
+                    f"in-place mutation {prim} reachable from a "
+                    f"shared-writer context ({via}) — racing writers "
+                    "void the single-owner premise; use the "
+                    "copy-on-write twin (with_events/with_bind) or "
+                    "guard the call with the _single_owner downgrade")

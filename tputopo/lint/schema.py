@@ -1,0 +1,333 @@
+"""The ``schema-additivity`` checker: report schemas only ever grow.
+
+The sim report's versioning contract (v2 -> v6) is strict additivity:
+every schema version emits a superset of the prior one, new keys are
+feature-gated (present only when their feature ran, so each feature-off
+path stays byte-identical to the prior schema), and the version strings
+themselves are single-definition contract literals.  Until now that was
+enforced only dynamically — full-trace byte-identity replays in CI.
+This rule proves the structural half statically:
+
+- The **manifest** (``tputopo/sim/report.py`` ``SCHEMA_KEY_MANIFEST``)
+  pins, per schema version, the top-level report keys and per-policy
+  record keys, split into unconditional and feature-gated sets.
+- The **extraction** reads the key-sets the builders actually emit from
+  their ASTs: the dict literal a builder returns (or assigns and
+  returns) gives the unconditional keys; ``out["key"] = ...`` subscript
+  stores give gated keys when under a conditional, unconditional ones
+  otherwise.  Builders: ``build_report`` (top), ``MetricsCollector.
+  report`` and ``sim/engine.py::finalize_run_state`` (policy).
+- **Findings**: a manifest key no builder emits any more (a removed key
+  breaks every consumer pinned to its version); a feature-gated key
+  emitted unconditionally (the feature-off report gains the key — the
+  byte-identity contract breaks silently); a formerly-unconditional key
+  now emitted only behind a condition (removed from feature-off
+  reports); an emitted key absent from the manifest (additive changes
+  extend the manifest in the same PR, in front of review); and any
+  version-SHAPED literal (``tputopo.sim/vN``) whose value is not one of
+  the canonical constants — the single-def rule already flags duplicates
+  of the defined versions, so this closes the gap it cannot see: a NEW
+  version string typed inline instead of being routed through
+  ``report.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from tputopo.lint.core import Checker, Finding, Module
+
+_VERSION_RE = re.compile(r"tputopo\.sim/v\d+\Z")
+
+#: The canonical report module: schema constants + the key manifest.
+REPORT_MODULE = "tputopo/sim/report.py"
+
+#: (relpath, function qualname, category) — where report keys are born.
+DEFAULT_BUILDERS: tuple[tuple[str, str, str], ...] = (
+    ("tputopo/sim/report.py", "build_report", "top"),
+    ("tputopo/sim/report.py", "MetricsCollector.report", "policy"),
+    ("tputopo/sim/engine.py", "finalize_run_state", "policy"),
+)
+
+MANIFEST_NAME = "SCHEMA_KEY_MANIFEST"
+
+
+class _Emit:
+    __slots__ = ("key", "category", "relpath", "line", "gated", "gate")
+
+    def __init__(self, key, category, relpath, line, gated, gate=None):
+        self.key = key
+        self.category = category
+        self.relpath = relpath
+        self.line = line
+        self.gated = gated
+        #: (id of the innermost gating If, arm) — lets the extractor
+        #: recognize a key emitted on BOTH arms of one if/else as
+        #: unconditional (every path emits it), not feature-gated.
+        self.gate = gate
+
+
+def _function_node(mod: Module, qualname: str) -> ast.AST | None:
+    parts = qualname.split(".")
+    body = getattr(mod.tree, "body", [])
+    node = None
+    for part in parts:
+        node = next((n for n in body
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef, ast.ClassDef))
+                     and n.name == part), None)
+        if node is None:
+            return None
+        body = node.body
+    return node if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) else None
+
+
+def _returned_name(fn: ast.AST) -> str | None:
+    """The Name a builder ultimately returns (``return out``), so only
+    ITS dict literal / subscript stores count as emissions."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and isinstance(node.value,
+                                                       ast.Name):
+            return node.value.id
+    return None
+
+
+class SchemaAdditivityChecker(Checker):
+    rule = "schema-additivity"
+    description = ("report schemas are strictly additive: the key-sets "
+                   "the sim report builders emit must match report.py's "
+                   "pinned SCHEMA_KEY_MANIFEST (no removed keys, "
+                   "feature-gated keys never emitted unconditionally) "
+                   "and every tputopo.sim/vN literal must be one of the "
+                   "canonical schema constants")
+
+    version = 1
+
+    def __init__(self, builders=DEFAULT_BUILDERS,
+                 report_module: str = REPORT_MODULE) -> None:
+        self.builders = tuple(builders)
+        self.report_module = report_module
+        self._mods: list[Module] = []
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("tputopo/")
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        self._mods.append(mod)
+        return ()
+
+    # ---- manifest + constants ----------------------------------------------
+
+    def _canon(self, mod: Module):
+        """(version values, manifest literal, manifest key lines)."""
+        versions: set[str] = set()
+        manifest = None
+        key_lines: dict[tuple[str, str, str], int] = {}
+        for node in getattr(mod.tree, "body", []):
+            if not isinstance(node, ast.Assign):
+                continue
+            names = [t.id for t in node.targets
+                     if isinstance(t, ast.Name)]
+            if not names:
+                continue
+            if isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str) \
+                    and _VERSION_RE.match(node.value.value):
+                versions.add(node.value.value)
+            if MANIFEST_NAME in names and isinstance(node.value, ast.Dict):
+                try:
+                    manifest = ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    manifest = None
+                else:
+                    self._manifest_key_lines(node.value, key_lines)
+        return versions, manifest, key_lines
+
+    @staticmethod
+    def _manifest_key_lines(dict_node: ast.Dict, out: dict) -> None:
+        """(version, bucket, key) -> line inside the manifest literal,
+        so removed-key findings point at the stale pin itself."""
+        for vk, vv in zip(dict_node.keys, dict_node.values):
+            if not (isinstance(vk, ast.Constant)
+                    and isinstance(vv, ast.Dict)):
+                continue
+            for bk, bv in zip(vv.keys, vv.values):
+                if not (isinstance(bk, ast.Constant)
+                        and isinstance(bv, (ast.Tuple, ast.List))):
+                    continue
+                for el in bv.elts:
+                    if isinstance(el, ast.Constant):
+                        out[(vk.value, bk.value, el.value)] = el.lineno
+
+    # ---- builder extraction ------------------------------------------------
+
+    def _extract(self, mod: Module, qualname: str,
+                 category: str) -> list[_Emit]:
+        fn = _function_node(mod, qualname)
+        if fn is None:
+            return []
+        out_name = _returned_name(fn)
+        emits: list[_Emit] = []
+
+        def visit(body: list, gated: bool, gate) -> None:
+            for node in body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                if isinstance(node, ast.Return) \
+                        and isinstance(node.value, ast.Dict):
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str):
+                            emits.append(_Emit(k.value, category,
+                                               mod.relpath, k.lineno,
+                                               gated, gate))
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == out_name \
+                                and isinstance(node.value, ast.Dict):
+                            for k in node.value.keys:
+                                if isinstance(k, ast.Constant) \
+                                        and isinstance(k.value, str):
+                                    emits.append(_Emit(
+                                        k.value, category, mod.relpath,
+                                        k.lineno, gated, gate))
+                        if isinstance(t, ast.Subscript) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == out_name \
+                                and isinstance(t.slice, ast.Constant) \
+                                and isinstance(t.slice.value, str):
+                            emits.append(_Emit(t.slice.value, category,
+                                               mod.relpath, t.lineno,
+                                               gated, gate))
+                if isinstance(node, ast.If):
+                    visit(node.body, True, (id(node), "body"))
+                    visit(node.orelse, True, (id(node), "orelse"))
+                elif isinstance(node, (ast.For, ast.While, ast.With,
+                                       ast.Try)):
+                    visit(getattr(node, "body", []), gated, gate)
+                    visit(getattr(node, "orelse", []), gated, gate)
+                    visit(getattr(node, "finalbody", []), gated, gate)
+                    for h in getattr(node, "handlers", ()) or ():
+                        visit(h.body, gated, gate)
+
+        visit(fn.body, False, None)
+        # A key emitted on BOTH arms of the SAME if/else reaches every
+        # path through that statement — it is unconditional, not
+        # feature-gated (an `if compact: out[k] = a else: out[k] = b`
+        # refactor must not read as gating the key).
+        by_key: dict[str, list[_Emit]] = {}
+        for e in emits:
+            by_key.setdefault(e.key, []).append(e)
+        for es in by_key.values():
+            arms_by_if: dict[int, set[str]] = {}
+            for e in es:
+                if e.gate is not None:
+                    arms_by_if.setdefault(e.gate[0], set()).add(e.gate[1])
+            both = {if_id for if_id, arms in arms_by_if.items()
+                    if arms == {"body", "orelse"}}
+            for e in es:
+                if e.gate is not None and e.gate[0] in both:
+                    e.gated = False
+        return emits
+
+    # ---- the analysis ------------------------------------------------------
+
+    def finalize(self) -> Iterable[Finding]:
+        mods, self._mods = self._mods, []
+        by_path = {m.relpath: m for m in mods}
+        report_mod = by_path.get(self.report_module)
+        if report_mod is None:
+            return  # canonical module not in this run's file set
+        versions, manifest, key_lines = self._canon(report_mod)
+        emits: list[_Emit] = []
+        complete: dict[str, bool] = {}
+        for rel, qual, category in self.builders:
+            mod = by_path.get(rel)
+            complete[category] = complete.get(category, True) \
+                and mod is not None
+            if mod is not None:
+                emits.extend(self._extract(mod, qual, category))
+        if manifest is not None:
+            yield from self._diff(manifest, key_lines, emits, complete)
+        # Version-literal routing: a version-shaped string whose value is
+        # NOT a canonical constant (single-def owns exact duplicates of
+        # the defined ones; this catches a NEW version typed inline).
+        for mod in mods:
+            for node in mod.nodes():
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and _VERSION_RE.match(node.value) \
+                        and node.value not in versions:
+                    yield Finding(
+                        mod.relpath, node.lineno, node.col_offset,
+                        self.rule,
+                        f"schema version literal {node.value!r} is not "
+                        "routed through the contract constants in "
+                        f"{self.report_module} — define SCHEMA_<NAME> "
+                        "there (and extend SCHEMA_KEY_MANIFEST) first")
+
+    def _diff(self, manifest: dict, key_lines: dict, emits: list[_Emit],
+              complete: dict[str, bool]) -> Iterable[Finding]:
+        emitted: dict[tuple[str, str], list[_Emit]] = {}
+        for e in emits:
+            emitted.setdefault((e.category, e.key), []).append(e)
+        manifest_keys: dict[tuple[str, str], tuple[str, bool]] = {}
+        for version in sorted(manifest):
+            buckets = manifest[version]
+            for bucket, gated in (("top", False), ("top_gated", True),
+                                  ("policy", False),
+                                  ("policy_gated", True)):
+                category = "top" if bucket.startswith("top") else "policy"
+                for key in buckets.get(bucket, ()):
+                    manifest_keys.setdefault((category, key),
+                                             (version, gated))
+        for (category, key), (version, gated) in sorted(
+                manifest_keys.items()):
+            got = emitted.get((category, key))
+            first_bucket = (f"{category}_gated" if gated else category)
+            line = key_lines.get((version, first_bucket, key), 1)
+            if not got:
+                if not complete.get(category, False):
+                    # A builder of this category is outside this run's
+                    # file set (a scoped CLI run) — "not emitted" would
+                    # be an artifact of the scope, not a removal.
+                    continue
+                yield Finding(
+                    self.report_module, line, 0, self.rule,
+                    f"schema key '{key}' ({category}, {version}) is "
+                    "pinned in SCHEMA_KEY_MANIFEST but no builder emits "
+                    "it — schema versions are strictly additive; a "
+                    "removed key breaks every consumer pinned to "
+                    f"{version}")
+                continue
+            if gated:
+                for e in got:
+                    if not e.gated:
+                        yield Finding(
+                            e.relpath, e.line, 0, self.rule,
+                            f"feature-gated schema key '{key}' "
+                            f"({version}) is emitted unconditionally — "
+                            "the feature-off report gains the key and "
+                            "its byte-identity to the prior schema "
+                            "breaks; emit it only when the feature ran")
+            elif all(e.gated for e in got):
+                e = got[0]
+                yield Finding(
+                    e.relpath, e.line, 0, self.rule,
+                    f"schema key '{key}' is unconditional in "
+                    f"{version} but now emitted only behind a "
+                    "condition — feature-off reports lose it, which is "
+                    "a removal in disguise")
+        for (category, key), es in sorted(emitted.items()):
+            if (category, key) not in manifest_keys:
+                e = es[0]
+                yield Finding(
+                    e.relpath, e.line, 0, self.rule,
+                    f"schema key '{key}' ({category}) is emitted but "
+                    "absent from SCHEMA_KEY_MANIFEST — additive schema "
+                    "changes extend the manifest (and bump/gate the "
+                    "version) in the same PR")

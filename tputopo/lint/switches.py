@@ -1,0 +1,368 @@
+"""The ``kill-switch-audit`` checker: every perf leg's kill switch is
+registered, live in BOTH directions, and byte-invisible when off.
+
+The fleet hot-path PRs put every performance leg behind a kill switch
+whose off-path must stay byte-identical to the historical behavior
+(``ClusterState.FOLD_INPLACE``, ``ExtenderScheduler.SCORE_INDEX``,
+``AssumptionGC.WATERMARK``, ``SimEngine.NOCOPY_WRITES``,
+``BaselinePolicy.delta_fold``, the fake API's ``nocopy_writes``
+constructor switch).  That contract is only falsifiable while the off
+path is actually reachable — a switch nobody reads, or one whose reads
+all have a dead off-direction, silently stops being a switch.  This rule
+audits the whole vocabulary:
+
+- **Discovery**: a class-level plain ``NAME = True/False`` assignment
+  whose attribute name is defined in exactly ONE class across the tree
+  is a mode switch (the same attribute defined in several classes —
+  ``Tracer.enabled`` / ``NullTracer.enabled`` — is polymorphic dispatch,
+  not a switch, and is ignored).  Every discovered switch must be
+  registered: centrally in :data:`SWITCH_REGISTRY` below, or in-file
+  with a ``# kill-switch: <reason>`` directive on the assignment line.
+- **Registry hygiene**: a registry entry whose definition vanished from
+  its module is a dead entry — retire it in the same PR.
+- **Liveness**: a switch with zero reads is dead weight; a switch whose
+  reads never cover BOTH branch directions (an ``if FLAG:`` that is the
+  last statement of its block with no else, a bare pass-through) has an
+  unfalsifiable off-path.  A ternary / guarded-early-return / followed
+  ``if`` covers both; so does delegating the value into ANOTHER
+  registered switch's constructor keyword (``SimEngine.NOCOPY_WRITES``
+  feeding ``FakeApiServer(nocopy_writes=...)`` — the ctor switch's own
+  reads are audited instead).
+- **Presence gating**: a counter incremented ONLY under a switch's
+  positive arm must not be eagerly seeded in a literal counters dict —
+  the seed makes the key appear (at 0) in off-path reports, so flipping
+  the switch is no longer byte-invisible.  (Report-KEY additivity is the
+  ``schema-additivity`` rule's half of this contract.)
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from tputopo.lint.core import Checker, Finding, Module
+
+_DIRECTIVE_RE = re.compile(r"#\s*kill-switch:\s*(?P<reason>.*\S)")
+
+#: The project's registered kill switches: (relpath, class qualname,
+#: attribute).  The last entry is a CONSTRUCTOR switch — enabled per
+#: instance via a keyword, audited through its ``self.<attr>`` reads.
+SWITCH_REGISTRY: tuple[tuple[str, str, str], ...] = (
+    ("tputopo/extender/state.py", "ClusterState", "FOLD_INPLACE"),
+    ("tputopo/extender/scheduler.py", "ExtenderScheduler", "SCORE_INDEX"),
+    ("tputopo/extender/gc.py", "AssumptionGC", "WATERMARK"),
+    ("tputopo/sim/engine.py", "SimEngine", "NOCOPY_WRITES"),
+    ("tputopo/sim/policies.py", "BaselinePolicy", "delta_fold"),
+    ("tputopo/k8s/fakeapi.py", "FakeApiServer", "nocopy_writes"),
+)
+
+#: Method names that record a counter by string literal — the presence-
+#: gating check's increment vocabulary (shared with counter-drift's).
+_INC_METHODS = frozenset({"inc", "inc_chaos", "_pcount"})
+
+
+class _Switch:
+    __slots__ = ("attr", "relpath", "cls", "line", "registered",
+                 "reads", "covered")
+
+    def __init__(self, attr, relpath, cls, line, registered):
+        self.attr = attr
+        self.relpath = relpath
+        self.cls = cls
+        self.line = line          # definition line (0 = not in this run)
+        self.registered = registered
+        self.reads: list[tuple[str, int]] = []   # (relpath, line)
+        self.covered = False
+
+
+class KillSwitchChecker(Checker):
+    rule = "kill-switch-audit"
+    description = ("class-level feature kill switches must be registered "
+                   "(lint/switches.py SWITCH_REGISTRY or a # kill-switch: "
+                   "directive), read with both branch directions live "
+                   "(a dead off-path makes byte-identity unfalsifiable), "
+                   "and must not eagerly seed switch-guarded counters")
+
+    version = 1
+
+    def __init__(self, registry=SWITCH_REGISTRY) -> None:
+        self.registry = tuple(registry)
+        self._mods: list[Module] = []
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("tputopo/")
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        self._mods.append(mod)
+        return ()
+
+    # ---- discovery ---------------------------------------------------------
+
+    @staticmethod
+    def _class_bool_assigns(mod: Module):
+        """(class qualname, attr, line) for plain class-level boolean
+        assignments (AnnAssign dataclass fields are config defaults, not
+        mode switches)."""
+        out = []
+
+        def visit(body, qual):
+            for node in body:
+                if isinstance(node, ast.ClassDef):
+                    visit(node.body, f"{qual}{node.name}.")
+                elif isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, bool):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            out.append((qual.rstrip("."), t.id,
+                                        node.lineno))
+
+        visit(getattr(mod.tree, "body", []), "")
+        return out
+
+    def _discover(self, mods) -> tuple[dict[str, _Switch], list[Finding]]:
+        findings: list[Finding] = []
+        registered = {(rel, cls, attr) for rel, cls, attr in self.registry}
+        switches: dict[str, _Switch] = {}
+        # Pass 1: every class-level bool assignment, counted per attr so
+        # polymorphic flag families (defined in >1 class) drop out.
+        sites: dict[str, list] = {}
+        for mod in mods:
+            for cls, attr, line in self._class_bool_assigns(mod):
+                sites.setdefault(attr, []).append((mod, cls, line))
+        for attr, defs in sites.items():
+            if len(defs) != 1:
+                continue  # polymorphic dispatch family, not a switch
+            mod, cls, line = defs[0]
+            key = (mod.relpath, cls, attr)
+            directive = _DIRECTIVE_RE.search(
+                mod.comment_on_or_above(line))
+            if key not in registered and directive is None:
+                findings.append(Finding(
+                    mod.relpath, line, 0, self.rule,
+                    f"unregistered kill switch {cls}.{attr} — register "
+                    "it in tputopo/lint/switches.py SWITCH_REGISTRY or "
+                    "annotate the assignment with `# kill-switch: "
+                    "<reason>` so its off-path stays audited"))
+            switches[attr] = _Switch(attr, mod.relpath, cls, line,
+                                     key in registered
+                                     or directive is not None)
+        # Pass 2: registry entries — constructor switches join the audit;
+        # class-level entries whose definition vanished are dead.
+        by_path = {m.relpath: m for m in mods}
+        for rel, cls, attr in self.registry:
+            if attr in switches:
+                continue
+            mod = by_path.get(rel)
+            if mod is None:
+                continue  # canonical module not in this run's file set
+            if self._ctor_switch_line(mod, cls, attr) is not None:
+                sw = _Switch(attr, rel, cls,
+                             self._ctor_switch_line(mod, cls, attr), True)
+                switches[attr] = sw
+            else:
+                findings.append(Finding(
+                    rel, 1, 0, self.rule,
+                    f"dead registry entry: SWITCH_REGISTRY names "
+                    f"{cls}.{attr} but {rel} no longer defines it — "
+                    "retire the entry in the same PR"))
+        return switches, findings
+
+    @staticmethod
+    def _ctor_switch_line(mod: Module, cls: str, attr: str) -> int | None:
+        """Line of a constructor-keyword switch: a ``<attr>`` parameter
+        with a boolean default on the class's ``__init__``."""
+        for node in mod.nodes():
+            if not (isinstance(node, ast.ClassDef) and node.name
+                    == cls.rsplit(".", 1)[-1]):
+                continue
+            for sub in node.body:
+                if isinstance(sub, ast.FunctionDef) \
+                        and sub.name == "__init__":
+                    a = sub.args
+                    params = list(a.posonlyargs) + list(a.args) \
+                        + list(a.kwonlyargs)
+                    for p in params:
+                        if p.arg == attr:
+                            return p.lineno
+        return None
+
+    # ---- read/branch analysis ----------------------------------------------
+
+    @staticmethod
+    def _reads_in(expr: ast.AST, attrs) -> set[str]:
+        """Switch reads in an expression — ATTRIBUTE access only
+        (``self.X`` / ``Cls.X``).  A bare Name matching a switch's
+        attribute is almost always an unrelated local or parameter (the
+        fakeapi constructor's ``nocopy_writes`` argument), and counting
+        it would let a pass-through satisfy the liveness/coverage audit
+        without any real branch read."""
+        return {node.attr for node in ast.walk(expr)
+                if isinstance(node, ast.Attribute) and node.attr in attrs}
+
+    def _scan_reads(self, mod: Module,
+                    switches: dict[str, _Switch]) -> None:
+        attrs = set(switches)
+        if not any(a in mod.source for a in attrs):
+            return
+        # Every read site (for liveness), every covering context, and
+        # delegation into another registered switch's ctor keyword.
+        for node in mod.nodes():
+            if isinstance(node, ast.Attribute):
+                sw = switches.get(node.attr)
+                if sw is not None:
+                    sw.reads.append((mod.relpath, node.lineno))
+            if isinstance(node, (ast.IfExp, ast.While)):
+                # A ternary always has both arms; a while-test's off
+                # direction is the loop exit — both directions live.
+                for name in self._reads_in(node.test, attrs):
+                    switches[name].covered = True
+        # Statement-level Ifs need sibling context (is the If the last
+        # statement of its block?), so walk bodies structurally.
+        self._scan_if_blocks(getattr(mod.tree, "body", []), attrs,
+                             switches)
+        # Delegation: passing switch X as the value of registered switch
+        # Y's constructor keyword audits Y instead — X counts covered.
+        # Judged against the registry's attribute names (not just this
+        # run's discovered switches), so a scoped run still recognizes
+        # the handoff into a constructor switch defined elsewhere.
+        # A switch can NOT delegate into itself: `nocopy_writes=
+        # nocopy_writes` at a construction site is the ctor switch being
+        # set, not its off-path being consumed — its coverage must come
+        # from its own branch reads.
+        delegatable = attrs | {a for _, _, a in self.registry}
+        for node in mod.nodes():
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg in delegatable:
+                        for name in self._reads_in(kw.value, attrs):
+                            if name != kw.arg:
+                                switches[name].covered = True
+
+    def _scan_if_blocks(self, body: list, attrs, switches) -> None:
+        for i, node in enumerate(body):
+            if isinstance(node, ast.If):
+                names = self._reads_in(node.test, attrs)
+                if names:
+                    covered = bool(node.body) and (
+                        bool(node.orelse) or i < len(body) - 1)
+                    if covered:
+                        for name in names:
+                            switches[name].covered = True
+            for sub_body in self._sub_bodies(node):
+                self._scan_if_blocks(sub_body, attrs, switches)
+
+    @staticmethod
+    def _sub_bodies(node: ast.AST):
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(node, field, None)
+            if isinstance(sub, list):
+                yield sub
+        for h in getattr(node, "handlers", ()) or ():
+            yield h.body
+
+    # ---- presence gating ---------------------------------------------------
+
+    def _eager_seeds(self, mod: Module) -> dict[str, int]:
+        """Counter names eagerly seeded in a literal dict assigned to a
+        ``self.<...counter...>`` attribute: {name: seed line}."""
+        out: dict[str, int] = {}
+        for node in mod.nodes():
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Dict)):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) \
+                        and "count" in t.attr.lower():
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str):
+                            out.setdefault(k.value, k.lineno)
+        return out
+
+    def _guarded_incs(self, mod: Module, attrs) -> list[tuple[str, int]]:
+        """(counter literal, line) for ``.inc("...")``-family calls in
+        the POSITIVE arm of a switch conditional."""
+        out: list[tuple[str, int]] = []
+
+        def collect(stmts):
+            for node in stmts:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) \
+                            and isinstance(sub.func, ast.Attribute) \
+                            and sub.func.attr in _INC_METHODS \
+                            and sub.args \
+                            and isinstance(sub.args[0], ast.Constant) \
+                            and isinstance(sub.args[0].value, str):
+                        out.append((sub.args[0].value, sub.lineno))
+
+        def visit(body):
+            for i, node in enumerate(body):
+                if isinstance(node, ast.If) \
+                        and self._reads_in(node.test, attrs):
+                    negated = isinstance(node.test, ast.UnaryOp) \
+                        and isinstance(node.test.op, ast.Not)
+                    if not negated:
+                        collect(node.body)
+                        visit(node.orelse)
+                    else:
+                        collect(node.orelse)
+                        visit(node.body)
+                        # `if not FLAG: return ...` — the statements
+                        # after the early exit ARE the positive arm.
+                        if node.body and isinstance(
+                                node.body[-1], (ast.Return, ast.Raise,
+                                                ast.Continue, ast.Break)):
+                            collect(body[i + 1:])
+                    continue
+                for sub_body in self._sub_bodies(node):
+                    visit(sub_body)
+
+        visit(getattr(mod.tree, "body", []))
+        return out
+
+    # ---- the analysis ------------------------------------------------------
+
+    def finalize(self) -> Iterable[Finding]:
+        mods, self._mods = self._mods, []
+        switches, findings = self._discover(mods)
+        yield from findings
+        for mod in mods:
+            self._scan_reads(mod, switches)
+        for sw in sorted(switches.values(), key=lambda s: s.attr):
+            if not sw.registered:
+                continue  # already flagged as unregistered above
+            if not sw.reads:
+                yield Finding(
+                    sw.relpath, sw.line or 1, 0, self.rule,
+                    f"kill switch {sw.cls}.{sw.attr} is never read — a "
+                    "switch nothing consults gates nothing; delete it "
+                    "or wire the legs it was meant to guard")
+            elif not sw.covered:
+                path, line = sw.reads[0]
+                yield Finding(
+                    path, line, 0, self.rule,
+                    f"kill switch {sw.cls}.{sw.attr} is read in only "
+                    "one branch direction — the off-path is dead, so "
+                    "the byte-identity contract is unfalsifiable; give "
+                    "every leg a live both-ways branch (or delegate "
+                    "into a registered constructor switch)")
+        # Presence gating: switch-guarded counters vs eager seeds, per
+        # module (seeds and incs live next to each other in this tree).
+        attrs = set(switches)
+        for mod in mods:
+            if not any(a in mod.source for a in attrs):
+                continue
+            seeds = self._eager_seeds(mod)
+            if not seeds:
+                continue
+            for name, line in self._guarded_incs(mod, attrs):
+                if name in seeds:
+                    yield Finding(
+                        mod.relpath, line, 0, self.rule,
+                        f"switch-guarded counter '{name}' is eagerly "
+                        f"seeded (line {seeds[name]}) — the off-path "
+                        "report emits the key at 0, so flipping the "
+                        "switch is not byte-invisible; drop the seed "
+                        "and let presence-gating carry it")

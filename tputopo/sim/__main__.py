@@ -137,6 +137,16 @@ def build_parser() -> argparse.ArgumentParser:
                    default=DEFAULT_REPLICAS["schedule"],
                    help="replica wake interleaving: 'rr' round-robin or "
                         "'weighted' seeded random draw")
+    p.add_argument("--replica-affinity", action="store_true",
+                   help="pod->replica affinity: hash-shard each pending "
+                        "gang to a preferred replica (stable crc32, no "
+                        "coordination) so racing shards mostly stop "
+                        "planning the same pod against the same chips — "
+                        "cuts the bind-conflict rate at high replica "
+                        "counts.  Schema-additive: off (the default) is "
+                        "byte-identical to v6; on adds the affinity "
+                        "marker to the replicas block and the resolved "
+                        "knob record")
     p.add_argument("--chaos", default=None, metavar="PROFILE",
                    help="run under the seeded fault-injection layer "
                         "(tputopo.chaos): injected CAS conflicts, "
@@ -225,6 +235,15 @@ def main(argv: list[str] | None = None) -> int:
         replicas = {"count": args.replicas,
                     "watch_delay_s": args.replica_watch_delay,
                     "schedule": args.replica_schedule}
+        if args.replica_affinity:
+            # Present only when ON: the resolved knob dict is recorded
+            # under engine.replicas, and affinity-off reports must stay
+            # byte-identical to v6.
+            replicas["affinity"] = True
+    elif args.replica_affinity:
+        print("--replica-affinity requires --replicas > 1",
+              file=sys.stderr)
+        return 2
     defrag = None
     if args.defrag:
         defrag = {"period_s": args.defrag_period,

@@ -372,6 +372,19 @@ class SimEngine:
             # the cluster-level controller, like staging/confirms), via
             # the copy-free facade — a sync per attempted plan.
             self._plan_api = _CopyFreeApi(self.api)
+            # Victim-tier listing: every preemption victim holds chips,
+            # so its pod carries the chip-group annotation — the
+            # server's assignment-key index (list_assignments,
+            # O(assignments)) is the exact candidate universe, same as
+            # the GC sweep's.  Pods outside it can never be victims and
+            # plan_preemption's fail-closed default (absent key = max
+            # priority) already protects anything racing in.  Readers
+            # without the index fall back to the whole-store shim,
+            # bound HERE so the planning path itself stays free of
+            # full-store primitives.
+            self._list_victims = getattr(
+                self._plan_api, "list_assignments", None) or (
+                lambda: list_pods_nocopy(self._plan_api))
 
         # Defragmentation loop (tputopo.defrag), opt-in: a periodic
         # controller cycle on virtual time, evicting through the same
@@ -977,8 +990,10 @@ class SimEngine:
                                      clock=self.clock).sync()
                 plan = plan_preemption(
                     state, (spec.replicas, spec.chips), spec.priority,
-                    # tpulint: disable=hot-path-scan -- amortized: same gate as the sync above — one victim-candidate listing per considered preemption plan
-                    list_pods_nocopy(self._plan_api),
+                    # Indexed victim listing (O(assignments), bound in
+                    # __init__): the former whole-store scan here was a
+                    # waived hot-path debt — deleted, not re-worded.
+                    self._list_victims(),
                     max_moves=int(knobs["max_moves"]),
                     max_chips_moved=int(knobs["max_chips_moved"]))
                 if plan is not None:

@@ -186,10 +186,12 @@ class IciAwarePolicy(PlacementPolicy):
         else:
             self.sched.invalidate_cached_state()
 
-    def _wake_scheduler(self) -> ExtenderScheduler:
+    def _wake_scheduler(self, job: JobSpec | None = None
+                        ) -> ExtenderScheduler:
         """The scheduler serving THIS place() wake.  The single-scheduler
         base returns its one instance; the replicated subclass picks a
-        racing shard from its seeded wake schedule."""
+        racing shard from its seeded wake schedule — or, under
+        ``--replica-affinity``, the ``job``'s hash shard."""
         return self.sched
 
     def _wake_committed(self, decisions: list[dict]) -> None:
@@ -201,7 +203,7 @@ class IciAwarePolicy(PlacementPolicy):
         self.last_none_reason = "infeasible"
         decisions = []
         sort_explain = None
-        sched = self._wake_scheduler()
+        sched = self._wake_scheduler(job)
         # Chaos: does the extender "die" mid-gang-bind this attempt?  The
         # crash point is drawn up front (deterministic stream position)
         # and hit after ``crash_at`` members are bound.
@@ -403,7 +405,8 @@ class ReplicatedIciPolicy(IciAwarePolicy):
             scheds, clock=clock, seed=seed,
             schedule=str(knobs["schedule"]),
             watch_delay_s=float(knobs["watch_delay_s"]),
-            weights=knobs.get("weights"))
+            weights=knobs.get("weights"),
+            affinity=bool(knobs.get("affinity", False)))
 
     def _make_scheduler(self) -> ExtenderScheduler:
         """One replica shard: shared_writers (CAS-guarded binds + claim
@@ -422,8 +425,13 @@ class ReplicatedIciPolicy(IciAwarePolicy):
             tracer=self.tracer if self.tracer is not None else NULL_TRACER,
             retry_rng=random.Random(0x7E7 + self._slot))
 
-    def _wake_scheduler(self) -> ExtenderScheduler:
-        return self.rset.begin_wake()
+    def _wake_scheduler(self, job: JobSpec | None = None
+                        ) -> ExtenderScheduler:
+        # The gang's NAME is the affinity key (every member of a gang
+        # binds through the same wake, so hashing the job keeps whole
+        # gangs on one shard); keyless wakes draw from the schedule.
+        return self.rset.begin_wake(
+            key=job.name if job is not None else None)
 
     def _wake_committed(self, decisions: list[dict]) -> None:
         self.rset.note_committed(decisions)

@@ -112,6 +112,34 @@ SCHEMA_PRIORITY = "tputopo.sim/v5"
 #: part of the byte-determinism contract.
 SCHEMA_REPLICAS = "tputopo.sim/v6"
 
+#: The pinned schema-key manifest: which top-level report keys and
+#: per-policy record keys each schema version emits, and which of them
+#: are FEATURE-GATED (emitted only when their feature ran — the
+#: additivity contract's presence-gated keys; ``top_gated`` also covers
+#: the two documented wall-clock blocks, gated on their values being
+#: collected).  ``tputopo.lint``'s schema-additivity rule extracts the
+#: key-sets actually emitted by the builders (build_report /
+#: MetricsCollector.report / engine.finalize_run_state) and diffs them
+#: against this manifest: a key removed from a prior version, a gated
+#: key emitted unconditionally, or an emitted key missing here is a
+#: finding — schema changes are additive and land in this table in the
+#: same PR, in front of review.
+SCHEMA_KEY_MANIFEST = {
+    "tputopo.sim/v2": {
+        "top": ("schema", "trace", "engine", "virtual_horizon_s",
+                "policies", "ab"),
+        "top_gated": ("throughput", "phase_wall"),
+        "policy": ("jobs", "queue_wait_s", "chip_utilization",
+                   "fragmentation", "ici_bw_score", "preemptions", "gc",
+                   "scheduler", "phases"),
+        "policy_gated": (),
+    },
+    "tputopo.sim/v3": {"policy_gated": ("defrag",)},
+    "tputopo.sim/v4": {"policy_gated": ("chaos",)},
+    "tputopo.sim/v5": {"policy_gated": ("tiers", "preempt")},
+    "tputopo.sim/v6": {"policy_gated": ("replicas",)},
+}
+
 #: The extender counters the report's per-policy ``scheduler`` block
 #: keeps (the ici policy filters its merged Metrics through this — plus
 #: the dynamic ``state_delta_fallback_*`` / chaos-prefix families).  One
