@@ -588,6 +588,67 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0,
     return out
 
 
+def bench_batch(nodes: int = 32, arrivals: int = 150, seed: int = 0,
+                fleet_nodes: int = 256, fleet_arrivals: int = 2000) -> dict:
+    """Joint batch-admission scenario (tputopo.batch) — the ``batch``
+    block: the standard mixed trace and the fleet offered-load trace,
+    each replayed per-gang FIFO vs ``--batch-admission``, A/B'd in one
+    process so the deltas divide same-host figures and move with code.
+    The dev-host 1024/10000 record is inlined as ``baseline_ref`` (same
+    rule as the sim fleet block).  Refuses to publish (SystemExit) when
+    a batch-on replay planned zero batches: that means the kill switch
+    path rotted and the A/B is silently FIFO-vs-FIFO."""
+    from tputopo.sim.engine import run_trace
+    from tputopo.sim.trace import TraceConfig
+
+    def leg(cfg, **kw):
+        fifo = run_trace(cfg, ["ici"], flight_trace=False, **kw)
+        on = run_trace(cfg, ["ici"], flight_trace=False, batch={}, **kw)
+        op = on["policies"]["ici"]
+        if op["batch"]["batches"] <= 0:
+            raise SystemExit("bench batch: batch-on replay planned zero "
+                             "batches — the joint solve never ran")
+        figs = {}
+        for tag, rep in (("fifo", fifo), ("batch", on)):
+            p = rep["policies"]["ici"]
+            figs[tag] = {
+                "events_per_s": rep["throughput"]["events_per_s"],
+                "wall_s": rep["throughput"]["wall_s"],
+                "queue_wait_p50_s": p["queue_wait_s"]["p50"],
+                "queue_wait_p95_s": p["queue_wait_s"]["p95"],
+                "utilization": p["chip_utilization"]["time_weighted_mean"],
+                "fragmentation": p["fragmentation"]["time_weighted_mean"],
+                "bw_vs_ideal": p["ici_bw_score"]["mean_vs_ideal"],
+                "scheduled": p["jobs"]["scheduled"],
+                "sort_requests": p["scheduler"].get("sort_requests", 0),
+            }
+        figs["batch"]["planner"] = dict(op["batch"],
+                                        gangs_per_batch=op["batch"]
+                                        ["gangs_per_batch"])
+        return figs
+
+    out = {
+        "mixed": leg(TraceConfig(seed=seed, nodes=nodes, arrivals=arrivals,
+                                 workload="mixed"), preempt={}),
+        "fleet": leg(TraceConfig(seed=seed, nodes=fleet_nodes,
+                                 arrivals=fleet_arrivals,
+                                 offered_load=0.73)),
+        # The PR-16 dev-host standing record for the documented command
+        # `python -m tputopo.sim --nodes 1024 --arrivals 10000
+        # --offered-load 0.73 --no-trace [--batch-admission]` — inlined
+        # so later rounds diff against it without re-running old code.
+        "baseline_ref": {
+            "ref": "PR 16 dev-host record (ROADMAP batch-admission entry)",
+            "fleet_1024x10000_fifo": {"wall_s": 27.0,
+                                      "events_per_s": 746.0},
+            "fleet_1024x10000_batch": {"wall_s": 25.5,
+                                       "events_per_s": 791.0,
+                                       "sort_requests": 33681},
+        },
+    }
+    return out
+
+
 def bench_shards(nodes: int = 256, arrivals: int = 2000, seed: int = 0,
                  counts: tuple = (1, 2, 4, 8),
                  http_pods: int = 600) -> dict:
@@ -1844,6 +1905,9 @@ def main() -> None:
     extras["bandwidth_gain_vs_count_only"] = isolated(
         "ab_gain", bench_ab_gain, strict=True)
     extras["sim"] = isolated("sim", bench_sim, strict=True)
+    # Joint batch admission: FIFO-vs-batch A/B on the mixed and fleet
+    # traces (pure-Python correctness traces — strict).
+    extras["batch"] = isolated("batch", bench_batch, strict=True)
     # Replicated control plane: the sim replica sweep (quality vs the
     # single-replica stream) + the real-process HTTP load leg.  Not
     # strict: the http leg spawns server subprocesses, and a sandboxed

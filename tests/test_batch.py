@@ -1,0 +1,379 @@
+"""tputopo.batch: the joint batch-admission planner (greedy-with-regret
+ordering, window refinement, infeasibility pre-gates, the incremental
+score-matrix cache), its sim integration behind --batch-admission (kill
+switch off = flag-absent bytes, on = deterministic incl. --jobs 2 and the
+v7 ``batch`` block), the replica-affinity interplay, and the extender's
+/debug/batchplan dry-run surface."""
+
+import json
+
+import pytest
+
+from tests.cluster import build_cluster
+from tputopo.batch import GangRequest, plan_batch
+from tputopo.k8s import objects as ko
+from tputopo.sim.engine import SimEngine, run_trace
+from tputopo.sim.report import SCHEMA_BATCH, SCHEMA_REPLICAS
+from tputopo.sim.trace import TraceConfig
+
+CLOCK = lambda: 1000.0  # noqa: E731
+
+SMALL = dict(nodes=8, spec="v5p:2x2x4", arrivals=40)
+
+
+def _canon(report: dict) -> str:
+    report = dict(report)
+    report.pop("throughput", None)
+    report.pop("phase_wall", None)
+    return json.dumps(report, sort_keys=True)
+
+
+# ---- planner units ----------------------------------------------------------
+
+DOMS = {"a": ["a0", "a1", "a2", "a3"], "b": ["b0", "b1", "b2", "b3"]}
+
+
+def _scorer(maps):
+    """A plan-scoped scorer over fixed ``{k: {node: score}}`` maps (the
+    consumer-memo idiom: one (scores, changed) tuple per k per plan)."""
+    memo = {}
+
+    def scores(k, key=None):
+        got = memo.get(k)
+        if got is None:
+            got = memo[k] = (maps[k], None)
+        return got
+
+    return scores
+
+
+def test_regret_orders_largest_gap_first():
+    """The gang with the most to lose if its preferred domain is taken
+    goes first, regardless of FIFO position."""
+    maps = {4: {"a0": 10, "b0": 9},   # regret 1
+            2: {"a0": 10, "b0": 2}}   # regret 8
+    gangs = [GangRequest(0, "close-call", 1, 4),
+             GangRequest(1, "must-have-a", 1, 2)]
+    plan = plan_batch(gangs, _scorer(maps), DOMS, {"a": 16, "b": 16})
+    assert plan.order == [1, 0]
+    assert plan.infeasible == []
+    assert plan.regret_reorders == 2
+    recs = {r["index"]: r for r in plan.records}
+    assert recs[1]["regret"] == 8.0 and recs[1]["best_domain"] == "a"
+    assert recs[0]["regret"] == 1.0
+
+
+def test_single_feasible_domain_has_infinite_regret():
+    """A one-domain gang leads its tier (losing its only domain means
+    losing everything) and its record carries the marker, not a float."""
+    maps = {4: {"a0": 10, "b0": 9},
+            8: {"a0": 5, "a1": 5}}    # domain b scores nothing for k=8
+    gangs = [GangRequest(0, "flexible", 1, 4),
+             GangRequest(1, "a-only", 1, 8)]
+    plan = plan_batch(gangs, _scorer(maps), DOMS, {"a": 16, "b": 16})
+    assert plan.order == [1, 0]
+    rec = {r["index"]: r for r in plan.records}[1]
+    assert rec["regret"] is None and rec["only_feasible_domain"] is True
+    assert rec["feasible_domains"] == 1
+
+
+def test_priority_tiers_dominate_regret():
+    """Regret reorders WITHIN a tier only — a serving gang with zero
+    regret still precedes an infinite-regret batch gang."""
+    maps = {4: {"a0": 10, "b0": 10},  # regret 0
+            8: {"a0": 5, "a1": 5}}    # infinite regret
+    gangs = [GangRequest(0, "batch-desperate", 1, 8, priority=0),
+             GangRequest(1, "serving-easy", 1, 4, priority=100)]
+    plan = plan_batch(gangs, _scorer(maps), DOMS, {"a": 16, "b": 16})
+    assert plan.order == [1, 0]
+    assert plan.regret_reorders == 0  # priority-major is the FIFO base too
+
+
+def test_infeasible_gang_pregated_and_ordered_last_in_tier():
+    """A gang no domain can hold right now is pre-gated (the consumer
+    skips its sort) but stays IN the order, after its scored tier-mates."""
+    maps = {4: {"a0": 10, "b0": 9}}
+    gangs = [GangRequest(0, "too-big", 4, 4),   # volume 16 > 8 free
+             GangRequest(1, "fits", 1, 4)]
+    plan = plan_batch(gangs, _scorer(maps), DOMS, {"a": 8, "b": 8})
+    assert plan.infeasible == [0]
+    assert plan.order == [1, 0]
+    rec = {r["index"]: r for r in plan.records}[0]
+    assert rec["feasible_domains"] == 0 and rec["best_domain"] is None
+
+
+def test_scoring_host_shortfall_pregates_even_with_free_volume():
+    """The second gate: volume fits but fewer hosts score positive than
+    the gang has members — place() would fail every member sort."""
+    maps = {2: {"a0": 7, "b0": 4}}    # one positive host per domain
+    gangs = [GangRequest(0, "three-members", 3, 2)]
+    plan = plan_batch(gangs, _scorer(maps), DOMS, {"a": 16, "b": 16})
+    assert plan.infeasible == [0]
+
+
+def test_multislice_gate_is_fleet_wide():
+    """Multislice gangs are unscored (placement spans domains) but still
+    pre-gated by the cross-domain necessary conditions: fleet free chips
+    >= volume AND fleet positive-scoring hosts >= members."""
+    maps = {4: {"a0": 10, "b0": 9}}
+    gangs = [GangRequest(0, "ms-fits", 2, 4, multislice=True),   # vol 8
+             GangRequest(1, "ms-too-big", 8, 4, multislice=True),  # vol 32
+             GangRequest(2, "scored", 1, 4)]
+    plan = plan_batch(gangs, _scorer(maps), DOMS, {"a": 8, "b": 8})
+    assert plan.infeasible == [1]
+    # Within the tier: scored first, feasible-unscored next, pre-gated last.
+    assert plan.order == [2, 0, 1]
+    recs = {r["index"]: r for r in plan.records}
+    assert recs[0]["multislice_feasible"] is True
+    assert recs[1]["multislice_feasible"] is False
+    # ms-fits has two positive hosts fleet-wide for its 2 members; a
+    # third member would trip the host gate despite the free volume.
+    plan = plan_batch([GangRequest(0, "ms-3", 3, 4, multislice=True)],
+                      _scorer(maps), DOMS, {"a": 16, "b": 16})
+    assert plan.infeasible == [0]
+
+
+def test_window_refinement_flips_contended_greedy_order():
+    """Two one-domain gangs contend for the last free chips of domain a;
+    FIFO-greedy admits the cheap one first, the exhaustive window finds
+    the better total and flips the order — and stays quiet (ties keep
+    greedy) when capacity stops being contended."""
+    maps = {4: {"a0": 6},             # gang 0: value 6, a-only
+            2: {"a0": 7, "a1": 3}}    # gang 1: 2 members, top-2 sum 10
+    gangs = [GangRequest(0, "cheap", 1, 4),
+             GangRequest(1, "valuable", 2, 2)]
+    # Both volume 4, both infinite regret -> FIFO would try 0 first and
+    # exhaust a; the permutation search prefers total 10 over total 6.
+    plan = plan_batch(gangs, _scorer(maps), DOMS, {"a": 4, "b": 0})
+    assert plan.window_refinements == 1
+    assert plan.order == [1, 0]
+    # Uncontended: both fit, greedy order stands, no refinement counted.
+    plan = plan_batch(gangs, _scorer(maps), DOMS, {"a": 16, "b": 0})
+    assert plan.window_refinements == 0
+    assert plan.order == [0, 1]
+
+
+def test_order_is_always_a_permutation_of_the_queue():
+    maps = {1: {"a0": 3, "a1": 2, "b0": 1}}
+    gangs = [GangRequest(i, f"g{i}", 1, 1, priority=(i % 3) * 50)
+             for i in range(9)]
+    plan = plan_batch(gangs, _scorer(maps), DOMS, {"a": 16, "b": 16})
+    assert sorted(plan.order) == list(range(9))
+    prios = {g.index: g.priority for g in gangs}
+    assert [prios[i] for i in plan.order] == \
+        sorted((prios[i] for i in plan.order), reverse=True)
+
+
+def test_cached_matrix_patch_matches_fresh_rebuild():
+    """The incremental path: a second plan over the SAME scores dict with
+    a changed-node report must equal a cache-less plan that rebuilds the
+    matrix from scratch."""
+    live = {4: {"a0": 5, "b0": 7}}
+    gangs = [GangRequest(0, "g0", 1, 4), GangRequest(1, "g1", 1, 4)]
+    free = {"a": 8, "b": 8}
+
+    def wake(changed):
+        memo = {}
+
+        def scores(k, key=None):
+            got = memo.get(k)
+            if got is None:
+                got = memo[k] = (live[k], changed)
+            return got
+
+        return scores
+
+    cache = {}
+    p1 = plan_batch(gangs, wake(None), DOMS, free, cache=cache)
+    assert p1.order == [0, 1]  # same shape, same regret: FIFO
+    assert {r["best_domain"] for r in p1.records} == {"b"}  # b0 leads on 7
+    live[4]["b0"] = 1
+    live[4]["a1"] = 9
+    patched = plan_batch(gangs, wake(("b0", "a1")), DOMS, free, cache=cache)
+    fresh = plan_batch(gangs, wake(None), DOMS, free)
+    assert patched.order == fresh.order
+    assert patched.records == fresh.records
+
+
+# ---- sim integration: the --batch-admission kill switch ---------------------
+
+
+def test_batch_off_matches_flag_absent_bytes(monkeypatch):
+    """The registered kill switch: knobs passed but BATCH_ADMISSION False
+    must replay the EXACT flag-absent bytes (prior schema included)."""
+    cfg = TraceConfig(seed=0, **SMALL)
+    absent = run_trace(cfg, ["ici", "naive"])
+    monkeypatch.setattr(SimEngine, "BATCH_ADMISSION", False)
+    killed = run_trace(cfg, ["ici", "naive"], batch={})
+    assert _canon(absent) == _canon(killed)
+    assert "batch" not in absent["policies"]["ici"]
+    assert "batch" not in absent["engine"]
+
+
+def test_batch_on_deterministic_with_v7_block():
+    """Byte-determinism incl. --jobs 2, the schema bump, and the batch
+    block's counter shape."""
+    cfg = TraceConfig(seed=0, **SMALL)
+    ra = run_trace(cfg, ["ici", "naive"], batch={})
+    rb = run_trace(cfg, ["ici", "naive"], batch={})
+    rj = run_trace(cfg, ["ici", "naive"], batch={}, jobs=2)
+    assert _canon(ra) == _canon(rb) == _canon(rj)
+    assert ra["schema"] == SCHEMA_BATCH
+    assert ra["engine"]["batch"] == {"window": 4}
+    for pol in ra["policies"].values():
+        blk = pol["batch"]
+        assert blk["batches"] > 0
+        assert {"p50", "p95", "mean", "max"} <= set(blk["gangs_per_batch"])
+        assert blk["sorts_avoided"] >= 0 and blk["regret_reorders"] >= 0
+
+
+def test_batch_vs_fifo_differential_on_contended_trace():
+    """The feature does something: on the standard contended small trace
+    the joint solve reorders admissions (nonzero regret_reorders), skips
+    pre-gated sorts, and steers a different trajectory than per-gang
+    FIFO — while conserving every job."""
+    cfg = TraceConfig(seed=0, **SMALL)
+    fifo = run_trace(cfg, ["ici"])
+    batch = run_trace(cfg, ["ici"], batch={})
+    assert _canon(fifo) != _canon(batch)
+    pol = batch["policies"]["ici"]
+    assert pol["batch"]["regret_reorders"] > 0
+    assert pol["batch"]["sorts_avoided"] > 0
+    for rep in (fifo, batch):
+        jobs = rep["policies"]["ici"]["jobs"]
+        assert jobs["arrived"] == SMALL["arrivals"]
+        assert jobs["arrived"] == (jobs["completed"] + jobs["ghost_reclaimed"]
+                                   + jobs["unplaced_at_end"])
+    # The joint solve must not cost placement quality on this trace.
+    assert (pol["ici_bw_score"]["mean_vs_ideal"]
+            >= fifo["policies"]["ici"]["ici_bw_score"]["mean_vs_ideal"] - 0.05)
+
+
+def test_batch_composes_with_chaos_and_preempt():
+    """Chaos invariants (no double-booking, gang atomicity, no lost jobs)
+    and the mixed+preempt path hold unchanged inside the joint solve."""
+    cfg = TraceConfig(seed=0, **SMALL)
+    rep = run_trace(cfg, ["ici"], batch={}, chaos="api-flake")
+    inv = rep["policies"]["ici"]["chaos"]["invariants"]
+    assert inv["ok"] is True and inv["violations"] == []
+    assert rep["schema"] == SCHEMA_BATCH
+    mixed = TraceConfig(seed=0, workload="mixed", **SMALL)
+    ra = run_trace(mixed, ["ici"], batch={}, preempt={})
+    rb = run_trace(mixed, ["ici"], batch={}, preempt={}, jobs=2)
+    assert _canon(ra) == _canon(rb)
+    assert ra["policies"]["ici"]["batch"]["batches"] > 0
+    assert "preempt" in ra["policies"]["ici"]
+
+
+# ---- replica-affinity interplay ---------------------------------------------
+
+
+def test_batch_with_replica_affinity_no_cross_shard_claims():
+    """Two racing replicas under --replica-affinity: the batch is valued
+    through the shard each gang HASHES to, the claim path uses the same
+    hash, so no batch-planned gang is ever claimed cross-shard — the
+    affinity conflict guarantee survives the joint solve (deterministic
+    incl. --jobs 2, and hash-sharding still never RAISES conflicts)."""
+    cfg = TraceConfig(seed=0, nodes=16, arrivals=60)
+    knobs = {"count": 2, "affinity": True}
+    ra = run_trace(cfg, ["ici"], replicas=knobs, batch={})
+    rj = run_trace(cfg, ["ici"], replicas=knobs, batch={}, jobs=2)
+    assert _canon(ra) == _canon(rj)
+    assert ra["schema"] == SCHEMA_BATCH
+    blk = ra["policies"]["ici"]["replicas"]
+    assert blk["schedule"]["affinity"] is True
+    assert blk["bind_conflicts"] == sum(blk["conflicts_by_cause"].values())
+    assert ra["policies"]["ici"]["batch"]["batches"] > 0
+    off = run_trace(cfg, ["ici"], replicas={"count": 2}, batch={})
+    assert (blk["bind_conflicts"]
+            <= off["policies"]["ici"]["replicas"]["bind_conflicts"])
+    jobs = ra["policies"]["ici"]["jobs"]
+    assert jobs["arrived"] == (jobs["completed"] + jobs["ghost_reclaimed"]
+                               + jobs["unplaced_at_end"])
+
+
+def test_unreplicated_batch_report_carries_no_replica_keys():
+    """Presence-gating both ways: batch-on without replicas emits v7 with
+    no replicas block; replicas without batch stays v6 with no batch."""
+    cfg = TraceConfig(seed=0, **SMALL)
+    b = run_trace(cfg, ["ici"], batch={})
+    assert "replicas" not in b["policies"]["ici"]
+    r = run_trace(cfg, ["ici"], replicas={"count": 2})
+    assert r["schema"] == SCHEMA_REPLICAS
+    assert "batch" not in r["policies"]["ici"]
+
+
+# ---- extender dry-run surface -----------------------------------------------
+
+
+def test_scheduler_plan_batch_orders_pending_and_counts():
+    """plan_batch over a real pending queue: gangs grouped once, regret
+    order over the live score index, counters ticked."""
+    from tputopo.extender import ExtenderConfig, ExtenderScheduler
+
+    api, _ = build_cluster()
+    sched = ExtenderScheduler(api, ExtenderConfig(), clock=CLOCK)
+    # One 2-member gang and one single pod, all pending.
+    for m in range(2):
+        api.create("pods", ko.make_pod(
+            f"gang-{m}", chips=4,
+            labels={"tpu.dev/gang-id": "gang",
+                    "tpu.dev/gang-size": "2"}))
+    api.create("pods", ko.make_pod("solo", chips=4))
+    plan = sched.plan_batch()
+    assert sched.metrics.counters["batch_plans_considered"] == 1
+    assert sched.metrics.counters["batch_plans_planned"] == 1
+    out = plan.describe()
+    assert sorted(out["order"]) == ["gang", "solo"]
+    assert out["infeasible"] == []
+    by_gang = {r["gang"]: r for r in out["gangs"]}
+    assert by_gang["gang"]["replicas"] == 2
+    assert by_gang["solo"]["replicas"] == 1
+    # An empty queue still counts the consideration, not a plan.
+    for name in ("gang-0", "gang-1", "solo"):
+        api.delete("pods", name, "default")
+    plan = sched.plan_batch()
+    assert plan.order == []
+    assert sched.metrics.counters["batch_plans_considered"] == 2
+    assert sched.metrics.counters["batch_plans_planned"] == 1
+
+
+def test_debug_batchplan_endpoint():
+    import urllib.error
+    import urllib.request
+
+    from tputopo.extender import (ExtenderConfig, ExtenderHTTPServer,
+                                  ExtenderScheduler)
+
+    api, _ = build_cluster()
+    config = ExtenderConfig()
+    sched = ExtenderScheduler(api, config, clock=CLOCK)
+    srv = ExtenderHTTPServer(sched, config, port=0).start()
+    try:
+        host, port = srv.address
+        api.create("pods", ko.make_pod("big", chips=4,
+                                       labels={ko.LABEL_PRIORITY: "100"}))
+        api.create("pods", ko.make_pod("small", chips=1))
+
+        def get(path):
+            with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                        timeout=5) as resp:
+                return resp.status, json.loads(resp.read())
+
+        status, out = get("/debug/batchplan")
+        assert status == 200
+        assert out["dry_run"] is True
+        # Priority-major: the serving pod leads whatever its regret.
+        assert out["order"][0] == "big"
+        assert out["counters"].keys() == {"regret_reorders",
+                                          "window_refinements"}
+        # Dry run must not bind anything.
+        assert not api.get("pods", "big", "default")["spec"].get("nodeName")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/debug/batchplan?window=-1")
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/debug/batchplan?window=x")
+        assert ei.value.code == 400
+    finally:
+        srv.stop()

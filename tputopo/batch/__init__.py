@@ -1,0 +1,10 @@
+"""tputopo.batch — joint batch admission over the pending queue.
+
+See :mod:`tputopo.batch.planner` for the greedy-with-regret solve; the
+sim engine consumes it behind ``SimEngine.BATCH_ADMISSION`` and the
+extender serves dry-run plans at ``GET /debug/batchplan``.
+"""
+
+from tputopo.batch.planner import BatchPlan, GangRequest, plan_batch
+
+__all__ = ["BatchPlan", "GangRequest", "plan_batch"]

@@ -1,0 +1,410 @@
+"""Joint batch-admission planning: solve the whole pending queue at once.
+
+The Gaia design (PAPER.md §III) — and the engine's wake path that
+reproduces it — admits one request at a time against the topology tree:
+each gang is planned in admission order with no view of the gangs queued
+behind it, so an early gang happily takes the last free chips of the one
+domain a later gang *needs whole*, and every queued gang pays its own
+full state sync + per-member sort.  This module generalizes the
+mask-native cheapest-set search of :mod:`tputopo.defrag.planner` from
+"one demand against the mask vocabulary" to "the whole pending set
+jointly": one scoring pass over the persistent ``{k: {node: score}}``
+score index (vectorized with numpy — the per-domain score vectors live
+in one int64 matrix per ``(k, shard)`` bucket, updated incrementally
+from the scorer's changed-node report and shared by every gang of the
+same shape), then
+
+- **greedy-with-regret ordering**: within each priority tier, attempt
+  first the gang whose best-minus-second-best domain value gap is
+  largest — the gang with the most to lose if its preferred domain is
+  taken (a single-feasible-domain gang has infinite regret and leads its
+  tier), FIFO as the deterministic tie-break;
+- **small-window exhaustive refinement**: when the head gangs of the
+  top tier *contend* (their summed chip demand on a preferred domain
+  exceeds its free chips), every permutation of the first ``window``
+  scored head gangs is evaluated against a per-domain free-chip capacity
+  model and the best total-value order wins (ties keep the greedy
+  order);
+- **infeasible passthrough**: a gang no domain can hold *right now*
+  (free chips < the gang's volume, or fewer scoring hosts than members;
+  for multislice gangs the same two conditions fleet-wide, since their
+  sub-gangs may span domains) is pre-gated — the consumer skips its
+  sort entirely and records the same per-epoch infeasibility verdict a
+  failed ``place()`` would have.  Pre-gating multislice gangs is what
+  keeps the joint solve cheap at fleet saturation: they sit at their
+  tier's tail, so without the gate every wake re-entered the
+  cross-domain composition search for gangs the capacity model already
+  ruled out.
+
+The planner decides attempt ORDER and exact pre-gates only; placement
+itself stays on the production sort/bind path, so the ledger, chaos and
+replica invariants hold unchanged inside the joint solve.  Everything is
+deterministic: numpy does the arithmetic, ordering is Python ``sorted``
+with explicit admission-index tie-breaks, and nothing here depends on
+iteration order of the node lists (domain values are sums and counts).
+
+Both integration layers consume this one module: the sim engine's
+``--batch-admission`` wake (``SimEngine._schedule_batch``, which keeps a
+``cache`` dict alive across wakes so the score matrices persist) and the
+extender's ``GET /debug/batchplan`` dry-run surface
+(:meth:`ExtenderScheduler.plan_batch`, cache-less — a dry run rebuilds).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+#: Factorial-cost guard: the exhaustive refinement window is clamped here
+#: (6! = 720 capacity-model evaluations per refined wake — still cheap;
+#: beyond that the "small-window" premise is gone).
+MAX_WINDOW = 6
+
+#: Regret sentinels for gangs the scorer cannot value: feasible
+#: multislice gangs (their placement spans domains — ordered after
+#: every scored peer of their tier) and pre-gated infeasible gangs
+#: (ordered last in tier; their position only feeds the blocked-tier
+#: gate, which is position-independent within a tier).
+_REGRET_UNSCORED = -1.0
+_REGRET_INFEASIBLE = -2.0
+
+#: Score-matrix cache bound: entries above this trigger a stale sweep at
+#: the end of a plan (see plan_batch).  Sized far above the handful of
+#: live (k, shard) buckets any real trace produces.
+_CACHE_CAP = 64
+
+# Entry tuple layout: the planner's working record per gang, kept as a
+# plain tuple because the fleet path builds queue-length of them per
+# wake.  (priority, regret, index, volume, values)
+_E_PRIO, _E_REGRET, _E_INDEX, _E_VOLUME, _E_VALUES = range(5)
+
+
+class GangRequest:
+    """One pending gang as the planner sees it: ``replicas`` members of
+    ``chips`` chips each, at ``priority``, with ``index`` its admission
+    (FIFO) position in the pending set.  ``key`` routes scoring — the
+    replicated control plane hashes it to the shard that would claim the
+    gang, so a batch never values a gang through a replica that cannot
+    bind it."""
+
+    __slots__ = ("index", "name", "replicas", "chips", "priority",
+                 "multislice", "key")
+
+    def __init__(self, index: int, name: str, replicas: int, chips: int,
+                 priority: int = 0, multislice: bool = False,
+                 key: str | None = None) -> None:
+        self.index = index
+        self.name = name
+        self.replicas = replicas
+        self.chips = chips
+        self.priority = priority
+        self.multislice = multislice
+        self.key = key if key is not None else name
+
+    @property
+    def volume(self) -> int:
+        return self.replicas * self.chips
+
+
+class BatchPlan:
+    """The joint solve's verdict: ``order`` — EVERY gang's queue index in
+    attempt order (priority-major, regret-greedy within a tier, window-
+    refined at the contended head); ``infeasible`` — the pre-gated
+    indices (present in ``order`` too, so a blocked high tier still
+    gates lower tiers); per-gang ``records`` (only when planned with
+    ``detail=True`` — the dry-run surface) and the deterministic
+    planning counters."""
+
+    __slots__ = ("order", "infeasible", "records", "regret_reorders",
+                 "window_refinements")
+
+    def __init__(self, order: list[int], infeasible: list[int],
+                 records: list[dict], regret_reorders: int,
+                 window_refinements: int) -> None:
+        self.order = order
+        self.infeasible = infeasible
+        self.records = records
+        self.regret_reorders = regret_reorders
+        self.window_refinements = window_refinements
+
+    def describe(self) -> dict:
+        """JSON-safe summary (the /debug/batchplan body)."""
+        by_index = {r["index"]: r for r in self.records}
+        return {
+            "gangs": self.records,
+            "order": [by_index[i]["gang"] for i in self.order],
+            "infeasible": [by_index[i]["gang"] for i in self.infeasible],
+            "counters": {"regret_reorders": self.regret_reorders,
+                         "window_refinements": self.window_refinements},
+        }
+
+
+class _ScoreMatrix:
+    """One ``(k, shard)`` bucket's scores as a domains x nodes int64
+    matrix, plus the per-domain positive-score counts — the vectorized
+    twin of the ``{node: score}`` dict.  Built once, then patched in
+    O(changed nodes) from the scorer's changed-node report; identity of
+    the backing dict and of the node layout guard staleness (a replaced
+    bucket or a changed alive set can never reuse a stale matrix)."""
+
+    __slots__ = ("scores", "layout", "mat", "npos", "node_pos")
+
+    def __init__(self, scores: dict, layout: dict,
+                 dom_ids: list[str]) -> None:
+        self.scores = scores
+        self.layout = layout
+        width = max(map(len, layout.values()), default=0)
+        self.mat = np.zeros((len(dom_ids), width), dtype=np.int64)
+        self.node_pos: dict[str, tuple[int, int]] = {}
+        get = scores.get
+        for i, d in enumerate(dom_ids):
+            row = layout[d]
+            self.mat[i, :len(row)] = [get(n, 0) for n in row]
+            for j, n in enumerate(row):
+                self.node_pos[n] = (i, j)
+        self.npos = (self.mat > 0).sum(axis=1)
+
+    def patch(self, changed: tuple) -> None:
+        """Apply the scorer's changed-node report: overwrite exactly the
+        reported cells and recount positives for the touched rows.
+        Nodes outside the layout (dead at plan time) are ignored — their
+        rows will be rebuilt wholesale when the alive set changes."""
+        rows: set[int] = set()
+        get = self.scores.get
+        pos = self.node_pos
+        mat = self.mat
+        for n in changed:
+            at = pos.get(n)
+            if at is not None:
+                mat[at[0], at[1]] = get(n, 0)
+                rows.add(at[0])
+        if rows:
+            rl = sorted(rows)
+            self.npos[rl] = (mat[rl] > 0).sum(axis=1)
+
+
+def _refine_window(head: list[tuple], free_by_domain: dict[str, int]) -> \
+        list[tuple] | None:
+    """Exhaustive permutation refinement of the contended head: evaluate
+    every attempt order of ``head`` against a per-domain free-chip
+    capacity model (each gang greedily takes its best still-fitting
+    domain; its value counts only if one fits) and return the best-total
+    order — or None when the greedy order already ties the optimum (ties
+    keep greedy: ``permutations`` yields the identity first and only a
+    strictly better total displaces it)."""
+    best_total = -1
+    best_perm: tuple[tuple, ...] | None = None
+    for perm in itertools.permutations(head):
+        rem = dict(free_by_domain)
+        total = 0
+        for g in perm:
+            for val, d in g[_E_VALUES]:
+                if rem.get(d, 0) >= g[_E_VOLUME]:
+                    total += val
+                    rem[d] -= g[_E_VOLUME]
+                    break
+        if total > best_total:
+            best_total = total
+            best_perm = perm
+    assert best_perm is not None
+    return None if list(best_perm) == head else list(best_perm)
+
+
+def plan_batch(gangs: list[GangRequest], scorer,
+               dom_nodes: dict[str, list[str]],
+               free_by_domain: dict[str, int], *,
+               window: int = 4, cache: dict | None = None,
+               detail: bool = True) -> BatchPlan:
+    """Solve the pending set jointly.
+
+    ``scorer(k, key)`` returns ``(scores, changed)``: the ``{node:
+    score}`` map for ``k``-chip members (the consumer backs it with the
+    persistent score index and memoizes per ``k`` — under replica
+    affinity, per ``(shard, k)``; ``key`` is the gang's routing key) and
+    a changed-node report — None when every entry must be treated as new
+    (first fill, rebuilt bucket), else the tuple of node names whose
+    scores moved since the scorer's previous report (empty when none).
+
+    ``dom_nodes`` maps each domain to its alive nodes (the scoring
+    universe); ``free_by_domain`` is the free-chip capacity model the
+    feasibility gate and the window refinement run against.  Capacity
+    only shrinks while the consumer attempts the returned order, so a
+    pre-gated verdict computed here can never turn feasible mid-wake.
+
+    ``cache`` is an opaque dict the caller keeps alive across calls so
+    the score matrices persist between wakes (entries whose bucket or
+    layout was replaced are dropped at the end of every call); per-gang
+    ``records`` are built only with ``detail=True``."""
+    dom_ids = sorted(dom_nodes)
+    free_arr = np.fromiter((free_by_domain.get(d, 0) for d in dom_ids),
+                           dtype=np.int64, count=len(dom_ids))
+    if cache is None:
+        cache = {}
+    touched: set[int] = set()
+    patched: set[int] = set()
+    # Per-call value memos: top-``r`` column sums per (bucket, r), and
+    # the feasible best-first (value, domain) lists per (bucket, r,
+    # volume) — every gang of a shape shares one computation.
+    tops_memo: dict[tuple[int, int], np.ndarray] = {}
+    vals_memo: dict[tuple, list[tuple[int, str]] | bool] = {}
+
+    def bucket_for(gang: GangRequest) -> _ScoreMatrix:
+        scores, changed = scorer(gang.chips, gang.key)
+        sid = id(scores)
+        sm = cache.get(sid)
+        if sm is None or sm.scores is not scores or sm.layout is not dom_nodes:
+            sm = cache[sid] = _ScoreMatrix(scores, dom_nodes, dom_ids)
+            patched.add(sid)
+        elif sid not in patched:
+            if changed is None:
+                sm = cache[sid] = _ScoreMatrix(scores, dom_nodes, dom_ids)
+            elif changed:
+                sm.patch(changed)
+            patched.add(sid)
+        touched.add(sid)
+        return sm
+
+    def multislice_feasible(gang: GangRequest) -> bool:
+        """The cross-domain necessary conditions a multislice plan can
+        never escape: the whole fleet must hold the gang's chip volume
+        free, and at least ``replicas`` hosts anywhere must score
+        positive (every member is still one ``chips``-box on one host,
+        whichever domain its sub-gang lands in).  Optimistic on
+        everything else — contiguity, generation classing, composition
+        budgets stay the production search's call — so the pre-gate can
+        only skip attempts that were guaranteed to fail."""
+        sm = bucket_for(gang)
+        sid = id(sm.scores)
+        vkey = (sid, "ms", gang.replicas, gang.volume)
+        got = vals_memo.get(vkey)
+        if got is None:
+            got = vals_memo[vkey] = bool(
+                int(free_arr.sum()) >= gang.volume
+                and int(sm.npos.sum()) >= gang.replicas)
+        return got
+
+    def shape_values(gang: GangRequest) -> list[tuple[int, str]]:
+        sid_key = scorer(gang.chips, gang.key)[0]
+        sid = id(sid_key)
+        vkey = (sid, gang.replicas, gang.volume)
+        got = vals_memo.get(vkey)
+        if got is not None:
+            return got
+        sm = bucket_for(gang)
+        r = gang.replicas
+        feas = (free_arr >= gang.volume) & (sm.npos >= r)
+        vals: list[tuple[int, str]] = []
+        if feas.any():
+            # npos >= r implies width >= r, so the top-r column slice is
+            # all-positive for every feasible row and the zero padding
+            # can never leak into a sum.
+            tops = tops_memo.get((sid, r))
+            if tops is None:
+                width = sm.mat.shape[1]
+                if r >= width:
+                    tops = sm.mat.sum(axis=1)
+                else:
+                    tops = np.partition(sm.mat, width - r,
+                                        axis=1)[:, width - r:].sum(axis=1)
+                tops_memo[(sid, r)] = tops
+            vals = [(int(tops[i]), dom_ids[i]) for i in np.nonzero(feas)[0]]
+            vals.sort(key=lambda t: (-t[0], t[1]))
+        vals_memo[vkey] = vals
+        return vals
+
+    entries: list[tuple] = []
+    records: list[dict] = []
+    infeasible: list[int] = []
+    for gang in gangs:
+        if gang.multislice:
+            # Feasibility spans domains — unscored (no per-domain regret
+            # is meaningful), pre-gated only by the cross-domain volume
+            # and host-count conditions no multislice plan can escape.
+            ok = multislice_feasible(gang)
+            if not ok:
+                infeasible.append(gang.index)
+            entries.append((gang.priority,
+                            _REGRET_UNSCORED if ok else _REGRET_INFEASIBLE,
+                            gang.index, gang.volume, []))
+            if detail:
+                records.append({
+                    "index": gang.index, "gang": gang.name,
+                    "replicas": gang.replicas,
+                    "chips_per_member": gang.chips,
+                    "priority": gang.priority, "best_domain": None,
+                    "regret": None, "feasible_domains": None,
+                    "multislice_feasible": ok})
+            continue
+        vals = shape_values(gang)
+        if not vals:
+            infeasible.append(gang.index)
+            entries.append((gang.priority, _REGRET_INFEASIBLE, gang.index,
+                            gang.volume, vals))
+            if detail:
+                records.append({
+                    "index": gang.index, "gang": gang.name,
+                    "replicas": gang.replicas,
+                    "chips_per_member": gang.chips,
+                    "priority": gang.priority, "best_domain": None,
+                    "regret": None, "feasible_domains": 0})
+            continue
+        regret = (float(vals[0][0] - vals[1][0]) if len(vals) > 1
+                  else float("inf"))
+        entries.append((gang.priority, regret, gang.index, gang.volume,
+                        vals))
+        if detail:
+            records.append({
+                "index": gang.index, "gang": gang.name,
+                "replicas": gang.replicas,
+                "chips_per_member": gang.chips,
+                "priority": gang.priority, "best_domain": vals[0][1],
+                "best_value": vals[0][0],
+                "regret": regret if regret != float("inf") else None,
+                "only_feasible_domain": len(vals) == 1,
+                "feasible_domains": len(vals)})
+
+    if len(cache) > _CACHE_CAP:
+        # Stale entries (replaced buckets) are only ever superseded, not
+        # dropped — their held references are what make the id() keys
+        # collision-proof — so bound the lot wholesale: distinct live
+        # (k, shard) buckets are a handful, and blowing past the cap
+        # means bucket churn, where a clean rebuild is the cheap move.
+        stale = [s for s in cache if s not in touched]
+        for sid in stale:
+            del cache[sid]
+
+    base = sorted(entries, key=lambda e: (-e[_E_PRIO], e[_E_INDEX]))
+    ordered = sorted(entries, key=lambda e: (-e[_E_PRIO], -e[_E_REGRET],
+                                             e[_E_INDEX]))
+
+    # Window refinement, top tier only (permuting across tiers would
+    # break admission order): the first `window` SCORED gangs of the
+    # highest tier that has any, refined only when they actually contend
+    # for chips under the capacity model.
+    window_refinements = 0
+    w = max(0, min(int(window), MAX_WINDOW))
+    scored = [e for e in ordered if e[_E_VALUES]]
+    if w >= 2 and len(scored) >= 2:
+        tier = scored[0][_E_PRIO]
+        head = [e for e in scored if e[_E_PRIO] == tier][:w]
+        if len(head) >= 2:
+            demand: dict[str, int] = {}
+            for e in head:
+                d = e[_E_VALUES][0][1]
+                demand[d] = demand.get(d, 0) + e[_E_VOLUME]
+            contended = any(v > free_by_domain.get(d, 0)
+                            for d, v in demand.items())
+            if contended:
+                refined = _refine_window(head, free_by_domain)
+                if refined is not None:
+                    window_refinements = 1
+                    positions = sorted(ordered.index(e) for e in head)
+                    for pos, e in zip(positions, refined):
+                        ordered[pos] = e
+    order = [e[_E_INDEX] for e in ordered]
+    regret_reorders = sum(1 for a, b in zip(base, ordered)
+                          if a[_E_INDEX] != b[_E_INDEX])
+    return BatchPlan(order=order, infeasible=infeasible, records=records,
+                     regret_reorders=regret_reorders,
+                     window_refinements=window_refinements)

@@ -514,10 +514,21 @@ class ExtenderScheduler:
             return
         sidx = getattr(state, "_score_index", None)
         if sidx:
-            for kd in sidx.values():
-                for sid in changed:
-                    for n in state.domains[sid].host_by_node:
-                        kd.pop(n, None)
+            # The batch planner's fill bookkeeping (batch_scores) rides
+            # the same eviction: every popped node lands in the per-k
+            # dirty set so the next batch scoring pass rescored exactly
+            # these — the bookkeeping exists only on states a batch
+            # plan has scored, so non-batch runs never touch it.
+            bfill = getattr(state, "_batch_filled", None)
+            changed_hosts = [n for sid in changed
+                             for n in state.domains[sid].host_by_node]
+            for k, kd in sidx.items():
+                for n in changed_hosts:
+                    kd.pop(n, None)
+                if bfill is not None:
+                    d = bfill.get(k)
+                    if d is not None:
+                        d.update(changed_hosts)
         memo = getattr(state, "_score_memo", None)
         if memo:
             changed_nodes = {n for sid in changed
@@ -1058,6 +1069,59 @@ class ExtenderScheduler:
             return dom.allocator.cost.ici_link_gbps  # blob-only request size
         return predict_allreduce_gbps(dom.topology, shapes[0].dims,
                                       dom.allocator.cost)
+
+    def batch_scores(self, k: int,
+                     node_names: list[str]) -> tuple[dict[str, int],
+                                                     tuple | None]:
+        """The ``{node: score}`` map for ``k``-chip members over
+        ``node_names`` — the batch planner's scoring primitive
+        (tputopo.batch) — plus a changed-node report: None when every
+        entry must be treated as new (first fill of this bucket, or a
+        rebuilt/carried state whose fill bookkeeping did not survive),
+        else the sorted tuple of node names whose scores moved since the
+        previous report (empty when none did).  The first call streams
+        the persistent score-index bucket full exactly like
+        :meth:`sort_best`'s fill; after that only the nodes the in-place
+        fold eviction marked dirty (:meth:`_evict_state_memos`) are
+        rescored — O(changed nodes) per wake instead of a fleet-size
+        scan, which was the batch wake's dominant cost at 1024 nodes.
+        The bucket is returned whole; entries for nodes outside
+        ``node_names`` (dead nodes, earlier fills) are harmless —
+        consumers read only the nodes they ask about, and a dead node's
+        dirty marker is simply refilled along with the rest."""
+        informer_reader = (self.informer if self.informer is not None
+                           and self.informer.synced else None)
+        state = self._state(allow_cache=True, reader=informer_reader)
+        uncached = self._score_node_uncached
+        if not self.SCORE_INDEX:
+            return ({name: uncached(state, k, name)
+                     for name in node_names}, None)
+        kd = self._score_index_for(state, k)
+        filled = getattr(state, "_batch_filled", None)
+        if filled is None:
+            filled = state._batch_filled = {}
+        dirty = filled.get(k)
+        if dirty is None:
+            kd_get = kd.get
+            hits = 0
+            for name in node_names:
+                if kd_get(name) is None:
+                    kd[name] = uncached(state, k, name)
+                else:
+                    hits += 1
+            if hits:
+                self.metrics.inc("score_memo_hits", hits)
+            filled[k] = set()
+            return kd, None
+        changed = tuple(sorted(dirty))
+        if changed:
+            for name in changed:
+                kd[name] = uncached(state, k, name)
+            dirty.clear()
+        hits = len(node_names) - len(changed)
+        if hits > 0:
+            self.metrics.inc("score_memo_hits", hits)
+        return kd, changed
 
     # ---- gang planning -----------------------------------------------------
 
@@ -1609,6 +1673,69 @@ class ExtenderScheduler:
             max_chips_moved=self.config.preempt_max_chips_moved)
         if plan is not None:
             self.metrics.inc("preempt_plans_found")
+        return plan
+
+    # ---- joint batch admission (tputopo.batch) -----------------------------
+
+    def plan_batch(self, window: int = 4):
+        """Dry-run joint batch-admission plan for the CURRENT pending
+        queue (served at ``GET /debug/batchplan``): every unbound pod,
+        taken in :meth:`admission_order` and grouped into gangs, solved
+        jointly by :func:`tputopo.batch.plan_batch` over this
+        scheduler's score index.  Read-only — executing the plan stays
+        the scheduling loop's call, exactly like /debug/preempt."""
+        from tputopo.batch import GangRequest
+        from tputopo.batch import plan_batch as _plan_batch
+        from tputopo.defrag.planner import list_pods_nocopy
+
+        self.metrics.inc("batch_plans_considered")
+        informer_reader = (self.informer if self.informer is not None
+                           and self.informer.synced else None)
+        state = self._state(allow_cache=True, reader=informer_reader)
+        pods = list_pods_nocopy(informer_reader if informer_reader
+                                is not None else self.api)
+        pending = [p for p in pods
+                   if not p.get("spec", {}).get("nodeName")]
+        gangs: list[GangRequest] = []
+        seen_gangs: set[tuple[str, str]] = set()
+        for p in self.admission_order(pending):
+            k = ko.pod_requested_chips(p)
+            if k <= 0:
+                continue
+            md = p.get("metadata", {})
+            g = _gang_of(p)
+            if g is not None:
+                if (g[0], g[1]) in seen_gangs:
+                    continue  # one GangRequest per gang, first-seen order
+                seen_gangs.add((g[0], g[1]))
+                name, replicas = g[1], int(g[2])
+            else:
+                name, replicas = md.get("name", ""), 1
+            meta = {**md.get("annotations", {}), **md.get("labels", {})}
+            gangs.append(GangRequest(
+                len(gangs), name, replicas, k,
+                priority=ko.pod_priority(p),
+                multislice=meta.get(LABEL_ALLOW_MULTISLICE) == "true"))
+        node_names = sorted(state._dom_by_node)
+        memo: dict[int, tuple[dict[str, int], tuple | None]] = {}
+
+        def scorer(k: int, key: str | None = None):
+            got = memo.get(k)
+            if got is None:
+                got = memo[k] = self.batch_scores(k, node_names)
+            return got
+
+        dom_nodes: dict[str, list[str]] = {}
+        for n in node_names:
+            dom_nodes.setdefault(state.domain_of_node(n).slice_id,
+                                 []).append(n)
+        plan = _plan_batch(
+            gangs, scorer, dom_nodes,
+            {dom.slice_id: dom.allocator.free_count
+             for dom in state.domains.values()},
+            window=window)
+        if plan.order:
+            self.metrics.inc("batch_plans_planned")
         return plan
 
     # ---- crash recovery ----------------------------------------------------

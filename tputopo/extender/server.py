@@ -142,6 +142,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._handle_preempt(url.query)
             elif url.path == "/debug/pending":
                 self._handle_pending()
+            elif url.path == "/debug/batchplan":
+                self._handle_batchplan(url.query)
             elif url.path == "/policy":
                 self._send_json(200, self.config.policy_json())
             else:
@@ -305,6 +307,27 @@ class _Handler(BaseHTTPRequestHandler):
                        "max_chips_moved":
                            self.config.preempt_max_chips_moved},
         })
+
+    def _handle_batchplan(self, query: str) -> None:
+        """GET /debug/batchplan?window=W — DRY-RUN joint batch-admission
+        plan (tputopo.batch) for the CURRENT pending queue: every
+        unbound pod via the informer mirror, grouped into gangs in
+        admission order and solved jointly (greedy-with-regret order,
+        infeasibility pre-gates, window refinement).  Read-only —
+        executing the plan stays the scheduling loop's call, exactly
+        like /debug/preempt."""
+        qs = urllib.parse.parse_qs(query)
+        try:
+            window = int(qs.get("window", ["4"])[0])
+            if window < 0:
+                raise ValueError("window must be >= 0")
+        except (ValueError, TypeError) as e:
+            self.scheduler.metrics.inc("bad_requests")
+            self._send_json(400, {"error": f"bad batchplan query "
+                                           f"{query!r}: {e}"})
+            return
+        plan = self.scheduler.plan_batch(window=window)
+        self._send_json(200, {"dry_run": True, **plan.describe()})
 
     def _handle_sort(self) -> None:
         req = self._read_json()

@@ -53,6 +53,7 @@ SWITCH_REGISTRY: tuple[tuple[str, str, str], ...] = (
     ("tputopo/extender/scheduler.py", "ExtenderScheduler", "SCORE_INDEX"),
     ("tputopo/extender/gc.py", "AssumptionGC", "WATERMARK"),
     ("tputopo/sim/engine.py", "SimEngine", "NOCOPY_WRITES"),
+    ("tputopo/sim/engine.py", "SimEngine", "BATCH_ADMISSION"),
     ("tputopo/sim/policies.py", "BaselinePolicy", "delta_fold"),
     ("tputopo/k8s/fakeapi.py", "FakeApiServer", "nocopy_writes"),
 )

@@ -50,6 +50,12 @@ COUNTERS = (
     # counters, and are pinned by the report schema instead)
     "preempt_plans_considered",
     "preempt_plans_found",
+    # joint batch admission (tputopo.batch; extender /debug/batchplan
+    # dry-run planning — the sim engine's per-wake batch tallies are
+    # deterministic report dicts, not Metrics counters, pinned by the
+    # v7 report schema instead)
+    "batch_plans_considered",
+    "batch_plans_planned",
     # baseline-policy state maintenance (tputopo/sim/policies.py,
     # BaselinePolicy.inc — deterministic report-dict counters): the
     # three-way split that replaced invalidate_drops.  delta_applied =

@@ -15,7 +15,8 @@ import sys
 import time
 
 from tputopo.extender.replicas import DEFAULT_REPLICAS, WakeSchedule
-from tputopo.sim.engine import DEFAULT_DEFRAG, DEFAULT_PREEMPT, run_trace
+from tputopo.sim.engine import (DEFAULT_BATCH, DEFAULT_DEFRAG,
+                                DEFAULT_PREEMPT, run_trace)
 from tputopo.sim.policies import available_policies
 from tputopo.sim.trace import TraceConfig
 
@@ -147,6 +148,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "byte-identical to v6; on adds the affinity "
                         "marker to the replicas block and the resolved "
                         "knob record")
+    p.add_argument("--batch-admission", action="store_true",
+                   help="joint batch admission (tputopo.batch): every "
+                        "scheduling wake plans the WHOLE pending queue "
+                        "jointly — one amortized scoring pass over the "
+                        "score index, greedy-with-regret attempt order "
+                        "within each tier, infeasible gangs pre-gated, "
+                        "a small-window exhaustive refinement at the "
+                        "contended head; adds the per-policy batch "
+                        "block (schema tputopo.sim/v7).  Off is "
+                        "byte-identical to the per-gang wake")
+    p.add_argument("--batch-window", type=int,
+                   default=DEFAULT_BATCH["window"],
+                   help="exhaustive-refinement window: max head gangs "
+                        "permuted per contended wake (clamped to 6)")
     p.add_argument("--chaos", default=None, metavar="PROFILE",
                    help="run under the seeded fault-injection layer "
                         "(tputopo.chaos): injected CAS conflicts, "
@@ -244,6 +259,13 @@ def main(argv: list[str] | None = None) -> int:
         print("--replica-affinity requires --replicas > 1",
               file=sys.stderr)
         return 2
+    batch = None
+    if args.batch_admission:
+        if args.batch_window < 0:
+            print(f"--batch-window must be >= 0, got {args.batch_window}",
+                  file=sys.stderr)
+            return 2
+        batch = {"window": args.batch_window}
     defrag = None
     if args.defrag:
         defrag = {"period_s": args.defrag_period,
@@ -272,6 +294,7 @@ def main(argv: list[str] | None = None) -> int:
                                    chaos=args.chaos,
                                    preempt=preempt,
                                    replicas=replicas,
+                                   batch=batch,
                                    return_states=True)
         prof.disable()
         buf = io.StringIO()
@@ -287,6 +310,7 @@ def main(argv: list[str] | None = None) -> int:
                                    chaos=args.chaos,
                                    preempt=preempt,
                                    replicas=replicas,
+                                   batch=batch,
                                    return_states=True)
     # tpulint: disable=determinism -- CLI wall timing feeds the throughput block only
     wall_s = time.perf_counter() - t0

@@ -23,6 +23,7 @@ import heapq
 import random
 import time
 
+from tputopo.batch import GangRequest, plan_batch
 from tputopo.defrag import DefragController
 from tputopo.deviceplugin.reporter import node_object_for_probe
 from tputopo.extender.replicas import DEFAULT_REPLICAS
@@ -36,7 +37,8 @@ from tputopo.k8s.fakeapi import FakeApiServer, NotFound
 from tputopo.priority import backfill_ok, plan_preemption
 from tputopo.defrag.planner import list_pods_nocopy
 from tputopo.sim.policies import get_policy, pods_for_job
-from tputopo.sim.report import MetricsCollector, build_report, tier_block
+from tputopo.sim.report import (MetricsCollector, batch_block, build_report,
+                                tier_block)
 from tputopo.sim.trace import JobSpec, Trace, TraceConfig, generate_trace
 from tputopo.topology.slices import Allocator, enumerate_shapes
 from tputopo.topology.score import (_box_of, predict_allreduce_gbps,
@@ -174,6 +176,14 @@ DEFAULT_PREEMPT = {
     "backfill_limit_s": 180.0,
 }
 
+#: Default knobs for joint batch admission (``--batch-admission``,
+#: tputopo.batch): the exhaustive-refinement window over the top
+#: contended shapes of a wake (clamped to planner.MAX_WINDOW; 4! = 24
+#: capacity-model evaluations per refined wake).
+DEFAULT_BATCH = {
+    "window": 4,
+}
+
 
 class _GcChaosMetrics:
     """Counter-only Metrics facade for the engine's :class:`AssumptionGC`.
@@ -215,6 +225,14 @@ class SimEngine:
     #: False restores the historical deepcopy write path byte-for-byte.
     NOCOPY_WRITES = True
 
+    #: Kill switch for joint batch admission (tputopo.batch): with batch
+    #: knobs present AND this True, every wake plans the whole pending
+    #: queue jointly (greedy-with-regret order + infeasibility pre-gates
+    #: from one amortized scoring pass) before attempting placements.
+    #: False — or absent knobs — runs the per-gang FIFO/tiered wake
+    #: byte-for-byte, schema included.
+    BATCH_ADMISSION = True
+
     def __init__(self, trace: Trace, policy_name: str, *,
                  assume_ttl_s: float = 60.0, gc_period_s: float = 30.0,
                  max_backfill_failures: int = 8,
@@ -223,6 +241,7 @@ class SimEngine:
                  chaos: str | dict | None = None,
                  preempt: dict | None = None,
                  replicas: dict | None = None,
+                 batch: dict | None = None,
                  audit_every: int = 0) -> None:
         self.trace = trace
         self.cfg = trace.config
@@ -386,6 +405,25 @@ class SimEngine:
                 self._plan_api, "list_assignments", None) or (
                 lambda: list_pods_nocopy(self._plan_api))
 
+        # Joint batch admission (tputopo.batch), opt-in behind the
+        # registered BATCH_ADMISSION kill switch: knobs present + switch
+        # on arms the per-wake joint solve; either off leaves every wake
+        # (and the report schema) byte-identical to the per-gang path.
+        self.batch_knobs = ({**DEFAULT_BATCH, **batch}
+                            if (batch is not None and self.BATCH_ADMISSION)
+                            else None)
+        # Deterministic planning tallies for the report's `batch` block
+        # (plain dict arithmetic, not Metrics counters — they are report
+        # body, not scheduler telemetry).
+        self.batch_stats = ({"batches": 0, "regret_reorders": 0,
+                             "window_refinements": 0, "sorts_avoided": 0}
+                            if self.batch_knobs is not None else None)
+        self._batch_gang_sizes: list[int] = []
+        # Planner score-matrix cache and the domain->alive-nodes layout,
+        # both persistent across wakes (see _schedule_batch).
+        self._batch_cache: dict = {}
+        self._batch_dom_nodes: tuple | None = None
+
         # Defragmentation loop (tputopo.defrag), opt-in: a periodic
         # controller cycle on virtual time, evicting through the same
         # requeue path node failures use.  Deterministic: the controller
@@ -504,6 +542,11 @@ class SimEngine:
             # Replicated-control-plane block (None whenever the policy is
             # unreplicated — its absence pins every prior schema's bytes).
             replicas=self.policy.replicas_block(),
+            # Joint-batch-admission block (None with the feature off —
+            # its absence pins the v2–v6 report bytes).
+            batch=(dict(self.batch_stats,
+                        gangs_per_batch=list(self._batch_gang_sizes))
+                   if self.batch_stats is not None else None),
         )
 
     def run_events(self) -> None:
@@ -802,6 +845,15 @@ class SimEngine:
         if self.ghosts and min(self.ghosts.values()) <= self.clock.t:
             self._sweep()
         alive = [n for n in self.node_names if n not in self.failed_nodes]
+        if self.batch_knobs is not None and self.queue:
+            # Joint batch admission (tputopo.batch): one scoring pass
+            # plans the whole pending set, then the tier-aware wake
+            # attempts placements in the planned order with infeasible
+            # gangs pre-gated — admission_order, the backfill gate and
+            # preemption all still apply inside the joint solve.
+            self._schedule_batch(alive)
+            self._sample_occupancy()
+            return
         if self._tiered:
             # Priority tiers present (tputopo.priority): the wake runs
             # the tier-aware variant — admission order, the backfill
@@ -888,7 +940,9 @@ class SimEngine:
     def _pcount(self, key: str, by: int = 1) -> None:
         self.preempt_counters[key] = self.preempt_counters.get(key, 0) + by
 
-    def _schedule_tiered(self, alive: list[str]) -> None:
+    def _schedule_tiered(self, alive: list[str],
+                         order: list[int] | None = None,
+                         pregated: set[int] | None = None) -> None:
         """The tier-aware scheduling wake: jobs attempt in admission
         order (higher tier first, FIFO within — the job-level spelling
         of the pod rule ``ExtenderScheduler.admission_order`` serves at
@@ -897,13 +951,23 @@ class SimEngine:
         and — with ``--preempt`` — an infeasible tiered job may evict the
         cheapest strictly-lower-tier victim set and retry immediately.
 
+        The batch wake passes ``order`` (the joint plan's attempt order
+        — still tier-major, so the backfill gate's semantics are
+        unchanged: gating compares tiers with strict ``<``, never
+        within-tier position) and ``pregated`` (queue indices the joint
+        solve proved infeasible at current capacity: they take the same
+        per-epoch infeasibility verdict a failed ``place()`` would and
+        still gate lower tiers, but spend no sort and no failure
+        budget).
+
         No rotation: the rotating window exists to keep head-of-queue
         failures from starving FIFO peers, and admission priority IS the
         fairness policy here; per-epoch failure memos still keep a stuck
         queue from costing O(queue) sorts per wake."""
         n = len(self.queue)
-        order = sorted(range(n),
-                       key=lambda i: (-self.queue[i].spec.priority, i))
+        if order is None:
+            order = sorted(range(n),
+                           key=lambda i: (-self.queue[i].spec.priority, i))
         # None = gate off (no preempt knobs, terminal drain, or a
         # non-positive limit — the documented "disable" spelling).
         backfill_limit = None
@@ -920,6 +984,20 @@ class SimEngine:
                 # Known-infeasible this epoch: no sort spent, but it is
                 # still BLOCKED — lower tiers behind it stay gated.
                 if blocked_priority is None or spec.priority > blocked_priority:
+                    blocked_priority = spec.priority
+                continue
+            if pregated is not None and i in pregated:
+                # Joint-solve pre-gate: no domain can hold this gang at
+                # current capacity (which only shrinks within the wake),
+                # so record the infeasibility verdict without spending a
+                # sort — and without consuming the failure budget, which
+                # exists to bound sort work.  The epoch memo is written
+                # directly (not via _note_place_failure): no attempt ran
+                # this wake, so there is no partial bind to reset — the
+                # previous attempt's failure path already did that.
+                run.failed_epoch = self.capacity_epoch
+                if blocked_priority is None \
+                        or spec.priority > blocked_priority:
                     blocked_priority = spec.priority
                 continue
             if failures >= self.max_backfill_failures:
@@ -967,6 +1045,80 @@ class SimEngine:
             placed.add(id(run))
         if placed:
             self.queue = [r for r in self.queue if id(r) not in placed]
+
+    # ---- joint batch admission (tputopo.batch) -----------------------------
+
+    def _batch_fallback_scorer(self, alive: list[str]):
+        """Capacity-only scorer for policies without a score index (the
+        baselines): a node scores its twin free-chip count for any
+        ``k`` it could possibly hold (free >= k), else 0.  Optimistic by
+        construction — free chips need not form a ``k``-box — which is
+        exactly what keeps the planner's pre-gate sound: it may miss a
+        pre-gate, never invent one."""
+        free_count = {}
+        for n in alive:
+            tw = self.twin[self.domain_of_node[n]]
+            free_count[n] = sum(1 for c in self.chips_by_node[n]
+                                if c in tw.free)
+        memo: dict[int, tuple[dict[str, int], None]] = {}
+
+        def scores(k: int, key: str | None = None):
+            got = memo.get(k)
+            if got is None:
+                got = memo[k] = ({n: (c if c >= k else 0)
+                                  for n, c in free_count.items()}, None)
+            return got
+
+        return scores
+
+    def _batch_dom_nodes_for(self, alive: list[str]) -> dict[str, list[str]]:
+        """The planner's domain -> alive-nodes layout, cached across
+        wakes keyed on the (tiny) failed-node set — the alive universe
+        only moves on failure/repair events, and rebuilding a fleet-size
+        grouping dict per wake was pure overhead.  The cached object's
+        identity doubles as the planner's layout-staleness guard."""
+        dead_key = tuple(sorted(self.failed_nodes))
+        cached = self._batch_dom_nodes
+        if cached is not None and cached[0] == dead_key:
+            return cached[1]
+        dom_nodes: dict[str, list[str]] = {}
+        for n in alive:
+            dom_nodes.setdefault(self.domain_of_node[n], []).append(n)
+        self._batch_dom_nodes = (dead_key, dom_nodes)
+        return dom_nodes
+
+    def _schedule_batch(self, alive: list[str]) -> None:
+        """The joint batch-admission wake: ONE scoring pass (the policy's
+        score index, synced once) values every pending gang against
+        every domain, the planner orders the whole set (tier-major,
+        greedy-with-regret within, window-refined at the contended head)
+        and pre-gates the gangs no domain can hold, then the tier-aware
+        wake attempts placements in that order — placement itself stays
+        on the production sort/bind path, so ledger/chaos/replica
+        invariants hold unchanged inside the joint solve.  The planner's
+        score matrices persist across wakes in ``self._batch_cache``,
+        patched from the scorer's changed-node reports."""
+        gangs = [GangRequest(i, run.spec.name, run.spec.replicas,
+                             run.spec.chips, priority=run.spec.priority,
+                             multislice=run.spec.multislice)
+                 for i, run in enumerate(self.queue)]
+        scorer = self.policy.batch_scorer(alive)
+        if scorer is None:
+            scorer = self._batch_fallback_scorer(alive)
+        plan = plan_batch(
+            gangs, scorer,
+            self._batch_dom_nodes_for(alive),
+            {sid: tw.free_count for sid, tw in self.twin.items()},
+            window=int(self.batch_knobs["window"]),
+            cache=self._batch_cache, detail=False)
+        st = self.batch_stats
+        st["batches"] += 1
+        st["regret_reorders"] += plan.regret_reorders
+        st["window_refinements"] += plan.window_refinements
+        st["sorts_avoided"] += len(plan.infeasible)
+        self._batch_gang_sizes.append(len(gangs))
+        self._schedule_tiered(alive, order=plan.order,
+                              pregated=set(plan.infeasible))
 
     def _try_preempt(self, run: _JobRun) -> bool:
         """Targeted preemption for one blocked tiered job: plan the
@@ -1210,13 +1362,14 @@ class RunState:
     __slots__ = ("policy_name", "horizon_s", "end_t", "metrics",
                  "placed_chips", "frag", "counters", "events_processed",
                  "phases", "phase_wall_ms", "decision_log", "defrag",
-                 "chaos", "tiers", "preempt", "replicas")
+                 "chaos", "tiers", "preempt", "replicas", "batch")
 
     def __init__(self, *, policy_name, horizon_s, end_t, metrics,
                  placed_chips, frag, counters, events_processed,
                  phases=None, phase_wall_ms=None,
                  decision_log=None, defrag=None, chaos=None,
-                 tiers=None, preempt=None, replicas=None) -> None:
+                 tiers=None, preempt=None, replicas=None,
+                 batch=None) -> None:
         self.policy_name = policy_name
         self.horizon_s = horizon_s
         self.end_t = end_t
@@ -1233,6 +1386,7 @@ class RunState:
         self.tiers = tiers
         self.preempt = preempt
         self.replicas = replicas
+        self.batch = batch
 
 
 def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
@@ -1274,6 +1428,11 @@ def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
         # byte-for-byte.  Fully deterministic (seeded wake schedule,
         # virtual-time delivery, counter sums).
         out["replicas"] = rs.replicas
+    if rs.batch is not None:
+        # Joint-batch-admission block (schema tputopo.sim/v7,
+        # tputopo.batch) — present only under --batch-admission; its
+        # absence keeps every prior schema's report bytes pinned.
+        out["batch"] = batch_block(rs.batch)
     return out
 
 
@@ -1310,11 +1469,12 @@ def _run_policy_worker(args) -> RunState:
     pinned by tests) so nothing heavyweight crosses the process boundary
     in either direction."""
     (cfg, name, assume_ttl_s, gc_period_s, flight_trace, defrag, chaos,
-     preempt, replicas) = args
+     preempt, replicas, batch) = args
     engine = SimEngine(generate_trace(cfg), name,
                        assume_ttl_s=assume_ttl_s, gc_period_s=gc_period_s,
                        flight_trace=flight_trace, defrag=defrag,
-                       chaos=chaos, preempt=preempt, replicas=replicas)
+                       chaos=chaos, preempt=preempt, replicas=replicas,
+                       batch=batch)
     engine.run_events()
     return engine.run_state()
 
@@ -1326,6 +1486,7 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
               chaos: str | None = None,
               preempt: dict | None = None,
               replicas: dict | None = None,
+              batch: dict | None = None,
               return_states: bool = False):
     """Replay one deterministic trace under each policy and build the
     A/B report.  Every policy sees the identical event stream.
@@ -1377,7 +1538,15 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
     percentiles, SLO attainment, preemption disruption) and — under
     preempt — the ``preempt`` counter block, with the knobs recorded at
     ``engine.preempt``.  Untiered preempt-off runs keep the v2/v3/v4
-    shapes byte-for-byte."""
+    shapes byte-for-byte.
+
+    ``batch`` (a knob dict merged over :data:`DEFAULT_BATCH`, or None)
+    arms joint batch admission (tputopo.batch, behind the registered
+    ``SimEngine.BATCH_ADMISSION`` kill switch): every wake plans the
+    whole pending queue jointly before attempting placements.  Each
+    policy record gains a deterministic ``batch`` block, the knobs land
+    under ``engine.batch``, and the schema becomes ``tputopo.sim/v7``;
+    None — or the switch off — keeps every prior shape byte-for-byte."""
     # tpulint: disable=determinism -- throughput.wall_s is the documented wall-clock exception
     t0 = time.perf_counter()
     defrag_knobs = ({**DEFAULT_DEFRAG, **defrag}
@@ -1389,8 +1558,12 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
         knobs = {**DEFAULT_REPLICAS, **replicas}
         if int(knobs["count"]) > 1:
             replica_knobs = knobs
+    batch_knobs = ({**DEFAULT_BATCH, **batch}
+                   if (batch is not None and SimEngine.BATCH_ADMISSION)
+                   else None)
     work = [(cfg, name, assume_ttl_s, gc_period_s, flight_trace,
-             defrag_knobs, chaos, preempt_knobs, replica_knobs)
+             defrag_knobs, chaos, preempt_knobs, replica_knobs,
+             batch_knobs)
             for name in policy_names]
     if jobs > 1 and len(work) > 1:
         import multiprocessing as mp
@@ -1442,6 +1615,12 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
         # distinguishable; absent on unreplicated runs so prior schema
         # bytes stay pinned.
         engine_params["replicas"] = dict(sorted(replica_knobs.items()))
+    if batch_knobs is not None:
+        # The resolved batch knobs — same rule as defrag/chaos/preempt/
+        # replicas: two batch reports differing only in knobs must be
+        # distinguishable; absent on batch-off runs so prior schema
+        # bytes stay pinned.
+        engine_params["batch"] = dict(sorted(batch_knobs.items()))
     report = build_report(
         cfg.describe(), horizon, policies,
         engine_params=engine_params,
@@ -1452,6 +1631,7 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
         schema_priority=(preempt_knobs is not None
                          or any("tiers" in p for p in policies.values())),
         schema_replicas=replica_knobs is not None,
+        schema_batch=batch_knobs is not None,
         throughput={
             "events": events,  # deterministic
             "wall_s": round(wall_s, 3),

@@ -137,6 +137,17 @@ class PlacementPolicy:
         absence, the same rule as defrag/chaos/tiers."""
         return None
 
+    def batch_scorer(self, node_names: list[str]):
+        """A per-wake ``scores(k, key) -> ({node: score}, changed)``
+        callable for the joint batch-admission planner (tputopo.batch),
+        or None when this policy has no score model — the engine then
+        falls back to a capacity-only scorer built from its twin ledger.
+        ``changed`` is the scorer's changed-node report (None = treat
+        every entry as new); ``key`` is the gang's routing key (its
+        name); only the replicated subclass uses it, to score through
+        the shard that would claim the gang."""
+        return None
+
 
 class IciAwarePolicy(PlacementPolicy):
     """The framework under test: sort -> max score -> bind, per member."""
@@ -197,6 +208,22 @@ class IciAwarePolicy(PlacementPolicy):
     def _wake_committed(self, decisions: list[dict]) -> None:
         """Hook after a successful wake's decisions commit — the
         replicated subclass logs the binds for delayed peer delivery."""
+
+    def batch_scorer(self, node_names: list[str]):
+        """One cached-state scoring pass per (wake, k): the scheduler's
+        :meth:`ExtenderScheduler.batch_scores` fills the persistent
+        score-index bucket once and every gang of that member size in
+        the batch reads it — the amortization the batch wake exists
+        for (the per-gang path re-enters the index per member sort)."""
+        memo: dict[int, tuple[dict[str, int], tuple | None]] = {}
+
+        def scores(k: int, key: str | None = None):
+            got = memo.get(k)
+            if got is None:
+                got = memo[k] = self.sched.batch_scores(k, node_names)
+            return got
+
+        return scores
 
     def place(self, job: JobSpec, node_names: list[str],
               handles: list | None = None) -> list[dict] | None:
@@ -465,6 +492,36 @@ class ReplicatedIciPolicy(IciAwarePolicy):
 
     def replicas_block(self) -> dict | None:
         return self.rset.block(self._merged_counters())
+
+    def batch_scorer(self, node_names: list[str]):
+        """Shard-aware scoring for the joint solve: under
+        ``--replica-affinity`` each gang is valued through the replica
+        its key HASHES to — the same ``affinity_shard`` rule
+        ``WakeSchedule.next_for`` applies when the wake later claims it,
+        so a batch planned by one replica never values (or claims) a
+        gang hashed to a different shard.  Without affinity the wake
+        replica is drawn from the seeded schedule at claim time, so
+        scoring reads shard 0's view — a stale-optimistic proxy, which
+        the planner's pre-gate tolerates by construction (optimism can
+        only miss a pre-gate, never invent one).  Scoring must not call
+        ``begin_wake``: that would advance the seeded wake schedule and
+        perturb which replica serves each subsequent claim."""
+        from tputopo.extender.replicas import affinity_shard
+
+        scheds = self.rset.schedulers
+        use_affinity = self.rset.schedule.affinity
+        memo: dict[tuple[int, int], tuple[dict[str, int], tuple | None]] = {}
+
+        def scores(k: int, key: str | None = None):
+            shard = (affinity_shard(key, len(scheds))
+                     if use_affinity and key is not None else 0)
+            got = memo.get((shard, k))
+            if got is None:
+                got = memo[(shard, k)] = scheds[shard].batch_scores(
+                    k, node_names)
+            return got
+
+        return scores
 
 
 class BaselinePolicy(PlacementPolicy):

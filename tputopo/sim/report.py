@@ -51,6 +51,9 @@ Schema (``tputopo.sim/v2``)::
                                               "ambiguous_timeout"},
                        "stale_cache_aborts", "foreign_bind_adoptions"}
                                                     # v6 (--replicas > 1)
+          "batch": {"batches", "gangs_per_batch": {"p50", "p95", "mean",
+                    "max"}, "regret_reorders", "window_refinements",
+                    "sorts_avoided"}               # v7 (--batch-admission)
         }, ...
       },
       "ab": {"policies": [...], "deltas": {<metric>: a_minus_b},
@@ -111,6 +114,16 @@ SCHEMA_PRIORITY = "tputopo.sim/v5"
 #: deterministic (seeded wake schedule, virtual-time watch delivery) —
 #: part of the byte-determinism contract.
 SCHEMA_REPLICAS = "tputopo.sim/v6"
+#: v7 = the above plus the joint-batch-admission surfaces
+#: (tputopo.batch): the per-policy ``batch`` block (batches planned,
+#: gangs-per-batch distribution, regret reorders, window refinements,
+#: sorts avoided by the infeasibility pre-gate) and the ``engine.batch``
+#: knob record — emitted ONLY when ``--batch-admission`` armed the joint
+#: solve (knobs present AND the SimEngine.BATCH_ADMISSION switch on).
+#: Batch-off runs keep emitting the v2..v6 shapes byte-for-byte.  All v7
+#: content is deterministic virtual-time fact — part of the
+#: byte-determinism contract.
+SCHEMA_BATCH = "tputopo.sim/v7"
 
 #: The pinned schema-key manifest: which top-level report keys and
 #: per-policy record keys each schema version emits, and which of them
@@ -138,6 +151,7 @@ SCHEMA_KEY_MANIFEST = {
     "tputopo.sim/v4": {"policy_gated": ("chaos",)},
     "tputopo.sim/v5": {"policy_gated": ("tiers", "preempt")},
     "tputopo.sim/v6": {"policy_gated": ("replicas",)},
+    "tputopo.sim/v7": {"policy_gated": ("batch",)},
 }
 
 #: The extender counters the report's per-policy ``scheduler`` block
@@ -167,6 +181,11 @@ SCHEDULER_COUNTER_KEEP = (
     "recover_foreign_bind_adopted",
     "replica_bind_lost_race", "replica_conflict_ambiguous",
     "replica_stale_cache_aborts",
+    # Joint batch admission (tputopo.batch): dry-run plan traffic on the
+    # extender's /debug/batchplan surface.  Presence-gated like the
+    # preempt pair — a run that never planned a batch never increments
+    # them, so prior report bytes stay pinned.
+    "batch_plans_considered", "batch_plans_planned",
 )
 
 
@@ -325,6 +344,29 @@ def tier_block(tier_stats: dict[str, dict]) -> dict:
     return out
 
 
+def batch_block(stats: dict) -> dict:
+    """Shape the engine's joint-batch-admission tallies into the report's
+    ``batch`` block (schema v7): batches planned, the gangs-per-batch
+    distribution (the shared ceil-rank quantile convention), and the
+    planner's deterministic counters — regret reorders (positions where
+    the joint order departed from tier-then-FIFO), window refinements,
+    and sorts avoided by the infeasibility pre-gate."""
+    counts = sorted(stats["gangs_per_batch"])
+    gp: dict = {"p50": 0.0, "p95": 0.0, "mean": 0.0, "max": 0}
+    if counts:
+        gp = {"p50": _r(quantile(counts, 0.5)),
+              "p95": _r(quantile(counts, 0.95)),
+              "mean": _r(sum(counts) / len(counts)),
+              "max": counts[-1]}
+    return {
+        "batches": stats["batches"],
+        "gangs_per_batch": gp,
+        "regret_reorders": stats["regret_reorders"],
+        "window_refinements": stats["window_refinements"],
+        "sorts_avoided": stats["sorts_avoided"],
+    }
+
+
 #: Scalar extractors for the A/B delta block: name -> path into a policy
 #: record.  Deltas are first-listed-policy minus each comparator.
 _DELTA_AXES = {
@@ -363,9 +405,11 @@ def build_report(trace_desc: dict, horizon_s: float,
                  schema_defrag: bool = False,
                  schema_chaos: bool = False,
                  schema_priority: bool = False,
-                 schema_replicas: bool = False) -> dict:
+                 schema_replicas: bool = False,
+                 schema_batch: bool = False) -> dict:
     out = {
-        "schema": (SCHEMA_REPLICAS if schema_replicas
+        "schema": (SCHEMA_BATCH if schema_batch
+                   else SCHEMA_REPLICAS if schema_replicas
                    else SCHEMA_PRIORITY if schema_priority
                    else SCHEMA_CHAOS if schema_chaos
                    else SCHEMA_DEFRAG if schema_defrag else SCHEMA),
