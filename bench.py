@@ -438,7 +438,8 @@ def bench_scale(n_domains: int = 4, spec: str = "v5p:8x8x4",
 
 
 def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0,
-              fleet_nodes: int = 256, fleet_arrivals: int = 2000) -> dict:
+              fleet_nodes: int = 256, fleet_arrivals: int = 2000,
+              fleet2_nodes: int = 1024, fleet2_arrivals: int = 8000) -> dict:
     """Trace-driven sim scenario (tputopo.sim): one deterministic Poisson
     trace replayed under the ICI-aware policy AND the count-only baseline,
     reported as the A/B block future perf/policy PRs diff against.  Pure
@@ -538,6 +539,11 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0,
         "ref": "BENCH_r05 (PR 12, ROADMAP fleet-scale record)",
         "fleet_1024x10000": {"wall_s": 280.0, "events_per_s": 144.0},
         "standard_64x500_no_trace": {"wall_s": 1.2, "events_per_s": 2000.0},
+        # The PR-16 dev-host record for the same documented command —
+        # inlined alongside r05 so BENCH_r06+ diffs against the most
+        # recent standing figure without re-running old code.
+        "pr16_fleet_1024x10000_fifo": {"wall_s": 27.0,
+                                       "events_per_s": 746.0},
     }
     out["fleet"] = {
         "nodes": fleet["trace"]["nodes"],
@@ -566,6 +572,52 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0,
             "bw_vs_ideal": p["ici_bw_score"]["mean_vs_ideal"],
             "scheduled": p["jobs"]["scheduled"],
         }
+    # Second fleet scale (the XL standing figure): the saturation-wake
+    # work (PR 17) is superlinear in fleet size — per-wake costs grow
+    # with both queue depth and domain count — so one scale point can't
+    # show whether a perf change flattens the curve or just shifts it.
+    # 1024/8000 here (minutes-runnable), with
+    # `python -m tputopo.sim --nodes 4096 --arrivals 40000
+    # --offered-load 0.73 --no-trace` as the documented dev-host XL
+    # standing command (figures recorded in the ROADMAP saturation
+    # entry).  Same best-of-2 wall rule as the first fleet leg; single
+    # policy — the A/B axes live in the first leg, this one exists for
+    # events_per_s scaling only.
+    xl_cfg = TraceConfig(seed=seed, nodes=fleet2_nodes,
+                         arrivals=fleet2_arrivals, offered_load=0.73)
+    xl = run_trace(xl_cfg, ["ici"], flight_trace=False)
+    xl2 = run_trace(xl_cfg, ["ici"], flight_trace=False)
+    xl_wall_runs = sorted([xl["throughput"]["wall_s"],
+                           xl2["throughput"]["wall_s"]])
+    if xl2["throughput"]["wall_s"] < xl["throughput"]["wall_s"]:
+        xl = xl2
+    xp = xl["policies"]["ici"]
+    out["fleet_xl"] = {
+        "nodes": xl["trace"]["nodes"],
+        "chips": xl["trace"]["chips"],
+        "arrivals": fleet2_arrivals,
+        "offered_load": xl["trace"]["offered_load"],
+        "events": xl["throughput"]["events"],
+        "events_per_s": xl["throughput"]["events_per_s"],
+        "wall_s": xl["throughput"]["wall_s"],
+        "wall_s_runs": xl_wall_runs,
+        # The dev-host standing records this leg is diffed against
+        # (same inline rule as the first fleet leg's r05 ref): the
+        # PR-16 1024x10000 fifo figure anchors the pre-watermark cost
+        # curve, and the PR-17 4096x40000 switch A/B is the first XL
+        # record (that scale had no earlier measurement).
+        "baseline_ref": {
+            "ref": "PR 16/17 dev-host records (ROADMAP entries)",
+            "fleet_1024x10000_fifo": {"wall_s": 27.0,
+                                      "events_per_s": 746.0},
+            "fleet_4096x40000_pr17": {"events_per_s_off": 293.2,
+                                      "events_per_s_on": 403.0},
+        },
+        "queue_wait_p95_s": xp["queue_wait_s"]["p95"],
+        "utilization": xp["chip_utilization"]["time_weighted_mean"],
+        "scheduled": xp["jobs"]["scheduled"],
+        "watermark": xp.get("watermark"),
+    }
     mixed = run_trace(
         TraceConfig(seed=seed, nodes=nodes, arrivals=arrivals,
                     workload="mixed"),

@@ -13,7 +13,8 @@ from tests.cluster import build_cluster
 from tputopo.batch import GangRequest, plan_batch
 from tputopo.k8s import objects as ko
 from tputopo.sim.engine import SimEngine, run_trace
-from tputopo.sim.report import SCHEMA_BATCH, SCHEMA_REPLICAS
+from tputopo.sim.report import (SCHEMA_BATCH, SCHEMA_REPLICAS,
+                                SCHEMA_WATERMARK)
 from tputopo.sim.trace import TraceConfig
 
 CLOCK = lambda: 1000.0  # noqa: E731
@@ -218,7 +219,7 @@ def test_batch_on_deterministic_with_v7_block():
     rb = run_trace(cfg, ["ici", "naive"], batch={})
     rj = run_trace(cfg, ["ici", "naive"], batch={}, jobs=2)
     assert _canon(ra) == _canon(rb) == _canon(rj)
-    assert ra["schema"] == SCHEMA_BATCH
+    assert ra["schema"] == SCHEMA_WATERMARK
     assert ra["engine"]["batch"] == {"window": 4}
     for pol in ra["policies"].values():
         blk = pol["batch"]

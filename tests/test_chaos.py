@@ -21,7 +21,7 @@ from tputopo.k8s.fakeapi import Conflict
 from tputopo.k8s.informer import Informer
 from tputopo.k8s.retry import ApiUnavailable, RetryPolicy
 from tputopo.sim.engine import SimEngine, run_trace
-from tputopo.sim.report import SCHEMA, SCHEMA_CHAOS
+from tputopo.sim.report import SCHEMA_CHAOS, SCHEMA_WATERMARK
 from tputopo.sim.trace import TraceConfig, generate_trace
 
 from tests.test_informer import wait_until
@@ -294,7 +294,7 @@ def test_chaos_run_deterministic_with_clean_invariants():
 
 def test_chaos_off_keeps_schema_and_omits_block():
     r = run_trace(_small_cfg(arrivals=10), ["ici"])
-    assert r["schema"] == SCHEMA
+    assert r["schema"] == SCHEMA_WATERMARK
     assert "chaos" not in r["policies"]["ici"]
     assert "chaos" not in r["engine"]
 

@@ -13,7 +13,7 @@ from tputopo.defrag.planner import placeable_free_box, pressure_report
 from tputopo.extender.state import ClusterState
 from tputopo.k8s import objects as ko
 from tputopo.sim.engine import SimEngine, finalize_run_state, run_trace
-from tputopo.sim.report import SCHEMA, SCHEMA_DEFRAG
+from tputopo.sim.report import SCHEMA_WATERMARK
 from tputopo.sim.trace import JobSpec, Trace, TraceConfig
 
 CLOCK = lambda: 1000.0  # noqa: E731 — staged occupancy stamps this time
@@ -443,12 +443,12 @@ def test_run_trace_defrag_schema_and_block():
     cfg = TraceConfig(seed=0, nodes=8, spec="v5p:2x2x4", arrivals=30,
                       node_failures=0)
     off = run_trace(cfg, ["ici"])
-    assert off["schema"] == SCHEMA
+    assert off["schema"] == SCHEMA_WATERMARK
     assert "defrag" not in off["policies"]["ici"]
     assert "defrag" not in off["engine"]
     on_a = run_trace(cfg, ["ici"], defrag={"hysteresis": 1})
     on_b = run_trace(cfg, ["ici"], defrag={"hysteresis": 1})
-    assert on_a["schema"] == SCHEMA_DEFRAG
+    assert on_a["schema"] == SCHEMA_WATERMARK
     assert on_a["policies"]["ici"]["defrag"]["cycles"] > 0
     assert on_a["engine"]["defrag"]["hysteresis"] == 1
 

@@ -19,7 +19,7 @@ from tputopo.k8s.informer import Informer
 from tputopo.priority import (admission_order, backfill_ok, plan_preemption,
                               victim_priorities)
 from tputopo.sim.engine import SimEngine, finalize_run_state, run_trace
-from tputopo.sim.report import SCHEMA, SCHEMA_PRIORITY
+from tputopo.sim.report import SCHEMA_WATERMARK
 from tputopo.sim.trace import JobSpec, Trace, TraceConfig, generate_trace
 
 CLOCK = lambda: 1000.0  # noqa: E731 — staged occupancy stamps this time
@@ -526,14 +526,14 @@ def test_run_trace_priority_schema_and_determinism():
     byte-identical to sequential ones."""
     std = run_trace(TraceConfig(seed=0, nodes=8, spec="v5p:2x2x4",
                                 arrivals=20, node_failures=0), ["ici"])
-    assert std["schema"] == SCHEMA
+    assert std["schema"] == SCHEMA_WATERMARK
     assert "tiers" not in std["policies"]["ici"]
     assert "preempt" not in std["policies"]["ici"]
 
     cfg = TraceConfig(seed=0, nodes=8, spec="v5p:2x2x4", arrivals=40,
                       node_failures=0, workload="mixed")
     off = run_trace(cfg, ["ici"])
-    assert off["schema"] == SCHEMA_PRIORITY
+    assert off["schema"] == SCHEMA_WATERMARK
     assert "tiers" in off["policies"]["ici"]
     assert "preempt" not in off["policies"]["ici"]
     assert "serving" in off["policies"]["ici"]["tiers"]
@@ -541,7 +541,7 @@ def test_run_trace_priority_schema_and_determinism():
 
     on_seq = run_trace(cfg, ["ici", "naive"], preempt={})
     on_par = run_trace(cfg, ["ici", "naive"], preempt={}, jobs=2)
-    assert on_seq["schema"] == SCHEMA_PRIORITY
+    assert on_seq["schema"] == SCHEMA_WATERMARK
     assert on_seq["engine"]["preempt"]["max_moves"] == 1
     assert "preempt" in on_seq["policies"]["ici"]
 

@@ -18,7 +18,7 @@ from tputopo.k8s import make_pod
 from tputopo.k8s import objects as ko
 from tputopo.obs import Tracer
 from tputopo.sim.engine import run_trace, stage_nodes
-from tputopo.sim.report import SCHEMA, SCHEMA_REPLICAS
+from tputopo.sim.report import SCHEMA_REPLICAS, SCHEMA_WATERMARK
 from tputopo.sim.trace import TraceConfig
 
 GANG = "tpu.dev/gang-id"
@@ -414,7 +414,7 @@ def test_replicas_one_and_absent_are_byte_identical():
     off = run_trace(cfg, ["ici"])
     one = run_trace(cfg, ["ici"], replicas={"count": 1})
     assert _canon(off) == _canon(one)
-    assert off["schema"] == SCHEMA
+    assert off["schema"] == SCHEMA_WATERMARK
     assert "replicas" not in off["policies"]["ici"]
     assert "replicas" not in off["engine"]
 

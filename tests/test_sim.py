@@ -11,7 +11,7 @@ import pytest
 
 from tputopo.sim.engine import SimEngine, run_trace
 from tputopo.sim.policies import available_policies
-from tputopo.sim.report import SCHEMA
+from tputopo.sim.report import SCHEMA_WATERMARK
 from tputopo.sim.trace import TraceConfig, generate_trace
 
 # Small two-domain fleet: v5p:2x2x4 = 16 chips over 4 hosts per domain.
@@ -101,7 +101,7 @@ def test_ab_policies_show_nonzero_delta():
 def test_report_schema_has_required_metrics():
     cfg = TraceConfig(seed=0, nodes=4, spec="v5p:2x2x4", arrivals=15)
     report = run_trace(cfg, ["ici", "naive"])
-    assert report["schema"] == SCHEMA
+    assert report["schema"] == SCHEMA_WATERMARK
     for p in report["policies"].values():
         assert {"p50", "p95", "mean", "max"} <= set(p["queue_wait_s"])
         assert "time_weighted_mean" in p["chip_utilization"]
@@ -189,7 +189,7 @@ def test_cli_emits_deterministic_json(tmp_path):
     proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stderr[-2000:]
     report = json.loads(proc.stdout)
-    assert report["schema"] == SCHEMA
+    assert report["schema"] == SCHEMA_WATERMARK
     assert list(report["policies"]) == ["ici", "naive"]
     assert json.loads(out.read_text()) == report
     assert "wall" in proc.stderr  # telemetry stays off stdout
@@ -282,7 +282,7 @@ def test_fleet_trace_parallel_matches_sequential():
     seq = run_trace(cfg, ["ici", "naive"], jobs=1, flight_trace=False)
     par = run_trace(cfg, ["ici", "naive"], jobs=2, flight_trace=False)
     assert _canon(seq) == _canon(par)
-    assert seq["schema"] == SCHEMA
+    assert seq["schema"] == SCHEMA_WATERMARK
 
 
 @pytest.mark.slow

@@ -1,0 +1,210 @@
+"""Cross-wake feasibility watermarks + vectorized gang composition (PR 17).
+
+Two saturation-wake optimizations, each behind a registered kill switch:
+
+- ``SimEngine.FEASIBILITY_WATERMARK`` — when a pending shape fails
+  placement, the engine records the minimum freed-chip condition under
+  which it could possibly succeed and skips the shape on subsequent
+  wakes (with exact failure bookkeeping) until cumulative releases
+  cross that threshold.  The skip must be an OUTCOME no-op: job
+  outcomes, queue waits, and utilization are byte-identical on/off —
+  only saved-work telemetry (sort counts, phase walls, policy
+  plan/infeasible tallies) may move.
+- ``ExtenderScheduler.VECTOR_GANG_PLAN`` — a numpy mask screen batched
+  across all candidate domains before per-candidate host-grid probing.
+  A *necessary-condition* screen: it may only drop domains the probe
+  would reject, so reports are byte-identical on/off, full stop.
+
+Both stand down (watermark) or stay invisible in report bytes (vector)
+under --chaos and --replicas; the schema bumps to v8 exactly when the
+watermark block can appear.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tputopo.extender.scheduler import ExtenderScheduler
+from tputopo.sim.engine import SimEngine, run_trace
+from tputopo.sim.report import (SCHEMA, SCHEMA_CHAOS, SCHEMA_REPLICAS,
+                                SCHEMA_WATERMARK)
+from tputopo.sim.trace import TraceConfig
+
+#: Contended enough that shapes fail and later succeed (the crossing
+#: path), small enough for the fast tier.
+SMALL = dict(nodes=16, arrivals=60)
+
+
+def _canon(report: dict) -> str:
+    r = dict(report)
+    r.pop("throughput", None)
+    r.pop("phase_wall", None)
+    return json.dumps(r, sort_keys=True)
+
+
+def _outcomes(report: dict) -> str:
+    """The OUTCOME projection of a report: everything a job or operator
+    observes — schedule results, waits, utilization, placement quality —
+    with the saved-work telemetry (scheduler counters, per-phase walls,
+    baseline plan/infeasible tallies, watermark block) stripped.  The
+    watermark differential tests compare THIS, because skipping a
+    hopeless sort legitimately changes how much work was done, never
+    what was decided."""
+    out = {"virtual_horizon_s": report["virtual_horizon_s"],
+           "engine": report["engine"], "policies": {}}
+    for name, p in report["policies"].items():
+        out["policies"][name] = {
+            k: p[k] for k in ("jobs", "queue_wait_s", "chip_utilization",
+                              "fragmentation", "ici_bw_score")
+            if k in p
+        }
+        for extra in ("tiers", "preempt", "defrag", "replicas", "chaos"):
+            if extra in p:
+                out["policies"][name][extra] = p[extra]
+    return json.dumps(out, sort_keys=True)
+
+
+# ---- schema + block shape ---------------------------------------------------
+
+
+def test_watermark_block_schema_and_counter_shape():
+    """Armed runs report v8 with the four-counter watermark block; the
+    block is per-ici-policy, deterministic, and internally consistent."""
+    cfg = TraceConfig(seed=0, **SMALL)
+    ra = run_trace(cfg, ["ici", "naive"])
+    rb = run_trace(cfg, ["ici", "naive"])
+    assert _canon(ra) == _canon(rb)
+    assert ra["schema"] == SCHEMA_WATERMARK
+    for p in ra["policies"].values():
+        wm = p["watermark"]
+        assert set(wm) == {"recorded", "skips", "crossed", "invalidated"}
+        assert all(v >= 0 for v in wm.values())
+    # Contended trace: the optimization actually fires (a dead watermark
+    # would silently revert every wake to full sorts).
+    assert ra["policies"]["ici"]["watermark"]["recorded"] > 0
+    assert ra["policies"]["ici"]["watermark"]["skips"] > 0
+
+
+def test_watermark_stands_down_under_chaos_and_replicas():
+    """Fault injection and replica sharding disarm the watermark: failed
+    attempts draw the fault stream (a skip would shift every later
+    injection) and per-shard twin views go stale — so those runs keep
+    their own schemas and carry no watermark key anywhere."""
+    chaos = run_trace(TraceConfig(seed=0, **SMALL), ["ici"],
+                      chaos="api-flake")
+    assert chaos["schema"] == SCHEMA_CHAOS
+    assert "watermark" not in chaos["policies"]["ici"]
+    rep = run_trace(TraceConfig(seed=0, **SMALL), ["ici"],
+                    replicas={"count": 2})
+    assert rep["schema"] == SCHEMA_REPLICAS
+    assert "watermark" not in rep["policies"]["ici"]
+
+
+def test_watermark_kill_switch_restores_prior_bytes(monkeypatch):
+    """The registered kill switch: FEASIBILITY_WATERMARK False must
+    replay the EXACT pre-PR bytes — v2 schema, no watermark key, and
+    identical scheduler/phase telemetry (the off-path does the sorts)."""
+    cfg = TraceConfig(seed=0, **SMALL)
+    monkeypatch.setattr(SimEngine, "FEASIBILITY_WATERMARK", False)
+    off = run_trace(cfg, ["ici", "naive"])
+    assert off["schema"] == SCHEMA
+    assert "watermark" not in off["policies"]["ici"]
+    assert "watermark" not in off["policies"]["naive"]
+
+
+# ---- the differential: outcomes never move ----------------------------------
+
+
+def test_watermark_differential_plain_trace(monkeypatch):
+    """Watermark on vs off on the contended v2 trace: identical job
+    outcomes, waits, utilization, and placement quality — the skip only
+    elides work whose failure was already proven."""
+    cfg = TraceConfig(seed=0, **SMALL)
+    on = run_trace(cfg, ["ici", "naive"])
+    monkeypatch.setattr(SimEngine, "FEASIBILITY_WATERMARK", False)
+    off = run_trace(cfg, ["ici", "naive"])
+    assert _outcomes(on) == _outcomes(off)
+    # And the engine genuinely saved sorts on the on-leg.
+    on_sorts = on["policies"]["ici"]["scheduler"].get("sort_requests", 0)
+    off_sorts = off["policies"]["ici"]["scheduler"].get("sort_requests", 0)
+    assert on_sorts < off_sorts
+
+
+def test_watermark_differential_mixed_preempt(monkeypatch):
+    """Same differential on the mixed serving+training trace with
+    targeted preemption on: tier outcomes, SLO attainment, and the
+    preempt block all survive the skip path (preempt-eligible jobs are
+    never watermark-skipped; executed preemptions invalidate)."""
+    cfg = TraceConfig(seed=0, workload="mixed", **SMALL)
+    on = run_trace(cfg, ["ici"], preempt={})
+    monkeypatch.setattr(SimEngine, "FEASIBILITY_WATERMARK", False)
+    off = run_trace(cfg, ["ici"], preempt={})
+    assert _outcomes(on) == _outcomes(off)
+
+
+def test_watermark_differential_chaos_and_replicas(monkeypatch):
+    """Under --chaos and --replicas the watermark stands down, so on/off
+    must be byte-identical WHOLESALE (not just outcome-identical) —
+    including a --jobs 2 replica replay."""
+    chaos_cfg = TraceConfig(seed=0, **SMALL)
+    rep_cfg = TraceConfig(seed=0, **SMALL)
+    on_chaos = run_trace(chaos_cfg, ["ici"], chaos="api-flake")
+    on_rep = run_trace(rep_cfg, ["ici"], replicas={"count": 2})
+    on_rep_j2 = run_trace(rep_cfg, ["ici"], replicas={"count": 2}, jobs=2)
+    monkeypatch.setattr(SimEngine, "FEASIBILITY_WATERMARK", False)
+    off_chaos = run_trace(chaos_cfg, ["ici"], chaos="api-flake")
+    off_rep = run_trace(rep_cfg, ["ici"], replicas={"count": 2})
+    assert _canon(on_chaos) == _canon(off_chaos)
+    assert _canon(on_rep) == _canon(off_rep) == _canon(on_rep_j2)
+
+
+# ---- crossing + invalidation ------------------------------------------------
+
+
+def test_watermark_crossings_and_invalidation_fire():
+    """The lifecycle counters move on real traces: crossings on any
+    contended trace (releases un-skip shapes, which then place), and
+    invalidation whenever a capacity-epoch event (preemption here)
+    rewrites feasibility out from under the recorded thresholds."""
+    contended = run_trace(TraceConfig(seed=0, **SMALL), ["ici"])
+    wm = contended["policies"]["ici"]["watermark"]
+    assert wm["crossed"] > 0
+    mixed = run_trace(TraceConfig(seed=0, workload="mixed", **SMALL),
+                      ["ici"], preempt={})
+    mp = mixed["policies"]["ici"]
+    if mp["preempt"]["plans_executed"] > 0:
+        assert mp["watermark"]["invalidated"] > 0  # cleared-on-preempt path
+    # The stats are self-consistent: every skip was against a recorded,
+    # not-yet-crossed threshold.
+    for rec in (wm, mp["watermark"]):
+        assert rec["crossed"] <= rec["recorded"]
+
+
+# ---- vectorized gang composition --------------------------------------------
+
+
+def test_vector_gang_plan_byte_identical_on_off(monkeypatch):
+    """VECTOR_GANG_PLAN is a pure work-elision screen: the report —
+    schema, outcomes, AND scheduler telemetry (gang_domains_screened is
+    deliberately outside the sim keep-list) — is byte-identical with the
+    switch on and off, on both the contended standard trace and a
+    multi-domain fleet slice."""
+    small = TraceConfig(seed=0, **SMALL)
+    fleet = TraceConfig(seed=0, nodes=64, arrivals=200, offered_load=0.73)
+    on_small = run_trace(small, ["ici", "naive"])
+    on_fleet = run_trace(fleet, ["ici", "naive"], flight_trace=False)
+    monkeypatch.setattr(ExtenderScheduler, "VECTOR_GANG_PLAN", False)
+    off_small = run_trace(small, ["ici", "naive"])
+    off_fleet = run_trace(fleet, ["ici", "naive"], flight_trace=False)
+    assert _canon(on_small) == _canon(off_small)
+    assert _canon(on_fleet) == _canon(off_fleet)
+
+
+def test_vector_screen_composes_with_batch_and_preempt(monkeypatch):
+    """The screen sits under every composition path — joint batch
+    admission and mixed+preempt replays stay byte-identical on/off."""
+    mixed = TraceConfig(seed=0, workload="mixed", **SMALL)
+    on_batch = run_trace(mixed, ["ici"], batch={}, preempt={})
+    monkeypatch.setattr(ExtenderScheduler, "VECTOR_GANG_PLAN", False)
+    off_batch = run_trace(mixed, ["ici"], batch={}, preempt={})
+    assert _canon(on_batch) == _canon(off_batch)

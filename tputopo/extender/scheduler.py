@@ -47,7 +47,8 @@ from tputopo.extender.state import (ClusterState, PodAssignment, SliceDomain,
 from tputopo.topology.model import ChipTopology, Coord
 from tputopo.topology.score import (_box_of, predict_allreduce_gbps,
                                     predict_multidomain_allreduce_gbps)
-from tputopo.topology.slices import Allocator, Placement, enumerate_shapes
+from tputopo.topology.slices import (Allocator, Placement, enumerate_shapes,
+                                     mask_bits_array)
 
 # Gang metadata lives in labels (selectable) with annotation fallback.
 LABEL_GANG_ID = "tpu.dev/gang-id"
@@ -340,6 +341,13 @@ class ExtenderScheduler:
         # lockset rule flagged — _cache_lock serializes it (bind already
         # nests _bind_lock > _cache_lock, so the order holds).
         self._gang_plan_cache: dict[tuple[str, str], dict] = {}  # guarded-by: _cache_lock
+        # Vectorized gang screen (VECTOR_GANG_PLAN): per-domain bit->node
+        # row layouts, keyed on the domain's node-mask table IDENTITY
+        # (those dicts are immutable and shared across copy-on-write
+        # states, so one layout serves every folded/delta state until a
+        # full rebuild replaces the table).  The keyed object is held in
+        # the value so a recycled id() can never alias a dead entry.
+        self._vector_rows_cache: dict[int, tuple] = {}  # guarded-by: _cache_lock
 
     _GANG_PLAN_CACHE_MAX = 512
 
@@ -352,6 +360,22 @@ class ExtenderScheduler:
     #: (``score_memo_hits``) and explain ``memo_hit`` flags are identical
     #: under both shapes; only wall time moves.
     SCORE_INDEX = True
+
+    #: Kill switch for the vectorized gang-composition screen (the
+    #: saturation-wake pass): per-node free-chip counts for EVERY domain
+    #: come from ONE numpy unpackbits+bincount batch over the
+    #: concatenated free masks (memoized per state instance), and gang
+    #: planning consults them as a sound NECESSARY condition — a domain
+    #: whose >=k-free host count (or free volume) cannot cover the
+    #: remaining replicas is skipped without building its per-host
+    #: candidate map, and the multislice search's per-domain
+    #: ``max_feasible`` probe starts at the screened bound instead of
+    #: the host count.  Screening can only over-admit (delisted nodes'
+    #: chips are counted), never reject a feasible domain, so plans,
+    #: scores, binds, and every report byte are identical under both
+    #: settings — only wall time moves.  False restores the historical
+    #: probe-every-domain loop byte-for-byte.
+    VECTOR_GANG_PLAN = True
 
     @property
     def _single_owner(self) -> bool:
@@ -512,6 +536,21 @@ class ExtenderScheduler:
                    if dom.allocator.used_mask != pre_masks.get(sid)}
         if not changed:
             return
+        # The vectorized gang screen's count batch is a pure function of
+        # fleet occupancy, but a fold only moves the CHANGED domains'
+        # rows — so the fold merely QUEUES those domain ids; the next
+        # gang plan that actually reads the batch patches exactly the
+        # stale windows (see _vector_counts).  Both eager alternatives
+        # lost: dropping the cache wholesale made the batch planner
+        # rebuild the full-fleet batch once per probe, and patching
+        # here, per fold, paid the numpy round-trip for fold bursts no
+        # plan ever read.
+        vc = getattr(state, "_vector_counts_cache", None)
+        if vc is not None:
+            stale = getattr(state, "_vector_stale", None)
+            if stale is None:
+                stale = state._vector_stale = set()
+            stale.update(changed)
         sidx = getattr(state, "_score_index", None)
         if sidx:
             # The batch planner's fill bookkeeping (batch_scores) rides
@@ -1181,6 +1220,156 @@ class ExtenderScheduler:
         except TypeError:  # reader without a copy kwarg (fake/REST client)
             return src.list("pods", is_member)
 
+    # ---- vectorized gang screen (VECTOR_GANG_PLAN) -------------------------
+
+    def _vector_rows(self, dom: SliceDomain) -> tuple:
+        """(bit->row int32 array, row_by_node, nrows) for one domain —
+        which node each chip-bit belongs to, as numpy rows.  Cached on
+        the domain's node-mask table identity: those dicts are built at
+        sync and shared across copy-on-write states, so the layout
+        survives every fold/delta until a full rebuild replaces them.
+        Bits of no listed node (delisted hosts) go to a trash row that
+        still participates in per-domain sums — every distortion is
+        toward OVER-admitting a domain, never rejecting one."""
+        key = id(dom.node_masks)
+        with self._cache_lock:
+            got = self._vector_rows_cache.get(key)
+        if got is not None and got[0] is dom.node_masks:
+            return got[1]
+        import numpy as np
+
+        nchips = len(dom.topology.chips)
+        names = sorted(dom.node_masks)
+        trash = len(names)
+        rows = np.full(((nchips + 7) // 8) * 8, trash, dtype=np.int32)
+        row_by_node = {}
+        for r, n in enumerate(names):
+            rows[mask_bits_array(dom.node_masks[n], nchips)
+                 .astype(bool)] = r
+            row_by_node[n] = r
+        layout = (rows, row_by_node, trash + 1)
+        with self._cache_lock:
+            self._vector_rows_cache[key] = (dom.node_masks, layout)
+            while len(self._vector_rows_cache) > self._GANG_PLAN_CACHE_MAX:
+                self._vector_rows_cache.pop(
+                    next(iter(self._vector_rows_cache)))
+        return layout
+
+    def _vector_patch(self, state: ClusterState, got: tuple,
+                      stale: set) -> tuple | None:
+        """Refresh the stale domains' windows of the count batch in
+        place — one small unpackbits+bincount per moved domain — and
+        fix the per-k capacity memo for exactly those domains.  In-
+        place folds only queue domain ids (_evict_state_memos); the
+        cost lands here, per READ, so a burst of folds between gang
+        plans collapses into one patch.  Returns None on any layout
+        mismatch (a domain the batch never saw, a replaced node-mask
+        table, node churn) — the caller rebuilds wholesale."""
+        import numpy as np
+
+        counts, info = got
+        for sid in stale:
+            win = info.get(sid)
+            dom = state.domains.get(sid)
+            if win is None or dom is None:
+                return None
+            r0, nr, _ = win
+            rows, _, nrows = self._vector_rows(dom)
+            if nrows != nr:
+                return None
+            bits = np.unpackbits(
+                np.frombuffer(dom.allocator.free_mask_bytes(),
+                              dtype=np.uint8), bitorder="little")
+            counts[r0:r0 + nr] = np.bincount(rows, weights=bits,
+                                             minlength=nr)
+        memo = getattr(state, "_vector_capk", None)
+        if memo is not None:
+            for k, caps in memo.items():
+                for sid in stale:
+                    if sid in caps:
+                        r0, nr, _ = info[sid]
+                        caps[sid] = int((counts[r0:r0 + nr] >= k).sum())
+        stale.clear()
+        return got
+
+    def _vector_counts(self, state: ClusterState) -> tuple:
+        """(counts, info) — per-node free-chip counts for EVERY domain
+        in one flat array, from a single unpackbits+bincount batch over
+        the concatenated free masks; ``info`` maps slice_id to its
+        (row offset, row count, row_by_node) window.  Memoized on the
+        state instance: one batch serves every gang planned against
+        that occupancy, which under a saturated queue is many; in-place
+        folds queue their changed domains and this read patches those
+        windows before answering."""
+        got = getattr(state, "_vector_counts_cache", None)
+        if got is not None:
+            stale = getattr(state, "_vector_stale", None)
+            if not stale:
+                return got
+            patched = self._vector_patch(state, got, stale)
+            if patched is not None:
+                return patched
+            # Layout moved under the cache: drop everything derived
+            # from it and fall through to the wholesale rebuild.
+            stale.clear()
+            for attr in ("_vector_counts_cache", "_vector_capk"):
+                if getattr(state, attr, None) is not None:
+                    delattr(state, attr)
+        import numpy as np
+
+        doms = sorted(state.domains.values(), key=lambda d: d.slice_id)
+        payload = bytearray()
+        chunks = []
+        info: dict[str, tuple] = {}
+        row0 = 0
+        for d in doms:
+            rows, row_by_node, nrows = self._vector_rows(d)
+            payload += d.allocator.free_mask_bytes()
+            chunks.append(rows + np.int32(row0))
+            info[d.slice_id] = (row0, nrows, row_by_node)
+            row0 += nrows
+        if not doms:
+            got = (np.zeros(0, dtype=np.int64), info)
+        else:
+            bits = np.unpackbits(
+                np.frombuffer(bytes(payload), dtype=np.uint8),
+                bitorder="little")
+            counts = np.bincount(np.concatenate(chunks), weights=bits,
+                                 minlength=row0).astype(np.int64)
+            got = (counts, info)
+        state._vector_counts_cache = got
+        return got
+
+    def _vector_cap(self, state: ClusterState, dom: SliceDomain, k: int,
+                    exclude_nodes: set[str]) -> int | None:
+        """Upper bound on the gang members ``dom`` can host at ``k``
+        chips each: nodes with >= k free chips, minus already-consumed
+        (excluded) hosts, from the vectorized count batch.  Per-(state,
+        k) capacities are memoized; None when the domain is unknown to
+        the batch (callers fall back to probing)."""
+        # Read the batch FIRST, unconditionally: it patches any windows
+        # (and per-k caps) staled by in-place folds since the last read
+        # — a memo hit must never answer from a pre-fold capacity.
+        counts, info = self._vector_counts(state)
+        memo = getattr(state, "_vector_capk", None)
+        if memo is None:
+            memo = state._vector_capk = {}
+        caps = memo.get(k)
+        if caps is None:
+            ge = counts >= k
+            caps = memo[k] = {sid: int(ge[r0:r0 + nr].sum())
+                              for sid, (r0, nr, _) in info.items()}
+        cap = caps.get(dom.slice_id)
+        if cap is None:
+            return None
+        if exclude_nodes:
+            r0, _, row_by_node = info[dom.slice_id]
+            for n in exclude_nodes:
+                r = row_by_node.get(n)
+                if r is not None and counts[r0 + r] >= k:
+                    cap -= 1
+        return cap
+
     def _plan_gang(self, state: ClusterState, dom: SliceDomain,
                    replicas: int, k: int,
                    exclude_nodes: set[str]) -> dict[str, Placement] | None:
@@ -1424,6 +1613,26 @@ class ExtenderScheduler:
             phase1 = all_doms
         else:
             phase1 = []  # already split (multislice in progress)
+        if self.VECTOR_GANG_PLAN and phase1:
+            # Vectorized necessary-condition screen: drop domains whose
+            # >=k-free host count or free volume cannot cover the
+            # remaining replicas BEFORE paying their per-host candidate
+            # maps.  The screen only over-admits (sound), so the first
+            # surviving domain that plans is the same winner the
+            # probe-every-domain loop finds — byte-identical plans.
+            vol = remaining * k
+            kept = []
+            for dom in phase1:
+                cap = self._vector_cap(state, dom, k, exclude)
+                if cap is not None and (
+                        cap < remaining
+                        or dom.allocator.free_count < vol):
+                    continue
+                kept.append(dom)
+            if len(kept) < len(phase1):
+                self.metrics.inc("gang_domains_screened",
+                                 len(phase1) - len(kept))
+            phase1 = kept
         for dom in phase1:
             plan = self._plan_gang(state, dom, remaining, k, exclude)
             if plan is not None:
@@ -1467,7 +1676,16 @@ class ExtenderScheduler:
                 return plan_cache[key]
 
             def max_feasible(dom) -> int:
-                for m in range(min(remaining, len(dom.node_by_host)), 0, -1):
+                hi = min(remaining, len(dom.node_by_host))
+                if self.VECTOR_GANG_PLAN:
+                    # Screened upper bound: no domain can seat more
+                    # members than its >=k-free host count or its free
+                    # volume allows, so the probe starts there instead
+                    # of at the host count — same answer, fewer probes.
+                    cap = self._vector_cap(state, dom, k, exclude)
+                    if cap is not None:
+                        hi = min(hi, cap, dom.allocator.free_count // k)
+                for m in range(hi, 0, -1):
                     if plan_for(dom, m) is not None:
                         return m
                 return 0

@@ -54,6 +54,9 @@ SWITCH_REGISTRY: tuple[tuple[str, str, str], ...] = (
     ("tputopo/extender/gc.py", "AssumptionGC", "WATERMARK"),
     ("tputopo/sim/engine.py", "SimEngine", "NOCOPY_WRITES"),
     ("tputopo/sim/engine.py", "SimEngine", "BATCH_ADMISSION"),
+    ("tputopo/sim/engine.py", "SimEngine", "FEASIBILITY_WATERMARK"),
+    ("tputopo/extender/scheduler.py", "ExtenderScheduler",
+     "VECTOR_GANG_PLAN"),
     ("tputopo/sim/policies.py", "BaselinePolicy", "delta_fold"),
     ("tputopo/k8s/fakeapi.py", "FakeApiServer", "nocopy_writes"),
 )

@@ -72,6 +72,7 @@ COUNTERS = (
     "gang_assumptions_released",
     "gang_candidate_memo_hits",
     "gang_ctx_memo_hits",
+    "gang_domains_screened",
     "gang_multislice_compositions_considered",
     "gang_multislice_plans",
     "gang_plan_reuse_hits",

@@ -233,6 +233,21 @@ class SimEngine:
     #: byte-for-byte, schema included.
     BATCH_ADMISSION = True
 
+    #: Kill switch for cross-wake feasibility watermarks: when a pending
+    #: ``(replicas, k)`` shape takes a capacity verdict, the engine
+    #: records the minimum number of freed chips under which the shape
+    #: could POSSIBLY place (per-domain for the distinct-host extender
+    #: planner, fleet-wide for the stack-capable baselines and for
+    #: multislice gangs) and later wakes skip the shape — with the exact
+    #: failure bookkeeping a failed attempt would have produced, but
+    #: zero sort/score work — until cumulative twin releases cross the
+    #: watermark.  Armed only where the skip is provably outcome-neutral:
+    #: stands down under ``--replicas`` (shards wake on stale per-replica
+    #: views) and ``--chaos`` (a skipped attempt would shift the fault
+    #: plan's draw stream, and can even skip a crash-restart).  False
+    #: runs every wake byte-for-byte as before, schema included.
+    FEASIBILITY_WATERMARK = True
+
     def __init__(self, trace: Trace, policy_name: str, *,
                  assume_ttl_s: float = 60.0, gc_period_s: float = 30.0,
                  max_backfill_failures: int = 8,
@@ -424,6 +439,78 @@ class SimEngine:
         self._batch_cache: dict = {}
         self._batch_dom_nodes: tuple | None = None
 
+        # Cross-wake feasibility watermarks, behind the registered
+        # FEASIBILITY_WATERMARK kill switch.  Armed only where skipping
+        # a doomed attempt is provably outcome-neutral: single-scheduler
+        # (replica shards wake on stale per-replica views) and
+        # fault-free (every place() attempt draws from the fault plan's
+        # stream, so eliding one would shift all later injections).  The
+        # stats dict doubles as the armed flag and the report block —
+        # absent means off/stood-down, which pins every prior schema's
+        # bytes.
+        self.watermark_stats = (
+            {"recorded": 0, "skips": 0, "crossed": 0, "invalidated": 0}
+            if (self.FEASIBILITY_WATERMARK and self.replica_knobs is None
+                and self.fault_plan is None) else None)
+        # shape (replicas, chips, multislice) -> release-counter value at
+        # which the shape could next possibly place (see _wm_record).
+        self._wm: dict[tuple[int, int, bool], int] = {}
+        self._wm_released = 0  # cumulative chips released into the twin
+        # Distinct-host planners (the extender: one host per gang member,
+        # one domain unless multislice) are bounded by the per-domain
+        # hosts-with->=k-free count; the count-only baselines can stack
+        # members on one node and straddle domains, so their necessary
+        # condition is the fleet-wide floor(free/k) sum instead.
+        self._wm_distinct = bool(getattr(self.policy,
+                                         "wm_distinct_hosts", False))
+        # Per-domain per-node free-chip counts and their histogram
+        # (hist[c] = nodes with exactly c free chips), maintained
+        # INCREMENTALLY by the twin mark/release helpers: O(changed
+        # chips) per event, O(chips-per-node) per capacity query.  The
+        # lazy dirty-domain rescan this replaced was itself a
+        # saturation bottleneck — every release dirtied a domain and
+        # every record rescanned every dirty domain's node list, which
+        # at 4096 nodes cost more than the sorts the watermark saved.
+        self._wm_node_free: dict[str, dict[str, int]] = {}
+        self._wm_hist: dict[str, list[int]] = {}
+        self._wm_chip_node: dict[str, dict] = {}
+        # Fleet-wide aggregates for the multislice/stack-capable branch
+        # of _wm_record: the histogram SUM of the per-domain ones and
+        # the twin free-chip total, maintained by the same incremental
+        # fold — the fleet-wide bound is O(chips-per-node) too, never a
+        # loop over domains (the naive-baseline leg of the fleet trace
+        # paid ~60% wall for that loop before).  _wm_gen counts capacity
+        # mutations; _wm_nofind memoizes "this shape's failure is not a
+        # capacity miss at this generation" so the pre-gate path does
+        # not recompute an unrecordable bound once per wake per gang
+        # (need is a pure function of shape + generation).
+        self._wm_hist_t: list[int] = []
+        self._wm_free_t = 0
+        self._wm_gen = 0
+        self._wm_nofind: dict[tuple[int, int, bool], int] = {}
+        if self.watermark_stats is not None:
+            dom_nodes: dict[str, list[str]] = {}
+            for n in self.node_names:
+                dom_nodes.setdefault(self.domain_of_node[n], []).append(n)
+            for sid in self.twin:
+                nodes = dom_nodes.get(sid, [])
+                nf = {n: len(self.chips_by_node[n]) for n in nodes}
+                hist = [0] * (max(nf.values(), default=0) + 1)
+                for f in nf.values():
+                    hist[f] += 1
+                self._wm_node_free[sid] = nf
+                self._wm_hist[sid] = hist
+                self._wm_chip_node[sid] = {
+                    c: n for n in nodes for c in self.chips_by_node[n]}
+            width = max((len(h) for h in self._wm_hist.values()),
+                        default=1)
+            self._wm_hist_t = [0] * width
+            for h in self._wm_hist.values():
+                for c, n_at in enumerate(h):
+                    self._wm_hist_t[c] += n_at
+            self._wm_free_t = sum(tw.free_count
+                                  for tw in self.twin.values())
+
         # Defragmentation loop (tputopo.defrag), opt-in: a periodic
         # controller cycle on virtual time, evicting through the same
         # requeue path node failures use.  Deterministic: the controller
@@ -547,6 +634,11 @@ class SimEngine:
             batch=(dict(self.batch_stats,
                         gangs_per_batch=list(self._batch_gang_sizes))
                    if self.batch_stats is not None else None),
+            # Feasibility-watermark counters (None when the switch is
+            # off or the run stood down under chaos/replicas — its
+            # absence pins the v2–v7 report bytes).
+            watermark=(dict(self.watermark_stats)
+                       if self.watermark_stats is not None else None),
         )
 
     def run_events(self) -> None:
@@ -736,6 +828,7 @@ class SimEngine:
         self._twin_release(self.domain_of_node[name],
                            self._blocked.pop(name, []))
         self.capacity_epoch += 1
+        self._wm_invalidate()
         self._try_schedule()
 
     def _on_gc(self) -> None:
@@ -798,6 +891,7 @@ class SimEngine:
             # The restored box (and the requeued victims) may place
             # queued work right now, not at the next event.
             self.capacity_epoch += 1
+            self._wm_invalidate()
             self._try_schedule()
 
     def _defrag_evict(self, victim) -> None:
@@ -883,6 +977,15 @@ class SimEngine:
             if (failures >= self.max_backfill_failures
                     or run.failed_epoch == self.capacity_epoch):
                 continue
+            if self.watermark_stats is not None and self._wm_hit(run.spec):
+                # Under an uncrossed watermark this attempt cannot
+                # succeed; take the exact bookkeeping a failed place()
+                # would (epoch memo, failure budget, rotation advance)
+                # minus the sort itself, so watermark-on and -off wakes
+                # diverge in nothing but wall clock.
+                self._note_place_failure(run, "infeasible")
+                failures += 1
+                continue
             decisions = self.policy.place(run.spec, alive,
                                           handles=run.handles)
             if decisions is None:
@@ -914,9 +1017,149 @@ class SimEngine:
                 self.place_retry_reasons.get(reason, 0) + 1
         else:
             run.failed_epoch = self.capacity_epoch
+            if self.watermark_stats is not None:
+                self._wm_record(run.spec)
         if run.spec.replicas > 1 or faulted:
             self._reset_if_partially_bound(run)
         return faulted
+
+    # ---- cross-wake feasibility watermarks ---------------------------------
+
+    def _wm_capk(self, sid: str, k: int) -> int:
+        """One domain's member capacity at ``k`` chips per member, read
+        straight off the incrementally maintained free-count histogram
+        (O(chips per node), no node rescan): hosts with >= k free chips
+        for distinct-host planners, the floor(free/k) sum for the
+        stack-capable baselines."""
+        hist = self._wm_hist[sid]
+        if self._wm_distinct:
+            return sum(hist[k:])
+        return sum(hist[c] * (c // k) for c in range(k, len(hist)))
+
+    def _wm_capk_t(self, k: int) -> int:
+        """The fleet-wide member capacity at ``k`` — :meth:`_wm_capk`
+        summed over every domain, read off the aggregate histogram in
+        one pass (the two are equal term-by-term, so thresholds are
+        bit-identical to the per-domain spelling)."""
+        hist = self._wm_hist_t
+        if self._wm_distinct:
+            return sum(hist[k:])
+        return sum(hist[c] * (c // k) for c in range(k, len(hist)))
+
+    def _wm_count(self, sid: str, chips, delta: int) -> None:
+        """Fold one twin mark (``delta=-1``) or release (``+1``) into
+        the per-node free counts, the per-domain histogram, and the
+        fleet-wide aggregates.  Chips of no mapped node (never the case
+        for trace-built fleets) are ignored by the histograms — the
+        capacity bound only ever OVER-estimates, which keeps the
+        watermark sound; the free total mirrors the twin ledger exactly
+        (every marked/released chip counts)."""
+        nf = self._wm_node_free[sid]
+        hist = self._wm_hist[sid]
+        hist_t = self._wm_hist_t
+        node_of = self._wm_chip_node[sid]
+        n_chips = 0
+        for c in chips:
+            n_chips += 1
+            n = node_of.get(c)
+            if n is None:
+                continue
+            f = nf[n]
+            hist[f] -= 1
+            hist_t[f] -= 1
+            f += delta
+            hist[f] += 1
+            hist_t[f] += 1
+            nf[n] = f
+        self._wm_free_t += delta * n_chips
+        self._wm_gen += 1
+
+    def _wm_skippable(self, spec: JobSpec) -> bool:
+        """Shapes the watermark may skip in the tiered wake: everything
+        except a job whose failed attempt could trigger PREEMPTION — for
+        those the attempt is the eviction trigger, and waiting for
+        organic releases is exactly what preemption exists to avoid.
+        The condition mirrors the preempt branch's eligibility test."""
+        return not (self.preempt_knobs is not None and spec.priority > 0
+                    and not spec.multislice
+                    and spec.replicas * spec.chips > 1)
+
+    def _wm_hit(self, spec: JobSpec) -> bool:
+        """True when ``spec``'s shape sits under an uncrossed watermark:
+        capacity provably has not recovered enough since the shape's
+        last capacity verdict, so the attempt is skipped.  A crossed
+        entry is dropped here (the lazy half of invalidation; the eager
+        half is :meth:`_wm_invalidate` on capacity-restructuring
+        events) and the attempt runs."""
+        key = (spec.replicas, spec.chips, spec.multislice)
+        th = self._wm.get(key)
+        if th is None:
+            return False
+        if self._wm_released >= th:
+            del self._wm[key]
+            self.watermark_stats["crossed"] += 1
+            return False
+        self.watermark_stats["skips"] += 1
+        return True
+
+    def _wm_record(self, spec: JobSpec) -> None:
+        """Record the watermark for a shape that just took a capacity
+        verdict: the minimum cumulative-release count under which it
+        could next possibly place.  The bound reuses the batch
+        planner's pre-gate shape, computed against the twin: a domain
+        can hold the gang only if ``free >= replicas*k`` AND its member
+        capacity covers ``replicas``; each released chip raises a
+        domain's free count by one and flips at most one host across
+        the >=k line (adds at most one floor(free/k) slot), so the
+        deficit in chips bounds the releases required.  Multislice
+        gangs and the stack-capable baselines take the fleet-wide
+        spelling of the same bound.  A non-positive deficit means the
+        failure was not a pure capacity miss (fragmentation, scoring,
+        topology) — nothing is recorded, so a watermark never claims
+        more than the math that justifies it."""
+        k, r = spec.chips, spec.replicas
+        key = (r, k, spec.multislice)
+        th = self._wm.get(key)
+        if k <= 0:
+            return
+        if th is not None:
+            if self._wm_released < th:
+                return  # an uncrossed entry already stands
+            # Crossed but never probed (e.g. the shape pre-gated before
+            # its wake attempt): retire it and re-record below.
+            del self._wm[key]
+            self.watermark_stats["crossed"] += 1
+        if self._wm_nofind.get(key) == self._wm_gen:
+            # Already proven "not a capacity miss" at this exact
+            # capacity generation — the bound below is a pure function
+            # of (shape, generation), so recomputing cannot record.
+            return
+        vol = r * k
+        if spec.multislice or not self._wm_distinct:
+            need = max(vol - self._wm_free_t, r - self._wm_capk_t(k))
+        else:
+            need = None
+            for sid, tw in self.twin.items():
+                d = max(vol - tw.free_count, r - self._wm_capk(sid, k))
+                if need is None or d < need:
+                    need = d
+                    if need <= 0:
+                        break
+        if need is not None and need > 0:
+            self._wm[key] = self._wm_released + need
+            self.watermark_stats["recorded"] += 1
+        else:
+            self._wm_nofind[key] = self._wm_gen
+
+    def _wm_invalidate(self) -> None:
+        """Eager invalidation on capacity-RESTRUCTURING events (executed
+        preemption or defrag, node repair): their releases already
+        advance the crossing counter, but the event also reshapes
+        where capacity sits, so every standing watermark is dropped and
+        the next failures re-record against the new world."""
+        if self.watermark_stats is not None and self._wm:
+            self.watermark_stats["invalidated"] += len(self._wm)
+            self._wm.clear()
 
     # ---- priority tiers (tputopo.priority) ---------------------------------
 
@@ -996,6 +1239,8 @@ class SimEngine:
                 # this wake, so there is no partial bind to reset — the
                 # previous attempt's failure path already did that.
                 run.failed_epoch = self.capacity_epoch
+                if self.watermark_stats is not None:
+                    self._wm_record(spec)
                 if blocked_priority is None \
                         or spec.priority > blocked_priority:
                     blocked_priority = spec.priority
@@ -1008,6 +1253,21 @@ class SimEngine:
                     spec.priority, spec.duration_s, blocked_priority,
                     backfill_limit):
                 self._pcount("backfill_held")
+                continue
+            if (self.watermark_stats is not None
+                    and self._wm_skippable(spec) and self._wm_hit(spec)):
+                # Watermark skip, tiered spelling: identical bookkeeping
+                # to the failure branch below (epoch memo, failure
+                # budget, the blocked-tier gate) minus the sort.  Jobs
+                # the preempt branch could answer are excluded by
+                # _wm_skippable — for those the failed attempt is the
+                # eviction trigger, and organic releases are exactly
+                # what preemption exists not to wait for.
+                self._note_place_failure(run, "infeasible")
+                if blocked_priority is None \
+                        or spec.priority > blocked_priority:
+                    blocked_priority = spec.priority
+                failures += 1
                 continue
             decisions = self.policy.place(spec, alive, handles=run.handles)
             reason = getattr(self.policy, "last_none_reason", None)
@@ -1165,6 +1425,7 @@ class SimEngine:
             self._pcount("jobs_preempted", len(plan.victims))
             self._pcount("chips_freed", plan.chips_moved)
             self.capacity_epoch += 1
+            self._wm_invalidate()
             self._sample_occupancy()
             explain = {
                 "verb": "preempt",
@@ -1336,10 +1597,18 @@ class SimEngine:
     def _twin_mark(self, sid: str, chips) -> None:
         self.twin[sid].mark_used(chips)
         self._frag_dirty.add(sid)
+        if self.watermark_stats is not None:
+            self._wm_count(sid, chips, -1)
 
     def _twin_release(self, sid: str, chips) -> None:
         self.twin[sid].release(chips)
         self._frag_dirty.add(sid)
+        if self.watermark_stats is not None:
+            self._wm_count(sid, chips, +1)
+            # The watermark crossing counter: EVERY chip returned to
+            # the placeable pool (completion, requeue, repair, GC
+            # reclaim) counts, whichever path released it.
+            self._wm_released += len(chips)
 
     def _sample_occupancy(self) -> None:
         # largest_free_box maintains its own incremental index (witness box
@@ -1362,14 +1631,15 @@ class RunState:
     __slots__ = ("policy_name", "horizon_s", "end_t", "metrics",
                  "placed_chips", "frag", "counters", "events_processed",
                  "phases", "phase_wall_ms", "decision_log", "defrag",
-                 "chaos", "tiers", "preempt", "replicas", "batch")
+                 "chaos", "tiers", "preempt", "replicas", "batch",
+                 "watermark")
 
     def __init__(self, *, policy_name, horizon_s, end_t, metrics,
                  placed_chips, frag, counters, events_processed,
                  phases=None, phase_wall_ms=None,
                  decision_log=None, defrag=None, chaos=None,
                  tiers=None, preempt=None, replicas=None,
-                 batch=None) -> None:
+                 batch=None, watermark=None) -> None:
         self.policy_name = policy_name
         self.horizon_s = horizon_s
         self.end_t = end_t
@@ -1387,6 +1657,7 @@ class RunState:
         self.preempt = preempt
         self.replicas = replicas
         self.batch = batch
+        self.watermark = watermark
 
 
 def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
@@ -1433,6 +1704,12 @@ def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
         # tputopo.batch) — present only under --batch-admission; its
         # absence keeps every prior schema's report bytes pinned.
         out["batch"] = batch_block(rs.batch)
+    if rs.watermark is not None:
+        # Cross-wake feasibility-watermark counters (schema
+        # tputopo.sim/v8) — present only when the watermark machinery
+        # was armed (switch on, unreplicated, fault-free); its absence
+        # pins every prior schema's report bytes.
+        out["watermark"] = dict(sorted(rs.watermark.items()))
     return out
 
 
@@ -1632,6 +1909,11 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
                          or any("tiers" in p for p in policies.values())),
         schema_replicas=replica_knobs is not None,
         schema_batch=batch_knobs is not None,
+        # v8 exactly when the engines armed the watermark machinery
+        # (switch on, unreplicated, fault-free) — the same condition
+        # that makes the per-policy `watermark` block appear.
+        schema_watermark=(SimEngine.FEASIBILITY_WATERMARK
+                          and replica_knobs is None and chaos is None),
         throughput={
             "events": events,  # deterministic
             "wall_s": round(wall_s, 3),

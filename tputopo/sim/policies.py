@@ -79,6 +79,14 @@ class PlacementPolicy:
 
     name = "abstract"
 
+    #: Capability bit the engine's feasibility watermarks read: True for
+    #: planners that require one DISTINCT host per gang member inside one
+    #: domain (the extender), so the per-domain hosts-with->=k-free count
+    #: bounds feasibility.  The count-only baselines can stack members on
+    #: one node and straddle domains — for them only the fleet-wide
+    #: floor(free/k) sum is a sound necessary condition.
+    wm_distinct_hosts = False
+
     def __init__(self, api: FakeApiServer, clock, assume_ttl_s: float,
                  tracer=None, fault_plan=None) -> None:
         self.api = api
@@ -153,6 +161,9 @@ class IciAwarePolicy(PlacementPolicy):
     """The framework under test: sort -> max score -> bind, per member."""
 
     name = "ici"
+    # The extender plans one distinct host per member, single domain
+    # unless multislice — the per-domain watermark bound applies.
+    wm_distinct_hosts = True
 
     def __init__(self, api, clock, assume_ttl_s, tracer=None,
                  fault_plan=None) -> None:

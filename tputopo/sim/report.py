@@ -124,6 +124,16 @@ SCHEMA_REPLICAS = "tputopo.sim/v6"
 #: content is deterministic virtual-time fact — part of the
 #: byte-determinism contract.
 SCHEMA_BATCH = "tputopo.sim/v7"
+#: v8 = the above plus the cross-wake feasibility-watermark counters
+#: (SimEngine.FEASIBILITY_WATERMARK): the per-policy ``watermark`` block
+#: (shapes recorded, wake attempts skipped, thresholds crossed, eager
+#: invalidations) — emitted exactly when the engines ARMED the
+#: machinery: switch on, unreplicated, fault-free.  Switch-off runs —
+#: and chaos/replicas runs, where the watermark stands down — keep
+#: emitting the v2..v7 shapes byte-for-byte.  All v8 content is
+#: deterministic virtual-time fact — part of the byte-determinism
+#: contract.
+SCHEMA_WATERMARK = "tputopo.sim/v8"
 
 #: The pinned schema-key manifest: which top-level report keys and
 #: per-policy record keys each schema version emits, and which of them
@@ -152,6 +162,7 @@ SCHEMA_KEY_MANIFEST = {
     "tputopo.sim/v5": {"policy_gated": ("tiers", "preempt")},
     "tputopo.sim/v6": {"policy_gated": ("replicas",)},
     "tputopo.sim/v7": {"policy_gated": ("batch",)},
+    "tputopo.sim/v8": {"policy_gated": ("watermark",)},
 }
 
 #: The extender counters the report's per-policy ``scheduler`` block
@@ -406,9 +417,11 @@ def build_report(trace_desc: dict, horizon_s: float,
                  schema_chaos: bool = False,
                  schema_priority: bool = False,
                  schema_replicas: bool = False,
-                 schema_batch: bool = False) -> dict:
+                 schema_batch: bool = False,
+                 schema_watermark: bool = False) -> dict:
     out = {
-        "schema": (SCHEMA_BATCH if schema_batch
+        "schema": (SCHEMA_WATERMARK if schema_watermark
+                   else SCHEMA_BATCH if schema_batch
                    else SCHEMA_REPLICAS if schema_replicas
                    else SCHEMA_PRIORITY if schema_priority
                    else SCHEMA_CHAOS if schema_chaos

@@ -342,6 +342,20 @@ def mask_chips(topo: ChipTopology, mask: int) -> list[Coord]:
     return out
 
 
+def mask_bits_array(mask: int, nbits: int):
+    """``mask`` as a numpy 0/1 vector indexed by bit position, padded to
+    the byte boundary (length ``ceil(nbits/8)*8`` — callers slice if the
+    tail matters; a well-formed occupancy mask has zero padding bits).
+    The scalar<->vector bridge the extender's vectorized gang screen
+    uses to lift chip bitmasks into numpy row arithmetic."""
+    import numpy as np
+
+    return np.unpackbits(
+        np.frombuffer(mask.to_bytes((nbits + 7) // 8, "little"),
+                      dtype=np.uint8),
+        bitorder="little")
+
+
 def enumerate_placements(topo: ChipTopology, shape: SliceShape,
                          free: frozenset[Coord],
                          cost: LinkCostModel | None = None) -> list[Placement]:
@@ -438,6 +452,15 @@ class Allocator:
     def free_count(self) -> int:
         """Number of free chips (a popcount — no coord-set build)."""
         return self.free_mask.bit_count()
+
+    def free_mask_bytes(self) -> bytes:
+        """Little-endian byte view of the free mask (bit ``i`` = chip
+        index ``i``), padded to the byte boundary — what the extender's
+        vectorized gang screen concatenates across EVERY domain before
+        a single ``numpy.unpackbits`` call turns the whole fleet's
+        occupancy into one 0/1 vector."""
+        return self.free_mask.to_bytes(
+            (len(self.topo.chips) + 7) // 8, "little")
 
     @property
     def used_count(self) -> int:
