@@ -591,6 +591,11 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0,
                            xl2["throughput"]["wall_s"]])
     if xl2["throughput"]["wall_s"] < xl["throughput"]["wall_s"]:
         xl = xl2
+    # Traced replay for the per-phase breakdown, same shape as the first
+    # fleet leg: WHERE the XL wall goes (wake scans vs sort vs bind vs
+    # fold) — the XL hot-path PRs read their bottleneck phase from here
+    # before reaching for --profile.  Single policy, same as the wall legs.
+    xl_traced = run_trace(xl_cfg, ["ici"])
     xp = xl["policies"]["ici"]
     out["fleet_xl"] = {
         "nodes": xl["trace"]["nodes"],
@@ -604,19 +609,25 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0,
         # The dev-host standing records this leg is diffed against
         # (same inline rule as the first fleet leg's r05 ref): the
         # PR-16 1024x10000 fifo figure anchors the pre-watermark cost
-        # curve, and the PR-17 4096x40000 switch A/B is the first XL
-        # record (that scale had no earlier measurement).
+        # curve, the PR-17 4096x40000 switch A/B is the first XL
+        # record (that scale had no earlier measurement), and the
+        # PR-18 A/B is the XL hot-path pass (all six switches off =
+        # the PR-17 path; note its off figure reproduces PR-17's on).
         "baseline_ref": {
-            "ref": "PR 16/17 dev-host records (ROADMAP entries)",
+            "ref": "PR 16/17/18 dev-host records (ROADMAP entries)",
             "fleet_1024x10000_fifo": {"wall_s": 27.0,
                                       "events_per_s": 746.0},
             "fleet_4096x40000_pr17": {"events_per_s_off": 293.2,
                                       "events_per_s_on": 403.0},
+            "fleet_4096x40000_pr18": {"events_per_s_off": 404.7,
+                                      "events_per_s_on": 515.6},
         },
         "queue_wait_p95_s": xp["queue_wait_s"]["p95"],
         "utilization": xp["chip_utilization"]["time_weighted_mean"],
         "scheduled": xp["jobs"]["scheduled"],
         "watermark": xp.get("watermark"),
+        "traced_wall_s": xl_traced["throughput"]["wall_s"],
+        "phase_wall_ms": xl_traced.get("phase_wall", {}).get("ici", {}),
     }
     mixed = run_trace(
         TraceConfig(seed=seed, nodes=nodes, arrivals=arrivals,

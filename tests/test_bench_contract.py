@@ -127,9 +127,13 @@ def test_parent_process_never_initializes_a_backend():
     # accelerator work, whatever the runtime's mood.
     env["BENCH_BUDGET_S"] = "50"
     env["BENCH_TPU_PREFLIGHT_S"] = "5"
+    # The timeout is plumbing, not the contract under test: it only has
+    # to outlast the CPU-side sub-benches (the fleet_xl leg's traced
+    # phase-breakdown replay is the long pole at ~1 min on a loaded
+    # host) so the backend-isolation assertions below get to run.
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
-        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+        capture_output=True, text=True, timeout=480, env=env, cwd=REPO)
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
     assert len(lines) == 1

@@ -1,0 +1,285 @@
+"""XL hot-path pass (ISSUE 18): mask-native gang probes, fold-bookkeeping
+dirty sets, generation-keyed capacity memos, the parsed-assignment cache,
+annotation-dict templates, and preemption planning-state reuse — each
+leg's differential property against the exact path it replaced.  The
+all-switches-off report identity lives in test_hotpath.py."""
+
+from __future__ import annotations
+
+import random
+
+from tests.cluster import build_cluster
+from tests.test_hotpath import _Clock, _bind_pod, _random_event, _sync
+from tputopo.extender.config import ExtenderConfig
+from tputopo.extender.scheduler import ExtenderScheduler
+from tputopo.extender.state import (_PA_CACHE, _PA_CACHE_STATS,
+                                    ClusterState, _pod_assignment_of)
+from tputopo.k8s import objects as ko
+from tputopo.k8s.fakeapi import FakeApiServer
+from tputopo.k8s.objects import make_pod
+
+NODES = [f"node-{i}" for i in range(4)]
+
+
+def _gang_labels(gid: str, size: int = 2) -> dict:
+    return {"tpu.dev/gang-id": gid, "tpu.dev/gang-size": str(size)}
+
+
+# ---- mask-native gang probe vs the exact per-host walk -----------------------
+
+
+def _exact_candidates(dom, k, exclude_nodes):
+    """The legacy _plan_gang per-host enumeration, verbatim — the oracle
+    the mask probe must reproduce bit-for-bit."""
+    candidate = {}
+    free_mask = dom.allocator.free_mask
+    for host, node_name in dom.node_by_host.items():
+        if node_name in exclude_nodes:
+            continue
+        node_mask = dom.node_masks.get(node_name, 0)
+        node_free_mask = node_mask & free_mask
+        if node_free_mask.bit_count() < k:
+            continue
+        p = dom.allocator.find(k, free_mask=node_free_mask,
+                               within_mask=node_mask)
+        if p is not None:
+            candidate[host] = p
+    return candidate
+
+
+def _placement_facts(p):
+    return (tuple(map(tuple, p.chips)),
+            None if p.origin is None else tuple(p.origin),
+            None if p.dims is None else tuple(p.dims),
+            p.score_gbps)
+
+
+def test_mask_probe_matches_exact_walk_over_random_occupancy():
+    """Property: for every host, every k (boxable, blob-only, and
+    infeasible), and randomized occupancy/exclusion, the mask probe's
+    candidate map equals the exact walk's — same hosts, same chips, same
+    origin/dims/score (the _pick_box tiebreaks)."""
+    clock = _Clock()
+    api, _ = build_cluster(clock=clock)
+    sched = ExtenderScheduler(api, ExtenderConfig(), clock=clock)
+    rng = random.Random(11)
+    for trial in range(40):
+        state = _sync(api, clock)
+        dom = next(iter(state.domains.values()))
+        chips = list(dom.topology.chips)
+        dom.allocator.mark_used(rng.sample(chips,
+                                           rng.randrange(0, len(chips))))
+        excl = set(rng.sample(NODES, rng.randrange(0, len(NODES))))
+        # k=2/4: box vocabulary; k=3: blob-only on this topology (every
+        # probe falls back to the exact walk); k=5 > node capacity.
+        for k in (2, 3, 4, 5):
+            got = sched._mask_probe_candidates(dom, k, excl)
+            want = _exact_candidates(dom, k, excl)
+            assert ({h: _placement_facts(p) for h, p in got.items()}
+                    == {h: _placement_facts(p) for h, p in want.items()}), \
+                (trial, k)
+    assert sched.metrics.counters.get("gang_mask_probe_hits", 0) > 0
+    assert sched.metrics.counters.get("gang_mask_probe_fallbacks", 0) > 0
+
+
+def test_mask_probe_gang_sorts_match_exact_walk():
+    """End-to-end: gang sort results (which ride _plan_gang's candidate
+    maps) are identical with the probe on and off across a randomized
+    event stream."""
+    def run(probe: bool):
+        try:
+            ExtenderScheduler.MASK_GANG_PROBE = probe
+            clock = _Clock()
+            api, _ = build_cluster(clock=clock)
+            sched = ExtenderScheduler(
+                api, ExtenderConfig(state_cache_s=1e12,
+                                    bind_from_cache=True), clock=clock)
+            rng = random.Random(17)
+            live: list[str] = []
+            out = []
+            for step in range(60):
+                event = _random_event(api, clock, rng, live, step)
+                if event is not None:
+                    sched.apply_events([event])
+                if step % 4 == 0:
+                    name = f"g{step}"
+                    api.create("pods", make_pod(
+                        name, chips=2, labels=_gang_labels(name)))
+                    out.append(sched.sort(
+                        api.get("pods", name, "default"), NODES))
+            return out
+        finally:
+            ExtenderScheduler.MASK_GANG_PROBE = True
+
+    assert run(True) == run(False)
+
+
+# ---- parsed-assignment cache vs re-parse -------------------------------------
+
+
+def test_pa_cache_matches_reparse_after_fold_bind_wipe_streams():
+    """Property: across a random bind/confirm/wipe/delete/health stream,
+    the cached parse of every stored pod equals a from-scratch re-parse
+    (PA_CACHE off), and repeat nocopy reads actually hit."""
+    clock = _Clock()
+    api, _ = build_cluster(clock=clock)
+    rng = random.Random(5)
+    live: list[str] = []
+    _PA_CACHE.clear()
+    hits0 = _PA_CACHE_STATS["hits"]
+
+    def facts(pa):
+        if pa is None:
+            return None
+        return (pa.pod_name, pa.namespace, pa.node_name,
+                tuple(map(tuple, pa.chips)), pa.assigned, pa.assume_time,
+                pa.gang_id)
+
+    for step in range(120):
+        _random_event(api, clock, rng, live, step)
+        for name in live:
+            obj = api.get_nocopy("pods", name, "default")
+            cached = _pod_assignment_of(obj)
+            again = _pod_assignment_of(obj)  # identical incarnation: hit
+            try:
+                ClusterState.PA_CACHE = False
+                fresh = _pod_assignment_of(obj)
+            finally:
+                ClusterState.PA_CACHE = True
+            assert facts(cached) == facts(again) == facts(fresh), \
+                (step, name)
+    assert _PA_CACHE_STATS["hits"] > hits0
+
+
+def test_pa_cache_identity_guard_across_api_servers():
+    """Two api servers restart the resourceVersion counter, so (ns, name,
+    rv) keys collide across them — the metadata-identity guard must keep
+    the second server's pod from reading the first's cached parse."""
+    clock = _Clock()
+    api_a = FakeApiServer()
+    api_b = FakeApiServer()
+    _bind_pod(api_a, "p", "node-0", [(0, 0, 0)], clock)
+    _bind_pod(api_b, "p", "node-0", [(1, 0, 0)], clock)
+    obj_a = api_a.get_nocopy("pods", "p", "default")
+    obj_b = api_b.get_nocopy("pods", "p", "default")
+    # The collision is real: identical cache keys, different content.
+    assert (obj_a["metadata"]["resourceVersion"]
+            == obj_b["metadata"]["resourceVersion"])
+    _PA_CACHE.clear()
+    pa_a = _pod_assignment_of(obj_a)
+    pa_b = _pod_assignment_of(obj_b)
+    assert tuple(map(tuple, pa_a.chips)) == ((0, 0, 0),)
+    assert tuple(map(tuple, pa_b.chips)) == ((1, 0, 0),)
+
+
+# ---- generation-keyed capacity memo vs uncached ------------------------------
+
+
+def test_vector_cap_memo_matches_uncached_across_occupancy_bumps():
+    """Property: the per-(k, exclude) capacity memo answers exactly what
+    the memo-less computation answers, across event folds and bind
+    deltas that bump the counts generation — and repeat probes hit."""
+    clock = _Clock()
+    api, _ = build_cluster(clock=clock)
+    sched = ExtenderScheduler(
+        api, ExtenderConfig(state_cache_s=1e12, bind_from_cache=True),
+        clock=clock)
+    rng = random.Random(3)
+    live: list[str] = []
+    api.create("pods", make_pod("warm", chips=2))
+    probe_pod = api.get("pods", "warm", "default")
+
+    def uncached(state, dom, k, excl):
+        try:
+            ExtenderScheduler.VECTOR_CAP_MEMO = False
+            return sched._vector_cap(state, dom, k, set(excl))
+        finally:
+            ExtenderScheduler.VECTOR_CAP_MEMO = True
+
+    for step in range(60):
+        event = _random_event(api, clock, rng, live, step)
+        if event is not None:
+            sched.apply_events([event])
+        sched.sort(probe_pod, NODES)  # (re)prime the cached state
+        state = sched._cached_state
+        assert state is not None
+        for sid, dom in state.domains.items():
+            for k in (1, 2, 4):
+                excl = frozenset(rng.sample(NODES, rng.randrange(0, 3)))
+                first = sched._vector_cap(state, dom, k, set(excl),
+                                          exclude_key=excl)
+                second = sched._vector_cap(state, dom, k, set(excl),
+                                           exclude_key=excl)
+                assert first == second == uncached(state, dom, k, excl), \
+                    (step, sid, k, sorted(excl))
+    assert sched.metrics.counters.get("vector_cap_memo_hits", 0) > 0
+
+
+# ---- dirty-set fold bookkeeping vs mask comparison ---------------------------
+
+
+def test_dirty_fold_sorts_match_mask_compare_eviction():
+    """Property: gang sorts after every fold are identical whether memo
+    eviction is driven by the fold's dirty set or by the legacy pre/post
+    used-mask comparison — a missed eviction would serve a stale
+    candidate map and change a sort."""
+    def run(dirty: bool):
+        try:
+            ExtenderScheduler.DIRTY_FOLD = dirty
+            clock = _Clock()
+            api, _ = build_cluster(clock=clock)
+            sched = ExtenderScheduler(
+                api, ExtenderConfig(state_cache_s=1e12,
+                                    bind_from_cache=True), clock=clock)
+            rng = random.Random(9)
+            live: list[str] = []
+            out = []
+            for step in range(80):
+                event = _random_event(api, clock, rng, live, step)
+                if event is not None:
+                    sched.apply_events([event])
+                if step % 3 == 0:
+                    name = f"q{step}"
+                    api.create("pods", make_pod(
+                        name, chips=2, labels=_gang_labels(name)))
+                    out.append(sched.sort(
+                        api.get("pods", name, "default"), NODES))
+            if dirty:
+                assert sched.metrics.counters.get(
+                    "state_dirty_folds", 0) > 0
+            return out
+        finally:
+            ExtenderScheduler.DIRTY_FOLD = True
+
+    assert run(True) == run(False)
+
+
+# ---- annotation-dict templates vs per-call literals --------------------------
+
+
+def test_bind_ann_template_produces_identical_annotations():
+    """The hoisted assume-claim template must land the exact annotation
+    content the per-call literal built (dict equality — consumers look
+    keys up and the nocopy digest sorts keys, so insertion order is
+    explicitly outside the contract)."""
+    def run(tmpl: bool):
+        try:
+            ExtenderScheduler.BIND_ANN_TEMPLATE = tmpl
+            clock = _Clock()
+            api, _ = build_cluster(clock=clock)
+            sched = ExtenderScheduler(api, ExtenderConfig(), clock=clock)
+            for m in range(2):
+                api.create("pods", make_pod(f"g-{m}", chips=4,
+                                            labels=_gang_labels("g")))
+            out = []
+            for m in range(2):
+                pod = api.get("pods", f"g-{m}", "default")
+                best = sched.sort_best(pod, NODES)
+                sched.bind(f"g-{m}", "default", best["Host"])
+                out.append(api.get("pods", f"g-{m}", "default")
+                           ["metadata"]["annotations"])
+            return out
+        finally:
+            ExtenderScheduler.BIND_ANN_TEMPLATE = True
+
+    assert run(True) == run(False)

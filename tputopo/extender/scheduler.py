@@ -47,8 +47,8 @@ from tputopo.extender.state import (ClusterState, PodAssignment, SliceDomain,
 from tputopo.topology.model import ChipTopology, Coord
 from tputopo.topology.score import (_box_of, predict_allreduce_gbps,
                                     predict_multidomain_allreduce_gbps)
-from tputopo.topology.slices import (Allocator, Placement, enumerate_shapes,
-                                     mask_bits_array)
+from tputopo.topology.slices import (Allocator, Placement, _boxes_within,
+                                     enumerate_shapes, mask_bits_array)
 
 # Gang metadata lives in labels (selectable) with annotation fallback.
 LABEL_GANG_ID = "tpu.dev/gang-id"
@@ -193,6 +193,24 @@ class Metrics:
         return tuple(quantile(xs, q) for q in qs)
 
 
+def _pod_meta_get(md: dict, key: str, default=None):
+    """Labels-over-annotations metadata lookup WITHOUT materializing the
+    merged dict — by construction exactly
+    ``{**md["annotations"], **md["labels"]}.get(key, default)``, including
+    a label explicitly present with a None value shadowing an annotation
+    (presence, not truthiness, decides the shadow).  The
+    ``BIND_ANN_TEMPLATE`` fast path for the per-pod-per-verb gang
+    metadata probes, which at XL scale built millions of one-shot merge
+    dicts."""
+    labels = md.get("labels")
+    if labels is not None and key in labels:
+        return labels[key]
+    anns = md.get("annotations")
+    if anns is not None and key in anns:
+        return anns[key]
+    return default
+
+
 def _wanted_generation(pod: dict) -> str | None:
     """Pod-requested TPU generation (label or annotation tpu.dev/generation)
     — the Gaia heterogeneous-quota rule (PDF §III.A): one workload never
@@ -200,6 +218,8 @@ def _wanted_generation(pod: dict) -> str | None:
     construction (one node = one generation); this gate lets a pod *pin* a
     generation so it never lands on the wrong pool at all."""
     md = pod.get("metadata", {})
+    if ExtenderScheduler.BIND_ANN_TEMPLATE:
+        return _pod_meta_get(md, ko.ANN_GENERATION_LABEL)
     meta = {**md.get("annotations", {}), **md.get("labels", {})}
     return meta.get(ko.ANN_GENERATION_LABEL)
 
@@ -219,12 +239,17 @@ def _gang_of(pod: dict) -> tuple[str, str, int] | None:
     """(namespace, gang_id, size) — gang identity is namespace-scoped so
     same-named gangs in different namespaces never merge."""
     md = pod.get("metadata", {})
-    meta = {**md.get("annotations", {}), **md.get("labels", {})}
-    gid = meta.get(LABEL_GANG_ID)
+    if ExtenderScheduler.BIND_ANN_TEMPLATE:
+        gid = _pod_meta_get(md, LABEL_GANG_ID)
+        raw_size = _pod_meta_get(md, LABEL_GANG_SIZE, "0")
+    else:
+        meta = {**md.get("annotations", {}), **md.get("labels", {})}
+        gid = meta.get(LABEL_GANG_ID)
+        raw_size = meta.get(LABEL_GANG_SIZE, "0")
     if not gid:
         return None
     try:
-        size = int(meta.get(LABEL_GANG_SIZE, "0"))
+        size = int(raw_size)
     except ValueError:
         size = 0
     if size < 1:
@@ -348,6 +373,22 @@ class ExtenderScheduler:
         # full rebuild replaces the table).  The keyed object is held in
         # the value so a recycled id() can never alias a dead entry.
         self._vector_rows_cache: dict[int, tuple] = {}  # guarded-by: _cache_lock
+        # Mask-native gang probe (MASK_GANG_PROBE): per-(domain, k) box
+        # candidate vocabularies, keyed like the row layouts above on the
+        # node-mask table identity (held in the value against id() reuse).
+        self._mask_probe_cache: dict[tuple, tuple] = {}  # guarded-by: _cache_lock
+        # Hoisted invariant annotation-dict parts (BIND_ANN_TEMPLATE):
+        # config.replica_id is fixed at construction, so the assume-claim
+        # and release-wipe payloads vary only in their per-placement keys
+        # — dict(template)+patch replaces rebuilding each literal per
+        # member per attempt.  Never mutated after construction.
+        self._bind_ann_tmpl: dict = {ko.ANN_ASSIGNED: "false"}
+        self._wipe_ann_tmpl: dict = {
+            ko.ANN_GROUP: None, ko.ANN_ASSUME_TIME: None,
+            ko.ANN_ASSIGNED: None, ko.ANN_PREDICTED_GBPS: None}
+        if self.config.replica_id:
+            self._bind_ann_tmpl[ko.ANN_BOUND_BY] = self.config.replica_id
+            self._wipe_ann_tmpl[ko.ANN_BOUND_BY] = None
 
     _GANG_PLAN_CACHE_MAX = 512
 
@@ -376,6 +417,65 @@ class ExtenderScheduler:
     #: settings — only wall time moves.  False restores the historical
     #: probe-every-domain loop byte-for-byte.
     VECTOR_GANG_PLAN = True
+
+    #: Kill switch for the exclude-keyed capacity memo (XL hot-path
+    #: pass): ``_vector_cap`` answers are cached per state instance as
+    #: ``{(k, frozenset(exclude)): {slice_id: cap}}``.  The per-k base
+    #: caps were already memoized; what remained per call — and at 4096
+    #: nodes ran ~14M times — was the excluded-host subtraction loop.
+    #: Coherence rides the counts batch's existing staleness protocol:
+    #: ``_vector_cap`` reads ``_vector_counts`` FIRST, whose patch step
+    #: pops every staled domain from this memo before any hit can be
+    #: served, and the wholesale layout-mismatch drop takes the memo
+    #: with it.  A hit returns the identical int the loop would have
+    #: recomputed, so plans and report bytes are unchanged; False
+    #: restores the per-call subtraction loop byte-for-byte.
+    VECTOR_CAP_MEMO = True
+
+    #: Kill switch for dirty-set fold bookkeeping (XL hot-path pass):
+    #: ``ClusterState`` records the slice_ids whose occupancy an
+    #: in-place fold actually moved (``_dirty_sids``, maintained at the
+    #: same mark/release sites the allocators mutate), and single-owner
+    #: memo eviction consumes that set instead of snapshotting every
+    #: domain's used_mask before the fold and re-comparing after — the
+    #: two O(domains) passes per fold/bind that dominated XL fold wall.
+    #: The dirty set can only OVER-approximate the mask-compare result
+    #: (a release and a same-chips re-mark inside one fold batch cancel
+    #: in the mask but still dirty the domain), so eviction stays sound
+    #: and deterministic; gang-candidate eviction additionally walks a
+    #: per-domain key index instead of scanning the whole memo.  False
+    #: restores the snapshot-and-compare path byte-for-byte.
+    DIRTY_FOLD = True
+
+    #: Kill switch for bind-leg annotation templating (XL hot-path
+    #: pass): the per-member assignment-annotation dicts, the gang
+    #: release/claim wipe dicts, and the metadata lookups that backed
+    #: them are built from hoisted invariant templates with only the
+    #: varying keys patched per member, and gang metadata reads probe
+    #: labels-then-annotations directly instead of materializing a
+    #: merged ``{**annotations, **labels}`` dict per pod per verb.
+    #: Every produced dict is equal by construction (labels shadow
+    #: annotations exactly as the merge did, including explicit None
+    #: values), so patch payloads and report bytes are identical under
+    #: both settings; False restores the per-member literal dicts.
+    BIND_ANN_TEMPLATE = True
+
+    #: Kill switch for mask-native gang composition probes (XL hot-path
+    #: pass): ``_plan_gang``'s per-host candidate search — for every
+    #: host with >= k free chips, the best k-chip box inside the node —
+    #: is answered from a precomputed per-(domain, k) candidate
+    #: vocabulary (every box of every k-volume shape within each node's
+    #: chip mask, scored and tie-ranked exactly as ``Allocator.find``
+    #: orders them) with one numpy feasibility/fragmentation pass over
+    #: all hosts' candidates, instead of a Python shape x origin walk
+    #: per host.  Hosts whose free set defeats every vocabulary box
+    #: (fragmented remainder needing the connected-blob fallback) fall
+    #: back to the exact ``Allocator.find`` walk, counted
+    #: (``gang_mask_probe_fallbacks``), so the candidate map — and
+    #: every plan, bind, and report byte derived from it — is identical
+    #: under both settings.  k == 1 probes (no box vocabulary) always
+    #: take the exact walk.  False restores the per-host walk wholesale.
+    MASK_GANG_PROBE = True
 
     @property
     def _single_owner(self) -> bool:
@@ -437,11 +537,20 @@ class ExtenderScheduler:
             # restores the COW clone byte-for-byte) and evict only the
             # memo entries the fold's occupancy changes invalidate,
             # instead of filter-copying every memo dict per fold.
-            pre_masks = ({sid: dom.allocator.used_mask
-                          for sid, dom in state.domains.items()}
-                         if ClusterState.FOLD_INPLACE else None)
+            # DIRTY_FOLD skips the every-domain mask snapshot too: the
+            # fold records the domains it moves (_dirty_sids), and
+            # eviction consumes that set.
+            use_dirty = self.DIRTY_FOLD and ClusterState.FOLD_INPLACE
+            if use_dirty:
+                pre_masks = None
+                state._dirty_sids.clear()
+            else:
+                pre_masks = ({sid: dom.allocator.used_mask
+                              for sid, dom in state.domains.items()}
+                             if ClusterState.FOLD_INPLACE else None)
             new_state = state.fold_inplace(events, reasons)
         else:
+            use_dirty = False
             new_state = state.with_events(events, reasons)
         if new_state is None:
             self._count_delta_fallback(reasons)
@@ -450,7 +559,9 @@ class ExtenderScheduler:
         else:
             self.metrics.inc("state_delta_applied")
             if new_state is state:
-                self._evict_state_memos(state, pre_masks)
+                self._evict_state_memos(
+                    state, pre_masks,
+                    dirty=state._dirty_sids if use_dirty else None)
             else:
                 new_state = self._carry_state_memos(state, new_state)
             with self._cache_lock:
@@ -516,24 +627,42 @@ class ExtenderScheduler:
                     if key[0] not in changed}
             if kept:
                 new._gang_cand_memo = kept
+                # Rebuild the per-domain key index (DIRTY_FOLD eviction)
+                # from exactly the carried keys — the old state's index
+                # names keys this copy never held.
+                by_dom: dict[str, set] = {}
+                for key in kept:
+                    by_dom.setdefault(key[0], set()).add(key)
+                new._gang_cand_by_dom = by_dom
         return new
 
     def _evict_state_memos(self, state: ClusterState,
-                           pre_masks: dict[str, int]) -> None:
+                           pre_masks: dict[str, int] | None,
+                           dirty: set[str] | None = None) -> None:
         """The in-place twin of :meth:`_carry_state_memos`: after a
         single-owner fold mutated ``state`` directly, evict exactly the
         memo entries the COW path would have dropped — nodes of domains
         whose occupancy mask moved since ``pre_masks`` was snapshotted —
         in O(changed domains) instead of filter-copying every memo dict.
-        The gang context/member-list memos are dropped wholesale: the
-        COW clone never carried them (member listings can change on any
-        event, occupancy-moving or not), and in-place parity requires
-        the same."""
+        Under DIRTY_FOLD the caller passes ``dirty`` instead: the
+        slice_ids the fold itself recorded at its mark/release sites
+        (``ClusterState._dirty_sids``), sparing both the pre-fold
+        snapshot and the every-domain compare; the set can only
+        over-approximate the compare (still sound — eviction of a
+        still-valid entry merely recomputes it).  The gang
+        context/member-list memos are dropped wholesale: the COW clone
+        never carried them (member listings can change on any event,
+        occupancy-moving or not), and in-place parity requires the
+        same."""
         for attr in ("_gang_ctx_memo", "_gang_members_memo"):
             if getattr(state, attr, None) is not None:
                 delattr(state, attr)
-        changed = {sid for sid, dom in state.domains.items()
-                   if dom.allocator.used_mask != pre_masks.get(sid)}
+        if dirty is not None:
+            self.metrics.inc("state_dirty_folds")
+            changed = {sid for sid in dirty if sid in state.domains}
+        else:
+            changed = {sid for sid, dom in state.domains.items()
+                       if dom.allocator.used_mask != pre_masks.get(sid)}
         if not changed:
             return
         # The vectorized gang screen's count batch is a pure function of
@@ -576,8 +705,21 @@ class ExtenderScheduler:
                 del memo[key]
         cand = getattr(state, "_gang_cand_memo", None)
         if cand:
-            for key in [key for key in cand if key[0] in changed]:
-                del cand[key]
+            by_dom = getattr(state, "_gang_cand_by_dom", None)
+            if self.DIRTY_FOLD and by_dom is not None:
+                # O(evicted) via the per-domain key index instead of
+                # scanning every memo key — the comprehension below
+                # scales with total memoized (domain, k, exclude) keys,
+                # which at 4096 nodes dwarfs the handful a fold moves.
+                for sid in changed:
+                    for key in by_dom.pop(sid, ()):
+                        cand.pop(key, None)
+            else:
+                for key in [key for key in cand if key[0] in changed]:
+                    del cand[key]
+                if by_dom is not None:
+                    for sid in changed:
+                        by_dom.pop(sid, None)
 
     def _count_delta_fallback(self, reasons: list[str] | str) -> None:
         """One forced full rebuild, attributed: the flat
@@ -1289,6 +1431,14 @@ class ExtenderScheduler:
                     if sid in caps:
                         r0, nr, _ = info[sid]
                         caps[sid] = int((counts[r0:r0 + nr] >= k).sum())
+        xmemo = getattr(state, "_vector_capx", None)
+        if xmemo is not None:
+            # Exclude-keyed caps (VECTOR_CAP_MEMO) of a moved domain are
+            # stale in every entry; drop just those — the next probe
+            # recomputes them from the freshly patched windows.
+            for entry in xmemo.values():
+                for sid in stale:
+                    entry.pop(sid, None)
         stale.clear()
         return got
 
@@ -1312,7 +1462,8 @@ class ExtenderScheduler:
             # Layout moved under the cache: drop everything derived
             # from it and fall through to the wholesale rebuild.
             stale.clear()
-            for attr in ("_vector_counts_cache", "_vector_capk"):
+            for attr in ("_vector_counts_cache", "_vector_capk",
+                         "_vector_capx"):
                 if getattr(state, attr, None) is not None:
                     delattr(state, attr)
         import numpy as np
@@ -1341,16 +1492,37 @@ class ExtenderScheduler:
         return got
 
     def _vector_cap(self, state: ClusterState, dom: SliceDomain, k: int,
-                    exclude_nodes: set[str]) -> int | None:
+                    exclude_nodes: set[str],
+                    exclude_key: frozenset | None = None) -> int | None:
         """Upper bound on the gang members ``dom`` can host at ``k``
         chips each: nodes with >= k free chips, minus already-consumed
         (excluded) hosts, from the vectorized count batch.  Per-(state,
         k) capacities are memoized; None when the domain is unknown to
-        the batch (callers fall back to probing)."""
+        the batch (callers fall back to probing).  ``exclude_key`` is an
+        optional precomputed ``frozenset(exclude_nodes)`` so repeat
+        callers (the gang screen probes every domain with one exclude
+        set) don't rebuild it per domain for the VECTOR_CAP_MEMO key."""
         # Read the batch FIRST, unconditionally: it patches any windows
-        # (and per-k caps) staled by in-place folds since the last read
-        # — a memo hit must never answer from a pre-fold capacity.
+        # (and per-k / per-exclude caps) staled by in-place folds since
+        # the last read — a memo hit must never answer from a pre-fold
+        # capacity.
         counts, info = self._vector_counts(state)
+        if self.VECTOR_CAP_MEMO:
+            if exclude_key is None:
+                exclude_key = frozenset(exclude_nodes)
+            xmemo = getattr(state, "_vector_capx", None)
+            if xmemo is None:
+                xmemo = state._vector_capx = {}
+            entry = xmemo.get((k, exclude_key))
+            if entry is None:
+                if len(xmemo) >= self._GANG_PLAN_CACHE_MAX:
+                    xmemo.clear()  # bound pathological exclude-set churn
+                entry = xmemo[(k, exclude_key)] = {}
+            elif dom.slice_id in entry:
+                self.metrics.inc("vector_cap_memo_hits")
+                return entry[dom.slice_id]
+        else:
+            entry = None
         memo = getattr(state, "_vector_capk", None)
         if memo is None:
             memo = state._vector_capk = {}
@@ -1360,19 +1532,158 @@ class ExtenderScheduler:
             caps = memo[k] = {sid: int(ge[r0:r0 + nr].sum())
                               for sid, (r0, nr, _) in info.items()}
         cap = caps.get(dom.slice_id)
-        if cap is None:
-            return None
-        if exclude_nodes:
+        if cap is not None and exclude_nodes:
             r0, _, row_by_node = info[dom.slice_id]
             for n in exclude_nodes:
                 r = row_by_node.get(n)
                 if r is not None and counts[r0 + r] >= k:
                     cap -= 1
+        if entry is not None:
+            entry[dom.slice_id] = cap
         return cap
+
+    def _mask_probe_vocab(self, dom: SliceDomain, k: int) -> tuple | None:
+        """Candidate vocabulary for the mask-native gang probe: every box
+        of every k-volume shape inside each node's chip mask, with the
+        exact ordering key ``Allocator._pick_box`` minimizes flattened
+        into one int per candidate.  The key is ``(score rank, frag,
+        chips)`` lexicographically; score rank is dense over DISTINCT
+        shape scores (ties compete on the rest, as the strict-< min
+        does), frag is the only occupancy-dependent term, and the chips
+        tiebreak becomes a per-host position: candidates sorted by
+        (chips tuple, encounter order), so exact key ties resolve to the
+        first-encountered candidate exactly as strict-< keeps it.
+        Cached per (node-mask table identity, k); None when no k-volume
+        box fits the topology at all (every probe needs the exact
+        walk's blob fallback)."""
+        key = (id(dom.node_masks), k)
+        with self._cache_lock:
+            got = self._mask_probe_cache.get(key)
+        if got is not None and got[0] is dom.node_masks:
+            return got[1]
+        import numpy as np
+
+        topo = dom.topology
+        cost = dom.allocator.cost
+        nchips = len(topo.chips)
+        shapes = enumerate_shapes(topo, k, cost)
+        vocab: tuple | None = None
+        if shapes:
+            ranked = []  # (rank, score, dims) — dense rank, best first
+            rank, prev = -1, None
+            for s in shapes:
+                sc = predict_allreduce_gbps(topo, s.dims, cost)
+                if prev is None or sc < prev:
+                    rank, prev = rank + 1, sc
+                ranked.append((rank, sc, s.dims))
+            hosts = []      # (host, node_name, node_mask, seg_lo, seg_hi)
+            masks, nbrs, ranks, poss = [], [], [], []
+            placements: list[Placement] = []
+            for host, node_name in dom.node_by_host.items():
+                node_mask = dom.node_masks.get(node_name, 0)
+                lo = len(masks)
+                entries = []  # (chips, encounter, rank, score, origin, dims,
+                enc = 0       #  box_mask, nbr_mask & node_mask)
+                for rk, sc, dims in ranked:
+                    for o, chips, mask, nbr in _boxes_within(topo, dims,
+                                                             node_mask):
+                        entries.append((chips, enc, rk, sc, o, dims, mask,
+                                        nbr & node_mask))
+                        enc += 1
+                order = sorted(range(len(entries)),
+                               key=lambda i: entries[i][:2])
+                pos = [0] * len(entries)
+                for p_i, i in enumerate(order):
+                    pos[i] = p_i
+                for (chips, _, rk, sc, o, dims, mask, nbrm), p_i in zip(
+                        entries, pos):
+                    masks.append(mask)
+                    nbrs.append(nbrm)
+                    ranks.append(rk)
+                    poss.append(p_i)
+                    placements.append(Placement(chips=chips, origin=o,
+                                                dims=dims, score_gbps=sc))
+                hosts.append((host, node_name, node_mask, lo, len(masks)))
+            if masks:
+                nbits = ((nchips + 7) // 8) * 8
+                m2 = len(masks) + 1            # > any pos
+                m1 = (nbits + 1) * m2          # > any frag * m2 + pos
+                big = (max(ranks) + 1) * m1    # > any feasible key
+                mask_bits = np.stack([mask_bits_array(m, nchips)
+                                      for m in masks]).astype(np.int64)
+                nbr_bits = np.stack([mask_bits_array(m, nchips)
+                                     for m in nbrs]).astype(np.int64)
+                key_base = (np.asarray(ranks, dtype=np.int64) * m1
+                            + np.asarray(poss, dtype=np.int64))
+                vocab = (hosts, mask_bits, nbr_bits, key_base,
+                         np.int64(m2), np.int64(big), placements, nchips)
+        with self._cache_lock:
+            self._mask_probe_cache[key] = (dom.node_masks, vocab)
+            while len(self._mask_probe_cache) > self._GANG_PLAN_CACHE_MAX:
+                self._mask_probe_cache.pop(
+                    next(iter(self._mask_probe_cache)))
+        return vocab
+
+    def _mask_probe_candidates(self, dom: SliceDomain, k: int,
+                               exclude_nodes: set[str]
+                               ) -> dict[Coord, Placement]:
+        """Mask-native per-host candidate map (MASK_GANG_PROBE, k >= 2):
+        one numpy feasibility/fragmentation pass over the domain's whole
+        candidate vocabulary answers every host's best-box query; hosts
+        whose free chips defeat every vocabulary box (or a k with no box
+        vocabulary) fall back to the exact ``Allocator.find`` walk.
+        Produces the same {host: placement} map as the per-host walk —
+        feasibility, fragmentation, and every tiebreak replicate
+        ``_pick_box`` bit-for-bit (see ``_mask_probe_vocab``)."""
+        vocab = self._mask_probe_vocab(dom, k)
+        free_mask = dom.allocator.free_mask
+        candidate: dict[Coord, Placement] = {}
+        fired = fell_back = 0
+        if vocab is not None:
+            import numpy as np
+
+            hosts, mask_bits, nbr_bits, key_base, m2, big, placements, \
+                nchips = vocab
+            fbits = mask_bits_array(free_mask, nchips).astype(np.int64)
+            hits = mask_bits @ fbits
+            keys = np.where(hits == k, key_base + (nbr_bits @ fbits) * m2,
+                            big)
+        else:
+            hosts = [(host, node_name, dom.node_masks.get(node_name, 0),
+                      0, 0) for host, node_name in dom.node_by_host.items()]
+            keys = big = None
+        for host, node_name, node_mask, lo, hi in hosts:
+            if node_name in exclude_nodes:
+                continue
+            node_free_mask = node_mask & free_mask
+            if node_free_mask.bit_count() < k:
+                continue
+            p = None
+            if hi > lo:
+                seg = keys[lo:hi]
+                i = int(seg.argmin())
+                if seg[i] < big:
+                    p = placements[lo + i]
+                    fired += 1
+            if p is None:
+                # Fragmented remainder (blob territory) or no vocabulary
+                # at this k: the exact walk is authoritative.
+                p = dom.allocator.find(
+                    k, free_mask=node_free_mask, within_mask=node_mask)
+                fell_back += 1
+            if p is not None:
+                candidate[host] = p
+        if fired:
+            self.metrics.inc("gang_mask_probe_hits", fired)
+        if fell_back:
+            self.metrics.inc("gang_mask_probe_fallbacks", fell_back)
+        return candidate
 
     def _plan_gang(self, state: ClusterState, dom: SliceDomain,
                    replicas: int, k: int,
-                   exclude_nodes: set[str]) -> dict[str, Placement] | None:
+                   exclude_nodes: set[str],
+                   exclude_key: frozenset | None = None
+                   ) -> dict[str, Placement] | None:
         """Plan ``replicas`` single-node k-chip placements, preferring a
         contiguous box on the host grid so the union is ICI-contiguous
         (SURVEY.md §7: Link-scheduler analog in 3D).  Returns
@@ -1400,23 +1711,37 @@ class ExtenderScheduler:
         memo = getattr(state, "_gang_cand_memo", None)
         if memo is None:
             memo = state._gang_cand_memo = {}
-        memo_key = (dom.slice_id, k, frozenset(exclude_nodes))
+        memo_key = (dom.slice_id, k,
+                    frozenset(exclude_nodes) if exclude_key is None
+                    else exclude_key)
         candidate = memo.get(memo_key)
         if candidate is None:
-            candidate = {}
-            free_mask = dom.allocator.free_mask
-            for host, node_name in dom.node_by_host.items():
-                if node_name in exclude_nodes:
-                    continue
-                node_mask = dom.node_masks.get(node_name, 0)
-                node_free_mask = node_mask & free_mask
-                if node_free_mask.bit_count() < k:
-                    continue
-                p = dom.allocator.find(
-                    k, free_mask=node_free_mask, within_mask=node_mask)
-                if p is not None:
-                    candidate[host] = p
+            if self.MASK_GANG_PROBE and k >= 2:
+                candidate = self._mask_probe_candidates(dom, k, exclude_nodes)
+            else:
+                candidate = {}
+                free_mask = dom.allocator.free_mask
+                for host, node_name in dom.node_by_host.items():
+                    if node_name in exclude_nodes:
+                        continue
+                    node_mask = dom.node_masks.get(node_name, 0)
+                    node_free_mask = node_mask & free_mask
+                    if node_free_mask.bit_count() < k:
+                        continue
+                    p = dom.allocator.find(
+                        k, free_mask=node_free_mask, within_mask=node_mask)
+                    if p is not None:
+                        candidate[host] = p
             memo[memo_key] = candidate
+            # Per-domain key index for dirty-set eviction (DIRTY_FOLD).
+            # Maintained unconditionally — a mid-run switch flip must
+            # never see a partial index — and only ever consulted to POP
+            # keys, so a stale entry naming an already-evicted key is a
+            # harmless no-op.
+            by_dom = getattr(state, "_gang_cand_by_dom", None)
+            if by_dom is None:
+                by_dom = state._gang_cand_by_dom = {}
+            by_dom.setdefault(dom.slice_id, set()).add(memo_key)
         else:
             self.metrics.inc("gang_candidate_memo_hits")
 
@@ -1432,9 +1757,13 @@ class ExtenderScheduler:
     @staticmethod
     def _gang_allows_multislice(members: list[dict]) -> bool:
         for p in members:
-            meta = {**p["metadata"].get("annotations", {}),
-                    **p["metadata"].get("labels", {})}
-            if meta.get(LABEL_ALLOW_MULTISLICE) == "true":
+            if ExtenderScheduler.BIND_ANN_TEMPLATE:
+                allow = _pod_meta_get(p["metadata"], LABEL_ALLOW_MULTISLICE)
+            else:
+                meta = {**p["metadata"].get("annotations", {}),
+                        **p["metadata"].get("labels", {})}
+                allow = meta.get(LABEL_ALLOW_MULTISLICE)
+            if allow == "true":
                 return True
         return False
 
@@ -1588,6 +1917,10 @@ class ExtenderScheduler:
             # out via the GC).
             return None
         exclude = {p["spec"]["nodeName"] for p in bound}
+        # One frozen copy serves every per-domain memo key below (the
+        # screen and the candidate-map memo key both need the hashable
+        # form; building it per probe was measurable at 4096 nodes).
+        exclude_fs = frozenset(exclude)
         all_doms = sorted(state.domains.values(), key=lambda d: d.slice_id)
         if wanted_gen is not None:
             all_doms = [d for d in all_doms
@@ -1623,7 +1956,8 @@ class ExtenderScheduler:
             vol = remaining * k
             kept = []
             for dom in phase1:
-                cap = self._vector_cap(state, dom, k, exclude)
+                cap = self._vector_cap(state, dom, k, exclude,
+                                       exclude_key=exclude_fs)
                 if cap is not None and (
                         cap < remaining
                         or dom.allocator.free_count < vol):
@@ -1634,7 +1968,8 @@ class ExtenderScheduler:
                                  len(phase1) - len(kept))
             phase1 = kept
         for dom in phase1:
-            plan = self._plan_gang(state, dom, remaining, k, exclude)
+            plan = self._plan_gang(state, dom, remaining, k, exclude,
+                                   exclude_key=exclude_fs)
             if plan is not None:
                 return ctx(plan)
         if not allow_multi:
@@ -1672,7 +2007,8 @@ class ExtenderScheduler:
             def plan_for(dom, m: int):
                 key = (dom.slice_id, m)
                 if key not in plan_cache:
-                    plan_cache[key] = self._plan_gang(state, dom, m, k, exclude)
+                    plan_cache[key] = self._plan_gang(
+                        state, dom, m, k, exclude, exclude_key=exclude_fs)
                 return plan_cache[key]
 
             def max_feasible(dom) -> int:
@@ -1682,7 +2018,8 @@ class ExtenderScheduler:
                     # members than its >=k-free host count or its free
                     # volume allows, so the probe starts there instead
                     # of at the host count — same answer, fewer probes.
-                    cap = self._vector_cap(state, dom, k, exclude)
+                    cap = self._vector_cap(state, dom, k, exclude,
+                                           exclude_key=exclude_fs)
                     if cap is not None:
                         hi = min(hi, cap, dom.allocator.free_count // k)
                 for m in range(hi, 0, -1):
@@ -1799,14 +2136,20 @@ class ExtenderScheduler:
             anns = md.get("annotations", {})
             if not anns.get(ko.ANN_GROUP) or anns.get(ko.ANN_ASSIGNED) != "false":
                 continue
-            wipe: dict = {ko.ANN_GROUP: None, ko.ANN_ASSUME_TIME: None,
-                          ko.ANN_ASSIGNED: None, ko.ANN_PREDICTED_GBPS: None}
-            if self.config.replica_id or ko.ANN_BOUND_BY in anns:
-                # Replicated deployments stamp the binding replica's id;
-                # a release must clear it with the claim (a peer's wiped
-                # gang must not read as still-owned).  Conditional so the
-                # single-scheduler patch stream stays byte-identical.
-                wipe[ko.ANN_BOUND_BY] = None
+            if ExtenderScheduler.BIND_ANN_TEMPLATE:
+                wipe: dict = dict(self._wipe_ann_tmpl)
+                if ko.ANN_BOUND_BY in anns:
+                    wipe[ko.ANN_BOUND_BY] = None
+            else:
+                wipe = {ko.ANN_GROUP: None, ko.ANN_ASSUME_TIME: None,
+                        ko.ANN_ASSIGNED: None, ko.ANN_PREDICTED_GBPS: None}
+                if self.config.replica_id or ko.ANN_BOUND_BY in anns:
+                    # Replicated deployments stamp the binding replica's
+                    # id; a release must clear it with the claim (a peer's
+                    # wiped gang must not read as still-owned).
+                    # Conditional so the single-scheduler patch stream
+                    # stays byte-identical.
+                    wipe[ko.ANN_BOUND_BY] = None
             try:
                 self._api_call(
                     "release", self.api.patch_annotations,
@@ -2294,10 +2637,13 @@ class ExtenderScheduler:
         # unclaimed — un-binding is the job controller's delete/recreate
         # (the sim engine's reset path); the TTL GC backstops a failed
         # wipe exactly like any other stale assumption.
-        wipe: dict = {ko.ANN_GROUP: None, ko.ANN_ASSUME_TIME: None,
-                      ko.ANN_ASSIGNED: None, ko.ANN_PREDICTED_GBPS: None}
-        if self.config.replica_id:
-            wipe[ko.ANN_BOUND_BY] = None
+        if ExtenderScheduler.BIND_ANN_TEMPLATE:
+            wipe: dict = dict(self._wipe_ann_tmpl)
+        else:
+            wipe = {ko.ANN_GROUP: None, ko.ANN_ASSUME_TIME: None,
+                    ko.ANN_ASSIGNED: None, ko.ANN_PREDICTED_GBPS: None}
+            if self.config.replica_id:
+                wipe[ko.ANN_BOUND_BY] = None
         try:
             self._api_call("release", self.api.patch_annotations, "pods",
                            pod_name, wipe, namespace=ns)
@@ -2414,8 +2760,7 @@ class ExtenderScheduler:
         with tr.phase("plan"):
             if gang is not None:
                 gang_id = gang[1]
-                gang_ctx = self._gang_context(state, gang, k,
-                                              _wanted_generation(pod),
+                gang_ctx = self._gang_context(state, gang, k, wanted_gen,
                                               reader=informer_reader, pod=pod)
                 if gang_ctx is None:
                     # None covers two distinct cases that must not share a
@@ -2470,20 +2815,29 @@ class ExtenderScheduler:
                     )
 
         now = self.clock()
-        anns = {
-            ko.ANN_GROUP: ko.coords_to_ann(placement.chips),
-            ko.ANN_ASSUME_TIME: str(now),
-            ko.ANN_ASSIGNED: "false",
-            ko.ANN_PREDICTED_GBPS: f"{placement.score_gbps:.3f}",
-        }
-        if gang_id is not None:
-            anns[ko.ANN_GANG_ID] = gang_id
-        if self.config.replica_id:
-            # Replica identity on every committed bind (replicated control
-            # plane): recover() reads it to tell its own in-flight binds
-            # from a peer's.  Absent without a replica_id — the
-            # single-scheduler annotation vocabulary is byte-identical.
-            anns[ko.ANN_BOUND_BY] = self.config.replica_id
+        if ExtenderScheduler.BIND_ANN_TEMPLATE:
+            anns = dict(self._bind_ann_tmpl)
+            anns[ko.ANN_GROUP] = ko.coords_to_ann(placement.chips)
+            anns[ko.ANN_ASSUME_TIME] = str(now)
+            anns[ko.ANN_PREDICTED_GBPS] = f"{placement.score_gbps:.3f}"
+            if gang_id is not None:
+                anns[ko.ANN_GANG_ID] = gang_id
+        else:
+            anns = {
+                ko.ANN_GROUP: ko.coords_to_ann(placement.chips),
+                ko.ANN_ASSUME_TIME: str(now),
+                ko.ANN_ASSIGNED: "false",
+                ko.ANN_PREDICTED_GBPS: f"{placement.score_gbps:.3f}",
+            }
+            if gang_id is not None:
+                anns[ko.ANN_GANG_ID] = gang_id
+            if self.config.replica_id:
+                # Replica identity on every committed bind (replicated
+                # control plane): recover() reads it to tell its own
+                # in-flight binds from a peer's.  Absent without a
+                # replica_id — the single-scheduler annotation vocabulary
+                # is byte-identical.
+                anns[ko.ANN_BOUND_BY] = self.config.replica_id
         with tr.phase("cas_patch"):
             try:
                 try:
@@ -2668,15 +3022,25 @@ class ExtenderScheduler:
                 # verb's claim arbitration, never by trusting this cache.
                 new_state = None
                 pre_masks = None
+                use_dirty = False
                 if self.config.state_delta and state is self._cached_state:
                     pa = PodAssignment(
                         pod_name=pod_name, namespace=namespace or "default",
                         node_name=node_name, chips=list(placement.chips),
                         assigned=False, assume_time=now, gang_id=gang_id)
                     if self._single_owner:
-                        pre_masks = ({sid: dom.allocator.used_mask
-                                      for sid, dom in state.domains.items()}
-                                     if ClusterState.FOLD_INPLACE else None)
+                        use_dirty = (self.DIRTY_FOLD
+                                     and ClusterState.FOLD_INPLACE)
+                        if use_dirty:
+                            # note_bind records the bound domain in
+                            # _dirty_sids — no per-domain mask snapshot.
+                            state._dirty_sids.clear()
+                        else:
+                            pre_masks = ({sid: dom.allocator.used_mask
+                                          for sid, dom in
+                                          state.domains.items()}
+                                         if ClusterState.FOLD_INPLACE
+                                         else None)
                         new_state = state.bind_inplace(pa)
                     else:
                         try:
@@ -2685,7 +3049,9 @@ class ExtenderScheduler:
                             new_state = None  # stale view — drop below
                 if new_state is not None:
                     if new_state is state:
-                        self._evict_state_memos(state, pre_masks)
+                        self._evict_state_memos(
+                            state, pre_masks,
+                            dirty=state._dirty_sids if use_dirty else None)
                     else:
                         new_state = self._carry_state_memos(state, new_state)
                     self.metrics.inc("bind_state_delta")

@@ -87,26 +87,65 @@ def _assume_time_of(pod: dict) -> float:
     return val if math.isfinite(val) else 0.0
 
 
+# Parsed-assignment cache (ClusterState.PA_CACHE): the fold/sync hot
+# paths call _pod_assignment_of for every pod of every event batch —
+# ~3.8M times per XL trace — and the api server bumps resourceVersion on
+# EVERY write, so (namespace, name, resourceVersion) pins one immutable
+# annotation snapshot and the parse is a pure function of it.  The key
+# alone is NOT globally unique — two api servers (the sim runs one per
+# policy) restart the version counter, so a hit additionally requires
+# the cached entry's metadata dict to be the SAME OBJECT: under the
+# nocopy read path an unchanged pod hands out one stored incarnation
+# (identity holds, hits land), while a colliding key from another
+# server is a different dict and recomputes.  Pods without a
+# resourceVersion (hand-built test objects, foreign clients) bypass the
+# cache entirely.  The cached PodAssignment is SHARED by all callers —
+# safe under the repo-wide "assignments are replaced, never mutated"
+# discipline (_update_assignment builds a new record; nothing writes
+# PodAssignment fields in place).  Bounded FIFO like _parse_chips_ann's
+# lru; hit/miss stats are module-local (state.py has no Metrics
+# plumbing) for the differential test and the CI smoke.
+_PA_CACHE: dict[tuple, tuple] = {}  # key -> (metadata dict, parse)
+_PA_CACHE_MAX = 32768
+_PA_CACHE_STATS = {"hits": 0, "misses": 0}
+
+
 def _pod_assignment_of(pod: dict) -> PodAssignment | None:
     """The assignment a pod object carries, or None for a pod with no
     derived-state impact (no chip group or not bound to a node).  THE pod
     filter — shared by sync() and the event folders, so the two can never
     silently diverge on what counts as an assignment."""
     md = pod.get("metadata", {})
+    key = None
+    if ClusterState.PA_CACHE:
+        rv = md.get("resourceVersion")
+        if rv is not None:
+            key = (md.get("namespace", "default"), md.get("name"), rv)
+            got = _PA_CACHE.get(key)
+            if got is not None and got[0] is md:
+                _PA_CACHE_STATS["hits"] += 1
+                return got[1]
+            _PA_CACHE_STATS["misses"] += 1
     anns = md.get("annotations", {})
     group = anns.get(ko.ANN_GROUP)
     node_name = pod.get("spec", {}).get("nodeName")
     if not group or not node_name:
-        return None
-    return PodAssignment(
-        pod_name=md["name"],
-        namespace=md.get("namespace", "default"),
-        node_name=node_name,
-        chips=ko.ann_to_coords(group),
-        assigned=anns.get(ko.ANN_ASSIGNED) == "true",
-        assume_time=_assume_time_of(pod),
-        gang_id=anns.get(ko.ANN_GANG_ID),
-    )
+        pa = None
+    else:
+        pa = PodAssignment(
+            pod_name=md["name"],
+            namespace=md.get("namespace", "default"),
+            node_name=node_name,
+            chips=ko.ann_to_coords(group),
+            assigned=anns.get(ko.ANN_ASSIGNED) == "true",
+            assume_time=_assume_time_of(pod),
+            gang_id=anns.get(ko.ANN_GANG_ID),
+        )
+    if key is not None:
+        if len(_PA_CACHE) >= _PA_CACHE_MAX:
+            _PA_CACHE.clear()
+        _PA_CACHE[key] = (md, pa)
+    return pa
 
 
 def _host_coord_of(anns: dict) -> Coord:
@@ -160,6 +199,20 @@ class ClusterState:
     #: (only a provably single-owner state may fold in place).
     FOLD_INPLACE = True
 
+    #: Kill switch for the parsed-assignment cache (XL hot-path pass):
+    #: :func:`_pod_assignment_of` memoizes its result per (namespace,
+    #: name, resourceVersion) — the api server bumps resourceVersion on
+    #: every write and the nocopy guard forbids content drift at an
+    #: unmoved version, so the key pins one immutable annotation
+    #: snapshot and the parse is a pure function of it (a hit also
+    #: requires metadata-dict identity, so a second api server's
+    #: colliding version counter can never alias).  Pods without a
+    #: resourceVersion bypass the cache, so a hit can only ever return
+    #: the value the parse would recompute — fold results, sync results,
+    #: and report bytes are identical under both settings.  False
+    #: restores the parse-per-call path wholesale.
+    PA_CACHE = True
+
     def __init__(self, api_server: FakeApiServer, *,
                  cost_for_generation=None, assume_ttl_s: float = 60.0,
                  clock=time.time) -> None:
@@ -180,6 +233,13 @@ class ClusterState:
         self._pod_index: dict[tuple[str, str], _PodRec] = {}
         self._unhealthy_by_node: dict[str, frozenset[Coord]] = {}
         self._synced_at: float = 0.0  # clock at sync — expiry judgement time
+        # Domains whose occupancy the in-place fold paths moved since the
+        # owner last drained the set (ExtenderScheduler.DIRTY_FOLD memo
+        # eviction).  Recorded unconditionally at every mark/release site
+        # — it is a bounded set of slice_ids, and recording must not
+        # depend on the scheduler-side switch so a mid-run flip never
+        # sees a half-recorded fold.
+        self._dirty_sids: set[str] = set()
 
     # ---- sync (SURVEY.md §3.2: parse annotations -> in-memory model) -------
 
@@ -200,6 +260,7 @@ class ClusterState:
         self._dom_by_node = {}
         self._pod_index = {}
         self._unhealthy_by_node = {}
+        self._dirty_sids = set()
         for node in self._list("nodes"):
             anns = node["metadata"].get("annotations", {})
             if ko.ANN_TOPOLOGY not in anns or ko.ANN_SLICE_ID not in anns:
@@ -341,6 +402,7 @@ class ClusterState:
         if not chips_marked:
             dom.allocator.mark_used(pa.chips)
         dom.assignments.append(pa)
+        self._dirty_sids.add(dom.slice_id)
         self._pod_index[(pa.namespace, pa.pod_name)] = _PodRec(
             pa, dom.slice_id, "active", tuple(pa.chips))
 
@@ -363,6 +425,7 @@ class ClusterState:
         new._pod_index = dict(self._pod_index)
         new._unhealthy_by_node = self._unhealthy_by_node
         new._synced_at = self._synced_at
+        new._dirty_sids = set()  # fresh owner, nothing drained yet
         new.domains = {}
         new._dom_by_node = {}
         for sid, dom in self.domains.items():
@@ -596,6 +659,7 @@ class ClusterState:
             back = [c for c in rec.held if c in dom.unhealthy]
             if back:
                 dom.allocator.mark_used(back)
+            self._dirty_sids.add(dom.slice_id)
 
     def _add_assignment(self, pa: PodAssignment) -> None:
         dom = self._dom_by_node[pa.node_name]
@@ -617,6 +681,7 @@ class ClusterState:
             raise _DeltaUnappliable("chips not cleanly free",
                                      code="overlap") from None
         dom.assignments.append(pa)
+        self._dirty_sids.add(dom.slice_id)
         self._pod_index[key] = _PodRec(pa, dom.slice_id, "active",
                                        tuple(pa.chips))
 
@@ -684,6 +749,8 @@ class ClusterState:
         gone = [c for c in dom.unhealthy - union if c not in held]
         if gone:
             alloc.release(gone)
+        if add or gone:
+            self._dirty_sids.add(dom.slice_id)
         dom.unhealthy = union  # fresh set: the parent's is shared, not ours
         dom.on_unhealthy = [pa for pa in dom.assignments
                             if any(c in union for c in pa.chips)]

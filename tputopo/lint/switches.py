@@ -57,6 +57,15 @@ SWITCH_REGISTRY: tuple[tuple[str, str, str], ...] = (
     ("tputopo/sim/engine.py", "SimEngine", "FEASIBILITY_WATERMARK"),
     ("tputopo/extender/scheduler.py", "ExtenderScheduler",
      "VECTOR_GANG_PLAN"),
+    ("tputopo/extender/scheduler.py", "ExtenderScheduler",
+     "VECTOR_CAP_MEMO"),
+    ("tputopo/extender/scheduler.py", "ExtenderScheduler", "DIRTY_FOLD"),
+    ("tputopo/extender/scheduler.py", "ExtenderScheduler",
+     "BIND_ANN_TEMPLATE"),
+    ("tputopo/extender/scheduler.py", "ExtenderScheduler",
+     "MASK_GANG_PROBE"),
+    ("tputopo/extender/state.py", "ClusterState", "PA_CACHE"),
+    ("tputopo/sim/engine.py", "SimEngine", "PLAN_STATE_REUSE"),
     ("tputopo/sim/policies.py", "BaselinePolicy", "delta_fold"),
     ("tputopo/k8s/fakeapi.py", "FakeApiServer", "nocopy_writes"),
 )

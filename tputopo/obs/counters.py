@@ -42,6 +42,7 @@ COUNTERS = (
     "state_cache_hits",
     "state_delta_applied",
     "state_delta_fallbacks",
+    "state_dirty_folds",
     "state_from_informer",
     "state_full_rebuilds",
     # priority / targeted preemption (tputopo.priority; extender
@@ -73,9 +74,12 @@ COUNTERS = (
     "gang_candidate_memo_hits",
     "gang_ctx_memo_hits",
     "gang_domains_screened",
+    "gang_mask_probe_fallbacks",
+    "gang_mask_probe_hits",
     "gang_multislice_compositions_considered",
     "gang_multislice_plans",
     "gang_plan_reuse_hits",
+    "vector_cap_memo_hits",
     # bind verb
     "bind_ambiguous_recovered",
     "bind_conflicts",

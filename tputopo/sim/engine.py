@@ -31,7 +31,7 @@ from tputopo.discovery.shim import _probe_python, _to_host_probe
 from tputopo.extender.gc import AssumptionGC
 from tputopo.obs import NULL_TRACER
 from tputopo.obs import Tracer as ObsTracer
-from tputopo.extender.state import ClusterState
+from tputopo.extender.state import ClusterState, full_sync
 from tputopo.k8s import objects as ko
 from tputopo.k8s.fakeapi import FakeApiServer, NotFound
 from tputopo.priority import backfill_ok, plan_preemption
@@ -247,6 +247,22 @@ class SimEngine:
     #: plan's draw stream, and can even skip a crash-restart).  False
     #: runs every wake byte-for-byte as before, schema included.
     FEASIBILITY_WATERMARK = True
+
+    #: Kill switch for preemption planning-state reuse (XL hot-path
+    #: pass): ``_try_preempt`` plans against the policy's own derived
+    #: state (``policy.planning_state()`` — the scheduler's cached,
+    #: delta-folded view) instead of a from-scratch O(pods) cluster
+    #: re-sync per planning attempt.  The planner is read-only over the
+    #: state it is handed, and the policy view is exact for everything
+    #: the plan reads (occupancy, domains, occupancy_records) — the one
+    #: judgement that can differ is assumption-TTL expiry, which a
+    #: cached view judges at its own sync time; the preemption tests pin
+    #: the observable outcomes.  Armed only where the sole-writer view
+    #: provably exists: stands down (full re-sync, the prior behavior
+    #: byte-for-byte) under ``--replicas`` (per-shard stale views) and
+    #: ``--chaos`` (planning must not consult a possibly-faulted api
+    #: mid-fault).  False restores the per-attempt re-sync wholesale.
+    PLAN_STATE_REUSE = True
 
     def __init__(self, trace: Trace, policy_name: str, *,
                  assume_ttl_s: float = 60.0, gc_period_s: float = 30.0,
@@ -1396,10 +1412,17 @@ class SimEngine:
         tr = self.tracer.start("preempt", job=spec.name)
         with tr:
             with tr.phase("plan") as sp:
-                # tpulint: disable=hot-path-scan -- amortized: preemption planning runs only when a high tier is capacity-blocked (volume-gated in _schedule_tiered), not per wake
-                state = ClusterState(self._plan_api,
-                                     assume_ttl_s=self.assume_ttl_s,
-                                     clock=self.clock).sync()
+                if (self.PLAN_STATE_REUSE and self.replica_knobs is None
+                        and self.fault_plan is None):
+                    # Plan against the policy's own derived state — the
+                    # scheduler's cached, delta-folded view the next sort
+                    # would use anyway.  plan_preemption is read-only
+                    # over it (victim grids are rebuilt locally).
+                    state = self.policy.planning_state()
+                else:
+                    state = full_sync(self._plan_api,
+                                      assume_ttl_s=self.assume_ttl_s,
+                                      clock=self.clock)
                 plan = plan_preemption(
                     state, (spec.replicas, spec.chips), spec.priority,
                     # Indexed victim listing (O(assignments), bound in

@@ -156,6 +156,16 @@ class PlacementPolicy:
         the shard that would claim the gang."""
         return None
 
+    def planning_state(self) -> ClusterState:
+        """The derived cluster state this policy would plan its next
+        placement against — what the engine's preemption planner
+        (``SimEngine.PLAN_STATE_REUSE``) reads instead of paying a
+        from-scratch O(pods) re-sync per planning attempt.  Policies
+        with a delta-maintained cache override this to serve it; the
+        base answer is the counted-elsewhere full rebuild."""
+        return full_sync(self.api, assume_ttl_s=self.assume_ttl_s,
+                         clock=self.clock)
+
 
 class IciAwarePolicy(PlacementPolicy):
     """The framework under test: sort -> max score -> bind, per member."""
@@ -407,6 +417,15 @@ class IciAwarePolicy(PlacementPolicy):
         # Into the LIVE scheduler's Metrics: _merged_counters folds it
         # with the crash carry, so the report sees run totals either way.
         self.sched.metrics.inc(name, by)
+
+    def planning_state(self) -> ClusterState:
+        # The scheduler's cached, delta-folded derived state — the same
+        # view the next sort/bind plans against (cache miss lands in the
+        # counted state_full_rebuilds branch).  The engine only calls
+        # this where the sole-writer premise holds (PLAN_STATE_REUSE
+        # stands down under replicas/chaos), mirroring the private
+        # access _replay_decision already models.
+        return self.sched._state(allow_cache=True)
 
 
 class ReplicatedIciPolicy(IciAwarePolicy):
@@ -674,6 +693,13 @@ class BaselinePolicy(PlacementPolicy):
             state = self._cached_state = full_sync(
                 self.api, assume_ttl_s=self.assume_ttl_s, clock=self.clock)
         return state
+
+    def planning_state(self) -> ClusterState:
+        # The same cached, backlog-folded state place() plans against
+        # (fold failure lands in the counted invalidate_full_drop_*
+        # branch) — what the engine's PLAN_STATE_REUSE preemption
+        # planner reads instead of a per-attempt full re-sync.
+        return self._state()
 
     def inc_chaos(self, name: str, by: int = 1) -> None:
         self._chaos_counters[name] = self._chaos_counters.get(name, 0) + by

@@ -197,6 +197,17 @@ SCHEDULER_COUNTER_KEEP = (
     # preempt pair — a run that never planned a batch never increments
     # them, so prior report bytes stay pinned.
     "batch_plans_considered", "batch_plans_planned",
+    # XL hot-path pass: dirty-set fold bookkeeping.  Incremented once
+    # per delta fold under DIRTY_FOLD's positive arm and presence-gated
+    # by this keep filter, so every off-path report stays byte-identical
+    # to the pre-switch schema.  The pass's OTHER counters —
+    # gang_mask_probe_hits/fallbacks and vector_cap_memo_hits — stay OUT
+    # of this keep-list (same rule as gang_domains_screened): they count
+    # per-probe work inside gang planning, so their values ride how many
+    # domains the VECTOR_GANG_PLAN screen elides — inside the report
+    # they would break that switch's byte-identity contract.  All three
+    # remain registered counters on the extender's /metrics surface.
+    "state_dirty_folds",
 )
 
 
