@@ -437,6 +437,21 @@ def bench_scale(n_domains: int = 4, spec: str = "v5p:8x8x4",
     return out
 
 
+def _timeline_summary(policy_rec: dict) -> dict | None:
+    """Compact digest of a policy record's ``timeline`` block for the
+    bench fleet legs: WHEN the fleet saturated, how deep the queue got,
+    and how many points the bounded recorder actually emitted (the
+    compaction evidence — must stay <= the pinned budget).  None when
+    the replay carried no timeline (feature off)."""
+    tl = policy_rec.get("timeline")
+    if tl is None:
+        return None
+    sat = tl["saturation"]
+    return {"saturation_onset_t": sat["onset_t"],
+            "peak_queue_depth": sat["peak_queue_depth"],
+            "points": tl["points"]}
+
+
 def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0,
               fleet_nodes: int = 256, fleet_arrivals: int = 2000,
               fleet2_nodes: int = 1024, fleet2_arrivals: int = 8000) -> dict:
@@ -525,9 +540,11 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0,
                               fleet2["throughput"]["wall_s"]])
     if fleet2["throughput"]["wall_s"] < fleet["throughput"]["wall_s"]:
         fleet = fleet2
-    # Only the ici phase breakdown is consumed from the traced replay —
-    # one policy keeps the second 2000-arrival run at half cost.
-    fleet_traced = run_trace(fleet_cfg, ["ici"])
+    # Only the ici phase breakdown (and the timeline digest — recorded
+    # on the traced replay so the untraced wall figures stay the
+    # documented perf configuration) is consumed from this run — one
+    # policy keeps the second 2000-arrival run at half cost.
+    fleet_traced = run_trace(fleet_cfg, ["ici"], timeline=True)
     fp = fleet["policies"]
     # The r05 standing figures this block is diffed against — recorded
     # INLINE so BENCH_r06+ stays comparable to r05 without re-running
@@ -556,6 +573,7 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0,
         "wall_s_runs": fleet_wall_runs,
         "baseline_ref": baseline_ref,
         "phase_wall_ms": fleet_traced.get("phase_wall", {}).get("ici", {}),
+        "timeline": _timeline_summary(fleet_traced["policies"]["ici"]),
         "state_maintenance": {
             name: {k: v for k, v in fp[name]["scheduler"].items()
                    if k.startswith(("invalidate_", "state_"))}
@@ -595,7 +613,7 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0,
     # fleet leg: WHERE the XL wall goes (wake scans vs sort vs bind vs
     # fold) — the XL hot-path PRs read their bottleneck phase from here
     # before reaching for --profile.  Single policy, same as the wall legs.
-    xl_traced = run_trace(xl_cfg, ["ici"])
+    xl_traced = run_trace(xl_cfg, ["ici"], timeline=True)
     xp = xl["policies"]["ici"]
     out["fleet_xl"] = {
         "nodes": xl["trace"]["nodes"],
@@ -628,6 +646,7 @@ def bench_sim(nodes: int = 32, arrivals: int = 150, seed: int = 0,
         "watermark": xp.get("watermark"),
         "traced_wall_s": xl_traced["throughput"]["wall_s"],
         "phase_wall_ms": xl_traced.get("phase_wall", {}).get("ici", {}),
+        "timeline": _timeline_summary(xl_traced["policies"]["ici"]),
     }
     mixed = run_trace(
         TraceConfig(seed=seed, nodes=nodes, arrivals=arrivals,

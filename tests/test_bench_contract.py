@@ -171,6 +171,27 @@ def test_dryrun_multichip_is_cpu_only_and_hang_immune():
     assert "multislice" in proc.stdout
 
 
+def test_timeline_summary_digest():
+    """bench_sim's fleet/fleet_xl blocks fold a compact digest of the
+    traced replay's timeline block — saturation onset, peak queue depth,
+    emitted point count — and report None (not a crash) when the replay
+    carried no timeline (the feature-off shape)."""
+    assert bench._timeline_summary({}) is None
+    assert bench._timeline_summary({"timeline": None}) is None
+    rec = {"timeline": {
+        "points": 42,
+        "saturation": {"onset_t": 115.5, "peak_queue_depth": 22,
+                       "peak_queue_t": 332.5, "above_util_s": 459.4,
+                       "util_threshold": 0.9, "last_arrival_t": 616.7,
+                       "drain_s": 1264.7},
+    }}
+    assert bench._timeline_summary(rec) == {
+        "saturation_onset_t": 115.5,
+        "peak_queue_depth": 22,
+        "points": 42,
+    }
+
+
 def test_calibration_provenance_split_lands(monkeypatch, capsys,
                                             restore_sigterm):
     """When the hbm sub-bench reports a measurement, the calibration

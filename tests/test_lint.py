@@ -1262,6 +1262,7 @@ class TestCounterDriftChecker:
             [CounterDriftChecker()],
             *[(rel, (REPO_ROOT / rel).read_text())
               for rel in ("tputopo/obs/counters.py",
+                          "tputopo/obs/timeline.py",
                           "tputopo/sim/report.py",
                           "tputopo/defrag/controller.py",
                           "tputopo/extender/scheduler.py",

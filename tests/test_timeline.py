@@ -1,0 +1,374 @@
+"""Fleet-gauge timeline (tputopo.obs.timeline, PR 19): the bounded
+byte-deterministic trajectory recorder, its power-of-two compaction, the
+schema-v9 sim report block behind the registered ``SimEngine.TIMELINE``
+kill switch, and the live extender surface.
+
+The load-bearing contracts:
+
+- the recorder is EXACT below the point budget (stride 1, every sample
+  emitted) and bounded at any scale (a 40k-sample stream emits <= the
+  pinned budget), deterministically — same stream, same bytes;
+- ``--timeline`` off — flag absent OR switch off — keeps the report
+  byte-identical to the v8 shapes across the standing config matrix
+  (plain / defrag / chaos / preempt-mixed / replicas / batch), and the
+  on-path is pure addition (strip the timeline keys, recover the off
+  bytes);
+- sequential and ``--jobs 2`` timeline reports are byte-identical;
+- the saturation analytics are computed from the raw stream, not the
+  compacted buckets;
+- the extender's ``/debug/timeline`` + Prometheus gauges serve the
+  wall-clock recorder and stand down cleanly when disabled.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from tputopo.obs.timeline import (MARK_KINDS, POINT_BUDGET,
+                                  TimelineRecorder, TimelineSampler,
+                                  bucket_at)
+from tputopo.sim.engine import SimEngine, run_trace
+from tputopo.sim.trace import TraceConfig
+
+SMALL = dict(nodes=16, arrivals=60)
+
+
+def _canon(report: dict) -> str:
+    """The determinism projection: everything but the two documented
+    wall-clock blocks, as stable bytes."""
+    r = dict(report)
+    r.pop("throughput", None)
+    r.pop("phase_wall", None)
+    return json.dumps(r, sort_keys=True)
+
+
+def _strip_timeline(report: dict, off_schema: str) -> dict:
+    """Remove every timeline addition (schema marker, engine knob record,
+    per-policy blocks, divergence annotations) — what remains must be the
+    off-path report byte-for-byte (pure additivity)."""
+    r = json.loads(json.dumps(report))
+    r["schema"] = off_schema
+    r["engine"].pop("timeline", None)
+    for rec in r["policies"].values():
+        rec.pop("timeline", None)
+    for div in (r.get("ab", {}).get("first_divergence") or {}).values():
+        if div:
+            div.pop("timeline", None)
+    return r
+
+
+# ---- recorder unit behavior -------------------------------------------------
+
+
+def test_recorder_exact_below_budget():
+    rec = TimelineRecorder(budget=64)
+    for i in range(50):
+        rec.sample(float(i), 0.5, 0.1, 100, i % 7, 3)
+    blk = rec.block()
+    assert blk["stride"] == 1
+    assert blk["points"] == 50 == blk["samples"]
+    assert blk["t"] == [float(i) for i in range(50)]
+    assert blk["queue_depth"] == [i % 7 for i in range(50)]
+
+
+def test_recorder_bounded_at_40k_samples():
+    rec = TimelineRecorder()
+    for i in range(40_000):
+        rec.sample(float(i), (i % 100) / 100.0, 0.2, 4096 - i % 64,
+                   i % 30, i % 11)
+    blk = rec.block()
+    assert blk["samples"] == 40_000
+    assert blk["points"] <= POINT_BUDGET
+    assert blk["stride"] == 256  # 40000 / 256 -> next power of two
+    # Columnar arrays stay aligned with the point count.
+    for key in ("t", "util", "frag", "free_chips", "queue_depth",
+                "running", "wm_skips"):
+        assert len(blk[key]) == blk["points"], key
+    for kind in MARK_KINDS:
+        assert len(blk["marks"][kind]) == blk["points"]
+    # Bucket end-times stay monotone through compaction.
+    assert blk["t"] == sorted(blk["t"])
+
+
+def test_recorder_deterministic_same_stream_same_bytes():
+    def run() -> str:
+        rec = TimelineRecorder(budget=32)
+        for i in range(1000):
+            if i % 37 == 0:
+                rec.mark("conflict")
+            if i % 101 == 0:
+                rec.note_arrival(float(i))
+            rec.sample(float(i), (i % 91) / 91.0, (i % 13) / 13.0,
+                       512 - i % 128, i % 17, i % 5, i // 100)
+        return json.dumps(rec.block(), sort_keys=True)
+
+    assert run() == run()
+
+
+def test_recorder_merge_semantics():
+    # budget=2: after the third sealed point, pairs merge and stride
+    # doubles — gauges keep the max, free the min, wm the last, marks sum.
+    rec = TimelineRecorder(budget=2)
+    rec.mark("conflict")
+    rec.sample(1.0, 0.2, 0.1, 90, 4, 1, 0)
+    rec.mark("conflict")
+    rec.mark("preempt")
+    rec.sample(2.0, 0.8, 0.3, 70, 2, 2, 5)
+    blk = rec.block()
+    assert blk["points"] == 1 and blk["stride"] == 2
+    assert blk["t"] == [2.0]          # merged bucket keeps the END time
+    assert blk["util"] == [0.8]       # max
+    assert blk["frag"] == [0.3]       # max
+    assert blk["free_chips"] == [70]  # min
+    assert blk["queue_depth"] == [4]  # max
+    assert blk["wm_skips"] == [5]     # cumulative tail
+    assert blk["marks"]["conflict"] == [2]
+    assert blk["marks"]["preempt"] == [1]
+    assert blk["marks"]["defrag"] == [0]
+
+
+def test_recorder_block_is_pure_read():
+    rec = TimelineRecorder(budget=8)
+    for i in range(100):
+        rec.sample(float(i), 0.5, 0.0, 10, 0, 1)
+    a = json.dumps(rec.block(), sort_keys=True)
+    b = json.dumps(rec.block(), sort_keys=True)
+    assert a == b
+    rec.sample(100.0, 0.5, 0.0, 10, 0, 1)  # still accepts samples after
+
+
+def test_recorder_saturation_analytics_exact():
+    rec = TimelineRecorder(budget=4)  # aggressive compaction on purpose:
+    # the analytics must come from the raw stream, not the buckets.
+    rec.note_arrival(0.0)
+    rec.sample(0.0, 0.5, 0.0, 10, 1, 0)
+    rec.sample(10.0, 0.95, 0.0, 2, 3, 1)   # onset at t=10
+    rec.note_arrival(12.0)                 # last arrival
+    rec.sample(20.0, 0.95, 0.0, 2, 5, 1)   # peak queue 5 at t=20
+    rec.sample(30.0, 0.5, 0.0, 10, 1, 2)   # 10+10 s spent >= 0.9
+    rec.sample(40.0, 0.2, 0.0, 12, 0, 1)   # queue drains at t=40
+    sat = rec.block()["saturation"]
+    assert sat["onset_t"] == 10.0
+    assert sat["peak_queue_depth"] == 5
+    assert sat["peak_queue_t"] == 20.0
+    assert sat["above_util_s"] == 20.0     # step-function integral
+    assert sat["last_arrival_t"] == 12.0
+    assert sat["drain_s"] == 28.0          # 40 - 12
+    assert sat["util_threshold"] == 0.9
+
+
+def test_recorder_drain_restarts_on_new_arrival():
+    rec = TimelineRecorder()
+    rec.note_arrival(0.0)
+    rec.sample(5.0, 0.1, 0.0, 10, 0, 0)    # drained at t=5...
+    rec.note_arrival(8.0)                  # ...but a new arrival resets it
+    rec.sample(9.0, 0.1, 0.0, 10, 2, 0)
+    assert rec.block()["saturation"]["drain_s"] is None
+    rec.sample(11.0, 0.1, 0.0, 10, 0, 0)
+    assert rec.block()["saturation"]["drain_s"] == 3.0
+
+
+def test_recorder_tier_depths_presence_gated():
+    rec = TimelineRecorder()
+    rec.sample(0.0, 0.1, 0.0, 10, 1, 0)
+    assert "tiers" not in rec.block()
+    rec.sample(1.0, 0.1, 0.0, 10, 2, 0, tier_depths={"serving": 2})
+    blk = rec.block()
+    assert blk["tiers"]["serving"] == [0, 2]  # absent bucket = depth 0
+
+
+def test_bucket_at_lookup():
+    rec = TimelineRecorder()
+    for i in range(10):
+        rec.sample(float(i * 10), i / 10.0, 0.0, 100 - i, i, i)
+    blk = rec.block()
+    b = bucket_at(blk, 35.0)
+    assert b["t"] == 40.0 and b["index"] == 4   # first bucket-end >= t
+    assert bucket_at(blk, -5.0)["index"] == 0
+    assert bucket_at(blk, 1e9)["index"] == blk["points"] - 1
+    assert bucket_at({"t": []}, 1.0) is None
+
+
+# ---- sim report integration -------------------------------------------------
+
+
+def _run(timeline=False, jobs=1, **kw):
+    cfg_kw = dict(SMALL)
+    cfg_kw.update(kw.pop("cfg", {}))
+    return run_trace(TraceConfig(seed=0, **cfg_kw), ["ici", "naive"],
+                     timeline=timeline, jobs=jobs, **kw)
+
+
+def test_sim_report_gains_v9_timeline_block():
+    report = _run(timeline=True)
+    assert report["schema"] == "tputopo.sim/v9"
+    assert report["engine"]["timeline"] == {"points_budget": POINT_BUDGET}
+    for rec in report["policies"].values():
+        tl = rec["timeline"]
+        assert tl["budget"] == POINT_BUDGET
+        assert 0 < tl["points"] <= POINT_BUDGET
+        assert tl["samples"] >= tl["points"]
+        assert len(tl["t"]) == tl["points"]
+        assert set(tl["marks"]) == set(MARK_KINDS)
+        assert "saturation" in tl
+
+
+def test_sim_timeline_divergence_buckets():
+    report = _run(timeline=True)
+    (div,) = report["ab"]["first_divergence"].values()
+    assert div is not None
+    tl = div["timeline"]
+    for side in ("ici", "naive"):
+        assert set(tl[side]) == {"index", "t", "util", "frag",
+                                 "free_chips", "queue_depth", "running"}
+
+
+def test_sim_timeline_jobs2_byte_identical():
+    assert _canon(_run(timeline=True)) == _canon(_run(timeline=True, jobs=2))
+
+
+#: The standing config matrix the off-path byte-identity contract covers.
+MATRIX = {
+    "plain": {},
+    "defrag": {"defrag": {}},
+    "chaos": {"chaos": "api-flake"},
+    "preempt-mixed": {"preempt": {}, "cfg": {"workload": "mixed"}},
+    "replicas": {"replicas": {"count": 2}},
+    "batch": {"batch": {}},
+}
+
+
+@pytest.mark.parametrize("name", sorted(MATRIX))
+def test_timeline_off_path_byte_identical(name, monkeypatch):
+    off_rep = _run(**dict(MATRIX[name]))
+    off = _canon(off_rep)
+    # Flag on, switch OFF: the kill switch must make --timeline
+    # byte-invisible.
+    monkeypatch.setattr(SimEngine, "TIMELINE", False)
+    assert _canon(_run(timeline=True, **dict(MATRIX[name]))) == off
+    monkeypatch.setattr(SimEngine, "TIMELINE", True)
+    # Flag on, switch on: stripping the timeline additions must recover
+    # the off bytes exactly (pure additivity — nothing else moved).
+    on = _run(timeline=True, **dict(MATRIX[name]))
+    assert _canon(_strip_timeline(on, off_rep["schema"])) == off
+
+
+def test_timeline_mixed_trace_carries_tier_depths():
+    report = _run(timeline=True, preempt={}, cfg={"workload": "mixed"})
+    tl = report["policies"]["ici"]["timeline"]
+    assert "tiers" in tl
+    assert set(tl["tiers"]) <= {"serving", "prod", "batch"}
+    for series in tl["tiers"].values():
+        assert len(series) == tl["points"]
+
+
+# ---- extender live surface --------------------------------------------------
+
+
+def _fake_clock():
+    state = {"t": 1000.0}
+
+    def clock():
+        state["t"] += 1.0
+        return state["t"]
+
+    return clock
+
+
+def test_sampler_feeds_recorder_and_counts():
+    calls = []
+
+    class M:
+        def inc(self, name, n=1):
+            calls.append(name)
+
+    gauges = {"util": 0.5, "frag": 0.1, "free_chips": 64,
+              "queue_depth": 2, "running": 3}
+    s = TimelineSampler(lambda: dict(gauges), period_s=10.0,
+                        clock=_fake_clock(), metrics=M())
+    s.sample_once()
+    s.sample_once()
+    blk = s.block()
+    assert blk["samples"] == 2
+    assert s.last["util"] == 0.5 and s.last["t"] == 1002.0
+    assert calls == ["timeline_samples", "timeline_samples"]
+    assert s.errors == 0
+
+
+def test_sampler_survives_gauge_failures():
+    def boom():
+        raise RuntimeError("api blip")
+
+    s = TimelineSampler(boom, clock=_fake_clock())
+    s.sample_once()
+    assert s.errors == 1
+    assert s.block()["samples"] == 0  # nothing recorded, nothing raised
+
+
+@pytest.fixture
+def extender_srv():
+    from tests.cluster import build_cluster
+    from tputopo.extender import (ExtenderConfig, ExtenderHTTPServer,
+                                  ExtenderScheduler)
+
+    api, _ = build_cluster()
+    config = ExtenderConfig()
+    sched = ExtenderScheduler(api, config)
+    srv = ExtenderHTTPServer(sched, config, port=0).start()
+    yield srv
+    srv.stop()
+
+
+def _get(srv, path: str) -> str:
+    host, port = srv.address
+    with urllib.request.urlopen(f"http://{host}:{port}{path}") as r:
+        return r.read().decode()
+
+
+def test_debug_timeline_endpoint(extender_srv):
+    out = json.loads(_get(extender_srv, "/debug/timeline"))
+    assert out["enabled"] is True
+    # start() seeds one sample before the thread's first period.
+    assert out["timeline"]["samples"] >= 1
+    assert out["last"] is not None
+    assert out["errors"] == 0
+
+
+def test_metrics_exports_timeline_gauges(extender_srv):
+    text = _get(extender_srv, "/metrics")
+    for g in ("util", "frag", "free_chips", "queue_depth", "running"):
+        assert f"tputopo_extender_timeline_{g} " in text
+    assert "tputopo_extender_timeline_samples_total" in text
+
+
+def test_debug_timeline_disabled_stands_down():
+    from tests.cluster import build_cluster
+    from tputopo.extender import (ExtenderConfig, ExtenderHTTPServer,
+                                  ExtenderScheduler)
+
+    api, _ = build_cluster()
+    config = ExtenderConfig(timeline_enabled=False)
+    sched = ExtenderScheduler(api, config)
+    srv = ExtenderHTTPServer(sched, config, port=0).start()
+    try:
+        out = json.loads(_get(srv, "/debug/timeline"))
+        assert out == {"enabled": False, "timeline": None}
+        assert "tputopo_extender_timeline_util" not in _get(srv, "/metrics")
+    finally:
+        srv.stop()
+
+
+def test_config_roundtrip_with_timeline_knobs(tmp_path):
+    from tputopo.extender import ExtenderConfig
+
+    cfg = ExtenderConfig(timeline_enabled=False, timeline_period_s=2.5,
+                         timeline_points=32)
+    p = tmp_path / "cfg.json"
+    cfg.save(p)
+    back = ExtenderConfig.load(p)
+    assert back.timeline_enabled is False
+    assert back.timeline_period_s == 2.5
+    assert back.timeline_points == 32

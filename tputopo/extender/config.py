@@ -107,6 +107,16 @@ class ExtenderConfig:
     # of both, whatever these allow.
     preempt_max_moves: int = 1
     preempt_max_chips_moved: int = 64
+    # Fleet-gauge timeline (tputopo.obs.timeline): a background sampler
+    # thread records utilization / fragmentation / free-chip / pending
+    # gauges every timeline_period_s wall seconds into a bounded
+    # recorder (timeline_points caps the retained series under
+    # power-of-two compaction), served at GET /debug/timeline and as
+    # gauges in /metrics.  Off = no thread, the endpoint reports
+    # enabled: false.
+    timeline_enabled: bool = True
+    timeline_period_s: float = 10.0
+    timeline_points: int = 256
     # Per-generation LinkCostModel field overrides, e.g.
     # {"v5p": {"ici_link_gbps": 95.0, "dcn_host_gbps": 42.0}} — the explicit,
     # measured replacement for the reference's TODO weight table.

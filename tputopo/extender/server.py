@@ -34,11 +34,54 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import tputopo
 from tputopo.extender.config import ExtenderConfig
 from tputopo.extender.scheduler import BindError, ExtenderScheduler
+from tputopo.obs import TimelineSampler
+
+
+def make_timeline_sampler(scheduler: ExtenderScheduler,
+                          config: ExtenderConfig) -> TimelineSampler:
+    """The live fleet-gauge feed behind GET /debug/timeline: a
+    :class:`TimelineSampler` whose gauge closure reads the same
+    informer-mirror state the verbs serve from (zero API LISTs per
+    sample in steady state, the /state posture), reduced to the
+    recorder's gauges — utilization, free-chip-weighted fragmentation
+    (the sim report's definition), free chips, pending/bound pod
+    counts."""
+    from tputopo.defrag.planner import list_pods_nocopy
+
+    def gauges() -> dict:
+        reader = (scheduler.informer if scheduler.informer is not None
+                  and scheduler.informer.synced else None)
+        state = scheduler._state(allow_cache=True, reader=reader)
+        used = free = 0
+        frag_by_domain = []
+        for dom in state.domains.values():
+            f = dom.allocator.free_count
+            largest = dom.allocator.largest_free_box()
+            frag_by_domain.append((f, largest[0] if largest else 0))
+            free += f
+            used += dom.allocator.used_count
+        frag = (sum(f * (1.0 - box / f) for f, box in frag_by_domain
+                    if f > 0) / free) if free > 0 else 0.0
+        pods = list_pods_nocopy(reader if reader is not None
+                                else scheduler.api)
+        bound = sum(1 for p in pods
+                    if p.get("spec", {}).get("nodeName"))
+        return {"util": used / max(1, used + free), "frag": frag,
+                "free_chips": free, "queue_depth": len(pods) - bound,
+                "running": bound}
+
+    return TimelineSampler(gauges, period_s=config.timeline_period_s,
+                           budget=config.timeline_points,
+                           metrics=scheduler.metrics)
 
 
 class _Handler(BaseHTTPRequestHandler):
     scheduler: ExtenderScheduler  # set by server factory
     config: ExtenderConfig
+    #: The wall-clock timeline sampler (set by the server factory when
+    #: config.timeline_enabled; None keeps /debug/timeline answering
+    #: enabled: false and /metrics free of the timeline gauges).
+    timeline: TimelineSampler | None = None
 
     #: Per-request socket deadline (BaseHTTPRequestHandler applies it in
     #: setup()): a stalled client cannot pin a server thread forever.
@@ -144,6 +187,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._handle_pending()
             elif url.path == "/debug/batchplan":
                 self._handle_batchplan(url.query)
+            elif url.path == "/debug/timeline":
+                self._handle_timeline()
             elif url.path == "/policy":
                 self._send_json(200, self.config.policy_json())
             else:
@@ -329,6 +374,24 @@ class _Handler(BaseHTTPRequestHandler):
         plan = self.scheduler.plan_batch(window=window)
         self._send_json(200, {"dry_run": True, **plan.describe()})
 
+    def _handle_timeline(self) -> None:
+        """GET /debug/timeline — the live fleet-gauge trajectory: the
+        background sampler's bounded recorder (same block shape as the
+        sim report's ``timeline``, wall-clock timestamps instead of
+        virtual ones), the most recent raw sample, and sampler health.
+        Timeline-off deployments answer ``enabled: false``."""
+        tl = self.timeline
+        if tl is None:
+            self._send_json(200, {"enabled": False, "timeline": None})
+            return
+        self._send_json(200, {
+            "enabled": True,
+            "period_s": tl.period_s,
+            "errors": tl.errors,
+            "last": tl.last,
+            "timeline": tl.block(),
+        })
+
     def _handle_sort(self) -> None:
         req = self._read_json()
         pod = req.get("Pod")
@@ -420,6 +483,22 @@ class _Handler(BaseHTTPRequestHandler):
                 family(f"{px}_informer_{name}_total", "counter",
                        f"Informer {name.replace('_', ' ')}.")
                 lines.append(f"{px}_informer_{name}_total {v}")
+        if self.timeline is not None and self.timeline.last is not None:
+            # The timeline sampler's most recent fleet gauges (the series
+            # history lives at /debug/timeline; scrapers get the current
+            # values here).  timeline_samples_total rides the generic
+            # counter loop above.
+            last = self.timeline.last
+            for g, help_text in (
+                    ("util", "Fraction of managed chips currently bound."),
+                    ("frag", "Free-chip-weighted fragmentation of the "
+                             "fleet (1 - largest_box/free per domain)."),
+                    ("free_chips", "Unbound managed chips fleet-wide."),
+                    ("queue_depth", "Pending (unbound) managed pods."),
+                    ("running", "Bound managed pods.")):
+                gname = f"{px}_timeline_{g}"
+                family(gname, "gauge", help_text)
+                lines.append(f"{gname} {last[g]:g}")
         family(f"{px}_build_info", "gauge",
                "Build metadata; the value is always 1.")
         lines.append(
@@ -434,8 +513,15 @@ class ExtenderHTTPServer:
                  config: ExtenderConfig | None = None,
                  host: str = "127.0.0.1", port: int | None = None) -> None:
         self.config = config or scheduler.config
+        # Wall-clock fleet-gauge sampler behind GET /debug/timeline —
+        # created here so the handler, the sampler thread, and stop()
+        # share one instance; started/stopped with the server.
+        self.timeline = (make_timeline_sampler(scheduler, self.config)
+                         if getattr(self.config, "timeline_enabled", False)
+                         else None)
         handler = type("Handler", (_Handler,), {
             "scheduler": scheduler, "config": self.config,
+            "timeline": self.timeline,
             "timeout": getattr(self.config, "http_timeout_s", 30.0) or None,
         })
         self.httpd = ThreadingHTTPServer(
@@ -451,9 +537,17 @@ class ExtenderHTTPServer:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         name="tputopo-extender", daemon=True)
         self._thread.start()
+        if self.timeline is not None:
+            # Seed the recorder immediately (the thread's first sample is
+            # a full period away) so /debug/timeline and the /metrics
+            # gauges have data from the first scrape.
+            self.timeline.sample_once()
+            self.timeline.start()
         return self
 
     def stop(self) -> None:
+        if self.timeline is not None:
+            self.timeline.stop()
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:

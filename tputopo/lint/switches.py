@@ -66,6 +66,7 @@ SWITCH_REGISTRY: tuple[tuple[str, str, str], ...] = (
      "MASK_GANG_PROBE"),
     ("tputopo/extender/state.py", "ClusterState", "PA_CACHE"),
     ("tputopo/sim/engine.py", "SimEngine", "PLAN_STATE_REUSE"),
+    ("tputopo/sim/engine.py", "SimEngine", "TIMELINE"),
     ("tputopo/sim/policies.py", "BaselinePolicy", "delta_fold"),
     ("tputopo/k8s/fakeapi.py", "FakeApiServer", "nocopy_writes"),
 )

@@ -1,11 +1,18 @@
 """tputopo.obs — scheduler flight recorder.
 
 Phase-span tracing (:class:`Tracer` / :class:`Span`), per-decision
-explain records, and the no-op :class:`NullTracer` the hot path runs
-with by default.  See :mod:`tputopo.obs.tracer` for the design notes.
+explain records, the no-op :class:`NullTracer` the hot path runs with
+by default, and the bounded fleet-gauge timeline
+(:class:`TimelineRecorder` / :class:`TimelineSampler`).  See
+:mod:`tputopo.obs.tracer` and :mod:`tputopo.obs.timeline` for the
+design notes.
 """
 
+from tputopo.obs.timeline import (POINT_BUDGET, TimelineRecorder,
+                                  TimelineSampler, bucket_at)
 from tputopo.obs.tracer import (NULL_TRACER, NullTracer, Span, Trace,
                                 Tracer)
 
-__all__ = ["Tracer", "Span", "Trace", "NullTracer", "NULL_TRACER"]
+__all__ = ["Tracer", "Span", "Trace", "NullTracer", "NULL_TRACER",
+           "TimelineRecorder", "TimelineSampler", "POINT_BUDGET",
+           "bucket_at"]

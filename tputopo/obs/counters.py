@@ -108,6 +108,11 @@ COUNTERS = (
     "replica_bind_lost_race",
     "replica_conflict_ambiguous",
     "replica_stale_cache_aborts",
+    # fleet-gauge timeline (tputopo/obs/timeline.py; the extender's
+    # background TimelineSampler counts every wall-clock sample it
+    # takes — the sim recorder's virtual-time series is a deterministic
+    # report block, pinned by the v9 schema, not a Metrics counter)
+    "timeline_samples",
     # retry attribution (k8s/retry.py count_retries)
     "retry_api_timeout",
     "retry_api_unavailable",

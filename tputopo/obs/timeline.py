@@ -1,0 +1,386 @@
+"""Bounded time-series recorder: the fleet's trajectory, not its endpoint.
+
+Every headline metric in the sim report is an end-of-run aggregate, yet
+the questions the standing evaluation keeps asking — when does the fleet
+saturate, how deep does the queue get before the watermarks bite, what
+did defrag/preemption do to fragmentation *over time* — are
+time-resolved.  :class:`TimelineRecorder` makes the trajectory a
+first-class artifact with two hard properties:
+
+- **Byte-deterministic.**  Fed virtual-time samples (the sim engine
+  calls it at every event boundary), its emitted block is a pure
+  function of the sample stream: same (seed, config) → same bytes,
+  sequential or ``--jobs N``.  Nothing here reads a clock; timestamps
+  come from the caller.
+- **Fixed memory, pinned output.**  Retained points never exceed
+  :data:`POINT_BUDGET`.  When the sealed-point count reaches the
+  budget, adjacent points merge pairwise and the bucket stride doubles
+  (power-of-two adjacent-bucket compaction), so a 40k-event XL run and
+  a 500-event run both emit ≤ the same pinned point count — and a run
+  short enough to fit emits every sample exactly (stride 1, lossless).
+
+Each emitted point is a bucket of ``stride`` consecutive samples,
+summarized to preserve what downsampling usually destroys: gauges keep
+the bucket **max** (utilization, fragmentation, queue depth, running
+gangs — peaks survive), ``free_chips`` keeps the bucket **min** (troughs
+survive), cumulative series (watermark skips) keep the bucket-final
+value, and event marks (conflict requeues / executed preemptions /
+executed defrag cycles) are per-bucket counts that sum under merges.
+
+Saturation analytics are computed EXACTLY from the raw stream (O(1)
+state per sample), never from the downsampled buckets: saturation onset
+(first time utilization crosses the threshold), peak queue depth and
+its timestamp, time spent at/above the threshold (step-function
+integral, same convention as the report's time-weighted means), and the
+queue drain time after the last arrival.
+
+:class:`TimelineSampler` is the live-extender variant: a background
+thread feeds the same recorder wall-clock samples from a caller-supplied
+gauge function, serving ``GET /debug/timeline`` and the matching
+Prometheus gauges.  Wall clock is telemetry there, exactly like span
+wall-ms in :mod:`tputopo.obs.tracer` — the deterministic contract
+applies to the sim's virtual-time feed only.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from bisect import bisect_left
+
+#: The pinned point budget: every emitted timeline, whatever the run
+#: length, carries at most this many points.  One definition — the sim
+#: block, the CLI contract, and the live extender recorder all read it.
+POINT_BUDGET = 256
+
+#: Utilization at/above this fraction counts as saturated (the onset /
+#: time-above analytics below).
+SATURATION_UTIL = 0.90
+
+#: Event-mark kinds, in emission order: conflict = eviction/requeue
+#: churn (node failures, defrag evictions, preemption victims, crash
+#: recoveries — everything through the one requeue path), preempt =
+#: executed preemption plans, defrag = executed defrag cycles.
+MARK_KINDS = ("conflict", "preempt", "defrag")
+
+# Bucket slot layout (plain lists: merged thousands of times per run,
+# so no per-point object/dict overhead on the sampling hot path).
+_T, _N, _UTIL, _FRAG, _FREE, _QUEUE, _RUN, _WM = range(8)
+_MARK0 = 8          # then one slot per MARK_KINDS entry
+_TIERS = _MARK0 + len(MARK_KINDS)   # per-tier queue-depth dict or None
+
+
+def _r(x: float, nd: int = 6) -> float:
+    """Stable rounding, same convention as the sim report's ``_r``: every
+    float the block emits passes through here so byte-determinism never
+    hinges on repr noise."""
+    return round(float(x), nd)
+
+
+def _merge(a: list, b: list) -> list:
+    """Fold two ADJACENT buckets (a precedes b) into one: max gauges,
+    min free, b's cumulative tail, summed marks, per-tier max."""
+    out = [
+        b[_T], a[_N] + b[_N],
+        a[_UTIL] if a[_UTIL] > b[_UTIL] else b[_UTIL],
+        a[_FRAG] if a[_FRAG] > b[_FRAG] else b[_FRAG],
+        a[_FREE] if a[_FREE] < b[_FREE] else b[_FREE],
+        a[_QUEUE] if a[_QUEUE] > b[_QUEUE] else b[_QUEUE],
+        a[_RUN] if a[_RUN] > b[_RUN] else b[_RUN],
+        b[_WM],
+    ]
+    for k in range(len(MARK_KINDS)):
+        out.append(a[_MARK0 + k] + b[_MARK0 + k])
+    ta, tb = a[_TIERS], b[_TIERS]
+    if ta is None:
+        out.append(tb)
+    elif tb is None:
+        out.append(ta)
+    else:
+        merged = dict(ta)
+        for name, d in tb.items():
+            if merged.get(name, -1) < d:
+                merged[name] = d
+        out.append(merged)
+    return out
+
+
+class TimelineRecorder:
+    """Bounded deterministic recorder of fleet gauges over caller time.
+
+    Feed :meth:`sample` monotonically non-decreasing timestamps; call
+    :meth:`mark` / :meth:`note_arrival` between samples (they fold into
+    the next sample's bucket).  :meth:`block` emits the report dict and
+    never mutates recorder state, so it is safe to call repeatedly."""
+
+    __slots__ = ("budget", "sat_util", "stride", "samples", "_points",
+                 "_cur", "_cur_n", "_pending_marks", "_tiers_seen",
+                 "_prev_t", "_prev_util", "_onset_t", "_peak_q",
+                 "_peak_q_t", "_above_s", "_last_arrival_t", "_drain_t")
+
+    def __init__(self, budget: int = POINT_BUDGET,
+                 sat_util: float = SATURATION_UTIL) -> None:
+        self.budget = max(2, int(budget))
+        self.sat_util = float(sat_util)
+        self.stride = 1          # samples per sealed bucket (power of two)
+        self.samples = 0
+        self._points: list[list] = []
+        self._cur: list | None = None
+        self._cur_n = 0
+        self._pending_marks = [0] * len(MARK_KINDS)
+        self._tiers_seen = False
+        # Exact analytics state (raw stream, step-function convention:
+        # a gauge holds its value until the next sample).
+        self._prev_t: float | None = None
+        self._prev_util = 0.0
+        self._onset_t: float | None = None
+        self._peak_q = 0
+        self._peak_q_t: float | None = None
+        self._above_s = 0.0
+        self._last_arrival_t: float | None = None
+        self._drain_t: float | None = None
+
+    # ---- feeders -----------------------------------------------------------
+
+    def note_arrival(self, t: float) -> None:
+        """A job arrived at ``t``: the drain clock restarts (drain time
+        measures from the LAST arrival to the first empty-queue sample
+        after it)."""
+        self._last_arrival_t = t
+        self._drain_t = None
+
+    def mark(self, kind: str) -> None:
+        """Count one event of ``kind`` (a :data:`MARK_KINDS` entry)
+        against the next sample's bucket."""
+        self._pending_marks[MARK_KINDS.index(kind)] += 1
+
+    def sample(self, t: float, util: float, frag: float, free_chips: int,
+               queue_depth: int, running: int, wm_skips: int = 0,
+               tier_depths: dict[str, int] | None = None) -> None:
+        """One gauge sample at caller time ``t`` (virtual in the sim)."""
+        self.samples += 1
+        # Exact analytics, before the bucket fold.
+        if self._prev_t is not None and t > self._prev_t \
+                and self._prev_util >= self.sat_util:
+            self._above_s += t - self._prev_t
+        self._prev_t = t
+        self._prev_util = util
+        if util >= self.sat_util and self._onset_t is None:
+            self._onset_t = t
+        if queue_depth > self._peak_q:
+            self._peak_q = queue_depth
+            self._peak_q_t = t
+        if queue_depth == 0 and self._drain_t is None \
+                and self._last_arrival_t is not None:
+            self._drain_t = t
+        # Bucket fold.
+        cur = self._cur
+        if cur is None:
+            cur = self._cur = [t, 1, util, frag, free_chips, queue_depth,
+                               running, wm_skips, *self._pending_marks,
+                               dict(tier_depths) if tier_depths else None]
+        else:
+            cur[_T] = t
+            cur[_N] += 1
+            if util > cur[_UTIL]:
+                cur[_UTIL] = util
+            if frag > cur[_FRAG]:
+                cur[_FRAG] = frag
+            if free_chips < cur[_FREE]:
+                cur[_FREE] = free_chips
+            if queue_depth > cur[_QUEUE]:
+                cur[_QUEUE] = queue_depth
+            if running > cur[_RUN]:
+                cur[_RUN] = running
+            cur[_WM] = wm_skips
+            for k in range(len(MARK_KINDS)):
+                cur[_MARK0 + k] += self._pending_marks[k]
+            if tier_depths:
+                ts = cur[_TIERS]
+                if ts is None:
+                    cur[_TIERS] = dict(tier_depths)
+                else:
+                    for name, d in tier_depths.items():
+                        if ts.get(name, -1) < d:
+                            ts[name] = d
+        if tier_depths is not None:
+            self._tiers_seen = True
+        for k in range(len(MARK_KINDS)):
+            self._pending_marks[k] = 0
+        self._cur_n += 1
+        if self._cur_n >= self.stride:
+            self._points.append(cur)
+            self._cur = None
+            self._cur_n = 0
+            if len(self._points) >= self.budget:
+                self._compact()
+
+    def _compact(self) -> None:
+        """Merge adjacent point pairs in place: halves the point count,
+        doubles the stride.  An odd trailing point carries over as-is
+        (it simply represents fewer samples than its new stride)."""
+        pts = self._points
+        folded = [_merge(pts[i], pts[i + 1])
+                  for i in range(0, len(pts) - 1, 2)]
+        if len(pts) % 2:
+            folded.append(pts[-1])
+        self._points = folded
+        self.stride *= 2
+
+    # ---- emission ----------------------------------------------------------
+
+    def last_values(self) -> dict | None:
+        """The most recent raw sample's gauges (the live /metrics
+        surface), or None before the first sample."""
+        cur = self._cur if self._cur is not None else (
+            self._points[-1] if self._points else None)
+        if cur is None:
+            return None
+        return {"t": cur[_T], "util": cur[_UTIL], "frag": cur[_FRAG],
+                "free_chips": cur[_FREE], "queue_depth": cur[_QUEUE],
+                "running": cur[_RUN]}
+
+    def block(self) -> dict:
+        """The report block: columnar point arrays + exact saturation
+        analytics.  Pure read — never mutates recorder state — and
+        every float passes the stable-rounding convention."""
+        pts = list(self._points)
+        if self._cur is not None:
+            pts.append(self._cur)
+        # The partial bucket can push the count to budget+0 at most
+        # (compaction fires AT budget), but keep the pin explicit.
+        while len(pts) > self.budget:
+            folded = [_merge(pts[i], pts[i + 1])
+                      for i in range(0, len(pts) - 1, 2)]
+            if len(pts) % 2:
+                folded.append(pts[-1])
+            pts = folded
+        sat = {
+            "onset_t": (_r(self._onset_t)
+                        if self._onset_t is not None else None),
+            "peak_queue_depth": self._peak_q,
+            "peak_queue_t": (_r(self._peak_q_t)
+                             if self._peak_q_t is not None else None),
+            "above_util_s": _r(self._above_s),
+            "util_threshold": _r(self.sat_util),
+            "last_arrival_t": (_r(self._last_arrival_t)
+                               if self._last_arrival_t is not None
+                               else None),
+            "drain_s": (_r(self._drain_t - self._last_arrival_t)
+                        if self._drain_t is not None
+                        and self._last_arrival_t is not None else None),
+        }
+        out = {
+            "budget": self.budget,
+            "points": len(pts),
+            "samples": self.samples,
+            "stride": self.stride,
+            "t": [_r(p[_T]) for p in pts],
+            "util": [_r(p[_UTIL]) for p in pts],
+            "frag": [_r(p[_FRAG]) for p in pts],
+            "free_chips": [p[_FREE] for p in pts],
+            "queue_depth": [p[_QUEUE] for p in pts],
+            "running": [p[_RUN] for p in pts],
+            "wm_skips": [p[_WM] for p in pts],
+            "marks": {kind: [p[_MARK0 + k] for p in pts]
+                      for k, kind in enumerate(MARK_KINDS)},
+            "saturation": sat,
+        }
+        if self._tiers_seen:
+            # Per-tier pending depth, present only when the feed carried
+            # tiers (the mixed workload) — same presence rule as the
+            # report's tiers block.  Missing tier-in-bucket = depth 0.
+            names = sorted({name for p in pts if p[_TIERS]
+                            for name in p[_TIERS]})
+            out["tiers"] = {name: [(p[_TIERS] or {}).get(name, 0)
+                                   for p in pts] for name in names}
+        return out
+
+
+def bucket_at(block: dict, t: float) -> dict | None:
+    """The timeline bucket covering time ``t`` in an emitted ``block``
+    (buckets are keyed by their END time, so this is the first bucket
+    whose end >= t; the last bucket covers everything after).  Powers
+    the A/B first-divergence annotation: WHAT the fleet looked like at
+    the moment two policies' decision streams split."""
+    ts = block.get("t") or []
+    if not ts:
+        return None
+    i = min(bisect_left(ts, t), len(ts) - 1)
+    return {
+        "index": i,
+        "t": ts[i],
+        "util": block["util"][i],
+        "frag": block["frag"][i],
+        "free_chips": block["free_chips"][i],
+        "queue_depth": block["queue_depth"][i],
+        "running": block["running"][i],
+    }
+
+
+class TimelineSampler:
+    """The live-extender feed: a background thread samples a caller
+    gauge function every ``period_s`` wall seconds into an internal
+    :class:`TimelineRecorder`, serving ``GET /debug/timeline``.
+
+    ``sample_fn`` returns the recorder's gauge kwargs (``util``,
+    ``frag``, ``free_chips``, ``queue_depth``, ``running``; optionals
+    default).  ``clock`` stamps sample times (wall by default — live
+    timelines are telemetry, like span wall-ms; tests inject a fake).
+    ``metrics`` (an extender ``Metrics``) counts samples taken.  All
+    recorder access goes through one lock: the sampler thread writes
+    while HTTP handler threads read."""
+
+    def __init__(self, sample_fn, *, period_s: float = 10.0,
+                 budget: int = POINT_BUDGET, clock=time.time,
+                 metrics=None) -> None:
+        self.sample_fn = sample_fn
+        self.period_s = max(0.1, float(period_s))
+        self.clock = clock
+        self.metrics = metrics
+        self.recorder = TimelineRecorder(budget=budget)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.last: dict | None = None  # most recent gauges (for /metrics)
+        self.errors = 0
+
+    def sample_once(self) -> None:
+        """Take one sample now (the thread loop's body; tests call it
+        directly).  Gauge-function failures count, never propagate — a
+        flaky API read must not kill the sampler."""
+        try:
+            gauges = self.sample_fn()
+        except Exception:
+            # A failed gauge read is counted and skipped — the sampler
+            # thread must survive any API blip.
+            with self._lock:
+                self.errors += 1
+            return
+        t = self.clock()
+        with self._lock:
+            self.recorder.sample(t, **gauges)
+            self.last = {"t": t, **gauges}
+        if self.metrics is not None:
+            self.metrics.inc("timeline_samples")
+
+    def block(self) -> dict:
+        with self._lock:
+            return self.recorder.block()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period_s):
+            self.sample_once()
+
+    def start(self) -> "TimelineSampler":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="tputopo-timeline",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -2,12 +2,17 @@
 
 The scheduler's verbs (``sort``/``bind``) are multi-stage pipelines —
 state build/fold, generation gate, score loop, gang composition search,
-CAS patch, delta publish — and until this module the only observable
-output was flat counters and one p50/p95 gauge per verb.  A
-:class:`Tracer` records, per verb invocation, a tree of timed phase
-spans with deterministic counters plus an optional **explain record**
-(the per-node score breakdown and structured rejection reasons the
-verbs attach), into a bounded ring buffer served by ``/debug/traces``.
+CAS patch, delta publish.  This module answers the *per-decision*
+questions about them: a :class:`Tracer` records, per verb invocation, a
+tree of timed phase spans with deterministic counters plus an optional
+**explain record** (the per-node score breakdown and structured
+rejection reasons the verbs attach), into a bounded ring buffer served
+by ``/debug/traces``.  It is one of three observability layers in
+:mod:`tputopo.obs`: flat counters and p50/p95 gauges
+(:mod:`tputopo.obs.counters` names the registry), these traces, and the
+bounded fleet-gauge timeline (:mod:`tputopo.obs.timeline`) that records
+the *trajectory* — utilization, fragmentation, queue depth over time —
+which spans and counters cannot reconstruct after the fact.
 
 Two design constraints shape the API:
 

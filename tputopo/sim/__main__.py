@@ -171,6 +171,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "crash-storm); adds the per-policy chaos block + "
                         "invariant audit (schema tputopo.sim/v4), still "
                         "byte-deterministic per (seed, profile)")
+    p.add_argument("--timeline", action="store_true",
+                   help="record the bounded fleet-gauge timeline "
+                        "(tputopo.obs.timeline): per-bucket utilization/"
+                        "fragmentation/free-chip/queue gauges sampled at "
+                        "every event boundary, compacted to a pinned "
+                        "point budget, plus exact saturation analytics "
+                        "(onset, peak queue, time above 90% util, drain); "
+                        "adds the per-policy timeline block (schema "
+                        "tputopo.sim/v9).  Off is byte-identical to the "
+                        "flag being absent")
     p.add_argument("--out", default=None, help="also write the report here")
     p.add_argument("--no-trace", action="store_true",
                    help="disable the flight recorder (NullTracer hot "
@@ -295,6 +305,7 @@ def main(argv: list[str] | None = None) -> int:
                                    preempt=preempt,
                                    replicas=replicas,
                                    batch=batch,
+                                   timeline=args.timeline,
                                    return_states=True)
         prof.disable()
         buf = io.StringIO()
@@ -311,6 +322,7 @@ def main(argv: list[str] | None = None) -> int:
                                    preempt=preempt,
                                    replicas=replicas,
                                    batch=batch,
+                                   timeline=args.timeline,
                                    return_states=True)
     # tpulint: disable=determinism -- CLI wall timing feeds the throughput block only
     wall_s = time.perf_counter() - t0

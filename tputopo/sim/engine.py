@@ -29,7 +29,7 @@ from tputopo.deviceplugin.reporter import node_object_for_probe
 from tputopo.extender.replicas import DEFAULT_REPLICAS
 from tputopo.discovery.shim import _probe_python, _to_host_probe
 from tputopo.extender.gc import AssumptionGC
-from tputopo.obs import NULL_TRACER
+from tputopo.obs import NULL_TRACER, POINT_BUDGET, TimelineRecorder, bucket_at
 from tputopo.obs import Tracer as ObsTracer
 from tputopo.extender.state import ClusterState, full_sync
 from tputopo.k8s import objects as ko
@@ -264,6 +264,16 @@ class SimEngine:
     #: mid-fault).  False restores the per-attempt re-sync wholesale.
     PLAN_STATE_REUSE = True
 
+    #: Kill switch for the fleet-gauge timeline (tputopo.obs.timeline):
+    #: with the ``timeline`` ctor flag set (CLI ``--timeline``) AND this
+    #: True, every occupancy sample also feeds the bounded
+    #: byte-deterministic trajectory recorder, and the report gains the
+    #: per-policy ``timeline`` block (schema v9).  False — or the flag
+    #: absent — records nothing and keeps every prior schema's report
+    #: bytes pinned.  Pure observer: the recorder never feeds back into
+    #: scheduling, so both directions place identically.
+    TIMELINE = True
+
     def __init__(self, trace: Trace, policy_name: str, *,
                  assume_ttl_s: float = 60.0, gc_period_s: float = 30.0,
                  max_backfill_failures: int = 8,
@@ -273,6 +283,7 @@ class SimEngine:
                  preempt: dict | None = None,
                  replicas: dict | None = None,
                  batch: dict | None = None,
+                 timeline: bool = False,
                  audit_every: int = 0) -> None:
         self.trace = trace
         self.cfg = trace.config
@@ -355,6 +366,12 @@ class SimEngine:
         self._ideal_gbps: dict[tuple[str, int], float] = {}
 
         self.metrics = MetricsCollector(self.cfg.total_chips)
+        # Fleet-gauge timeline (tputopo.obs.timeline), opt-in behind the
+        # registered TIMELINE kill switch: the recorder doubles as the
+        # armed flag — None (flag or switch off) records nothing and its
+        # absent report block pins every prior schema's bytes.
+        self.timeline = (TimelineRecorder()
+                         if (timeline and self.TIMELINE) else None)
         self.queue: list[_JobRun] = []
         self.jobs: dict[str, _JobRun] = {}
         self.ledger: dict[tuple[str, tuple], str] = {}  # (slice, chip) -> job
@@ -655,6 +672,12 @@ class SimEngine:
             # absence pins the v2–v7 report bytes).
             watermark=(dict(self.watermark_stats)
                        if self.watermark_stats is not None else None),
+            # Fleet-gauge timeline block (None when --timeline or the
+            # TIMELINE switch is off — its absence pins the v2–v8 report
+            # bytes).  Emitted here so it ships across the --jobs N
+            # process boundary as a plain dict.
+            timeline=(self.timeline.block()
+                      if self.timeline is not None else None),
         )
 
     def run_events(self) -> None:
@@ -760,6 +783,8 @@ class SimEngine:
 
     def _on_arrival(self, spec: JobSpec) -> None:
         self.metrics.counts["arrived"] += 1
+        if self.timeline is not None:
+            self.timeline.note_arrival(self.clock.t)
         if self.tier_stats is not None:
             self._tier(spec)["arrived"] += 1
         run = _JobRun(spec, self.clock.t)
@@ -903,6 +928,8 @@ class SimEngine:
             self._push(self.clock.t + self.defrag_period_s,
                        self._DEFRAG, None)
         if rec["action"] == "executed":
+            if self.timeline is not None:
+                self.timeline.mark("defrag")
             self._sample_occupancy()
             # The restored box (and the requeued victims) may place
             # queued work right now, not at the next event.
@@ -930,6 +957,8 @@ class SimEngine:
         derived-state impact, so no policy invalidation is needed for
         them (deletions were folded by _delete_job_pods)."""
         self.requeue_reasons[reason] = self.requeue_reasons.get(reason, 0) + 1
+        if self.timeline is not None:
+            self.timeline.mark("conflict")
         self.metrics.preempt["pods_evicted"] += run.spec.replicas
         self.metrics.preempt["jobs_requeued"] += 1
         self.metrics.counts["evicted_requeues"] += 1
@@ -1449,6 +1478,8 @@ class SimEngine:
             self._pcount("chips_freed", plan.chips_moved)
             self.capacity_epoch += 1
             self._wm_invalidate()
+            if self.timeline is not None:
+                self.timeline.mark("preempt")
             self._sample_occupancy()
             explain = {
                 "verb": "preempt",
@@ -1645,7 +1676,27 @@ class SimEngine:
                                      largest[0] if largest else 0)
         self._frag_dirty.clear()
         frag = [self._frag_cache[sid] for sid in sorted(self._frag_cache)]
-        self.metrics.occupancy(self.clock.t, self.placed_chips, frag)
+        util, fval, free_total = self.metrics.occupancy(
+            self.clock.t, self.placed_chips, frag)
+        if self.timeline is not None:
+            # The same event-boundary sample feeds the timeline — gauges
+            # reused from the occupancy computation above, so the
+            # recorder costs O(1) extra per sample.  Per-tier pending
+            # depth only on tiered traces (the mixed workload; O(queue)
+            # there, never on the untiered fleet/XL standing traces).
+            qd = len(self.queue)
+            tiers = None
+            if self.tier_stats is not None:
+                tiers = {}
+                for r in self.queue:
+                    tname = ko.tier_name(r.spec.priority)
+                    tiers[tname] = tiers.get(tname, 0) + 1
+            self.timeline.sample(
+                self.clock.t, util, fval, free_total, qd,
+                len(self.jobs) - qd,
+                (self.watermark_stats["skips"]
+                 if self.watermark_stats is not None else 0),
+                tiers)
 
 
 class RunState:
@@ -1655,14 +1706,14 @@ class RunState:
                  "placed_chips", "frag", "counters", "events_processed",
                  "phases", "phase_wall_ms", "decision_log", "defrag",
                  "chaos", "tiers", "preempt", "replicas", "batch",
-                 "watermark")
+                 "watermark", "timeline")
 
     def __init__(self, *, policy_name, horizon_s, end_t, metrics,
                  placed_chips, frag, counters, events_processed,
                  phases=None, phase_wall_ms=None,
                  decision_log=None, defrag=None, chaos=None,
                  tiers=None, preempt=None, replicas=None,
-                 batch=None, watermark=None) -> None:
+                 batch=None, watermark=None, timeline=None) -> None:
         self.policy_name = policy_name
         self.horizon_s = horizon_s
         self.end_t = end_t
@@ -1681,6 +1732,7 @@ class RunState:
         self.replicas = replicas
         self.batch = batch
         self.watermark = watermark
+        self.timeline = timeline
 
 
 def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
@@ -1733,6 +1785,14 @@ def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
         # was armed (switch on, unreplicated, fault-free); its absence
         # pins every prior schema's report bytes.
         out["watermark"] = dict(sorted(rs.watermark.items()))
+    if rs.timeline is not None:
+        # Bounded virtual-time trajectory + saturation analytics (schema
+        # tputopo.sim/v9, tputopo.obs.timeline) — present only under
+        # --timeline with the TIMELINE switch on; its absence pins every
+        # prior schema's report bytes.  Already emitted/rounded by the
+        # recorder: a pure function of the virtual-time sample stream,
+        # part of the byte-determinism contract.
+        out["timeline"] = rs.timeline
     return out
 
 
@@ -1749,17 +1809,33 @@ def first_divergence(ref: RunState, other: RunState) -> dict | None:
                 tuple((m["pod"], m["node"], m["slice"],
                        tuple(map(tuple, m["chips"]))) for m in e["members"]))
 
+    def attach_timeline(out: dict, t: float) -> dict:
+        # When both runs recorded timelines, annotate the divergence with
+        # each side's bucket at that virtual time: WHAT the fleet looked
+        # like (utilization, fragmentation, queue depth) at the moment
+        # the decision streams split — not just which decision differed.
+        # Timeline-off runs add nothing, pinning the prior report bytes.
+        if ref.timeline is not None and other.timeline is not None:
+            out["timeline"] = {
+                ref.policy_name: bucket_at(ref.timeline, t),
+                other.policy_name: bucket_at(other.timeline, t)}
+        return out
+
     for i, (ea, eb) in enumerate(zip(ref.decision_log, other.decision_log)):
         if key(ea) != key(eb):
-            return {"index": i, ref.policy_name: ea, other.policy_name: eb}
+            return attach_timeline(
+                {"index": i, ref.policy_name: ea, other.policy_name: eb},
+                ea["t"])
     la, lb = len(ref.decision_log), len(other.decision_log)
     if la != lb:
         # Identical prefix, different lengths: the divergence is the first
         # decision only one policy made (the other side reports null).
         i = min(la, lb)
-        return {"index": i,
-                ref.policy_name: ref.decision_log[i] if i < la else None,
-                other.policy_name: other.decision_log[i] if i < lb else None}
+        return attach_timeline(
+            {"index": i,
+             ref.policy_name: ref.decision_log[i] if i < la else None,
+             other.policy_name: other.decision_log[i] if i < lb else None},
+            (ref.decision_log[i] if i < la else other.decision_log[i])["t"])
     return None
 
 
@@ -1769,12 +1845,12 @@ def _run_policy_worker(args) -> RunState:
     pinned by tests) so nothing heavyweight crosses the process boundary
     in either direction."""
     (cfg, name, assume_ttl_s, gc_period_s, flight_trace, defrag, chaos,
-     preempt, replicas, batch) = args
+     preempt, replicas, batch, timeline) = args
     engine = SimEngine(generate_trace(cfg), name,
                        assume_ttl_s=assume_ttl_s, gc_period_s=gc_period_s,
                        flight_trace=flight_trace, defrag=defrag,
                        chaos=chaos, preempt=preempt, replicas=replicas,
-                       batch=batch)
+                       batch=batch, timeline=timeline)
     engine.run_events()
     return engine.run_state()
 
@@ -1787,6 +1863,7 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
               preempt: dict | None = None,
               replicas: dict | None = None,
               batch: dict | None = None,
+              timeline: bool = False,
               return_states: bool = False):
     """Replay one deterministic trace under each policy and build the
     A/B report.  Every policy sees the identical event stream.
@@ -1846,7 +1923,18 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
     whole pending queue jointly before attempting placements.  Each
     policy record gains a deterministic ``batch`` block, the knobs land
     under ``engine.batch``, and the schema becomes ``tputopo.sim/v7``;
-    None — or the switch off — keeps every prior shape byte-for-byte."""
+    None — or the switch off — keeps every prior shape byte-for-byte.
+
+    ``timeline`` (CLI ``--timeline``, behind the registered
+    ``SimEngine.TIMELINE`` kill switch) arms the bounded fleet-gauge
+    trajectory recorder (tputopo.obs.timeline) in every engine: each
+    policy record gains the deterministic ``timeline`` block (≤
+    POINT_BUDGET points under power-of-two compaction, plus the exact
+    saturation analytics), the ab ``first_divergence`` entries gain each
+    side's timeline bucket at the divergence point, the point budget is
+    recorded under ``engine.timeline``, and the schema becomes
+    ``tputopo.sim/v9``.  False — or the switch off — keeps every prior
+    shape byte-for-byte."""
     # tpulint: disable=determinism -- throughput.wall_s is the documented wall-clock exception
     t0 = time.perf_counter()
     defrag_knobs = ({**DEFAULT_DEFRAG, **defrag}
@@ -1861,9 +1949,10 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
     batch_knobs = ({**DEFAULT_BATCH, **batch}
                    if (batch is not None and SimEngine.BATCH_ADMISSION)
                    else None)
+    timeline_on = bool(timeline) and SimEngine.TIMELINE
     work = [(cfg, name, assume_ttl_s, gc_period_s, flight_trace,
              defrag_knobs, chaos, preempt_knobs, replica_knobs,
-             batch_knobs)
+             batch_knobs, timeline_on)
             for name in policy_names]
     if jobs > 1 and len(work) > 1:
         import multiprocessing as mp
@@ -1921,6 +2010,11 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
         # distinguishable; absent on batch-off runs so prior schema
         # bytes stay pinned.
         engine_params["batch"] = dict(sorted(batch_knobs.items()))
+    if timeline_on:
+        # The pinned point budget — the one knob that shapes timeline
+        # content; recorded like the other feature knobs and absent on
+        # timeline-off runs so prior schema bytes stay pinned.
+        engine_params["timeline"] = {"points_budget": POINT_BUDGET}
     report = build_report(
         cfg.describe(), horizon, policies,
         engine_params=engine_params,
@@ -1937,6 +2031,10 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
         # that makes the per-policy `watermark` block appear.
         schema_watermark=(SimEngine.FEASIBILITY_WATERMARK
                           and replica_knobs is None and chaos is None),
+        # v9 exactly when the engines armed the timeline recorder
+        # (--timeline AND the TIMELINE switch) — the same condition that
+        # makes the per-policy `timeline` block appear.
+        schema_timeline=timeline_on,
         throughput={
             "events": events,  # deterministic
             "wall_s": round(wall_s, 3),

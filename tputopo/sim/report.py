@@ -53,7 +53,13 @@ Schema (``tputopo.sim/v2``)::
                                                     # v6 (--replicas > 1)
           "batch": {"batches", "gangs_per_batch": {"p50", "p95", "mean",
                     "max"}, "regret_reorders", "window_refinements",
-                    "sorts_avoided"}               # v7 (--batch-admission)
+                    "sorts_avoided"},              # v7 (--batch-admission)
+          "watermark": {"recorded", "skips", "crossed", "invalidated"},
+                                                   # v8 (watermark armed)
+          "timeline": {"budget", "points", "samples", "stride",
+                    "t", "util", "frag", "free_chips", "queue_depth",
+                    "running", "wm_skips", "marks", "saturation",
+                    "tiers"?}                      # v9 (--timeline)
         }, ...
       },
       "ab": {"policies": [...], "deltas": {<metric>: a_minus_b},
@@ -134,6 +140,17 @@ SCHEMA_BATCH = "tputopo.sim/v7"
 #: deterministic virtual-time fact — part of the byte-determinism
 #: contract.
 SCHEMA_WATERMARK = "tputopo.sim/v8"
+#: v9 = the above plus the per-policy ``timeline`` block
+#: (tputopo.obs.timeline): the bounded byte-deterministic virtual-time
+#: trajectory — per-bucket utilization/fragmentation/free-chip/queue
+#: gauges under power-of-two adjacent-bucket compaction, event marks,
+#: and the exact saturation analytics (onset, peak queue, time above
+#: threshold, drain) — emitted ONLY when ``--timeline`` requested it AND
+#: the SimEngine.TIMELINE switch is on.  Timeline-off runs keep emitting
+#: the v2..v8 shapes byte-for-byte.  All v9 content is a pure function
+#: of the virtual-time sample stream — part of the byte-determinism
+#: contract.
+SCHEMA_TIMELINE = "tputopo.sim/v9"
 
 #: The pinned schema-key manifest: which top-level report keys and
 #: per-policy record keys each schema version emits, and which of them
@@ -163,6 +180,7 @@ SCHEMA_KEY_MANIFEST = {
     "tputopo.sim/v6": {"policy_gated": ("replicas",)},
     "tputopo.sim/v7": {"policy_gated": ("batch",)},
     "tputopo.sim/v8": {"policy_gated": ("watermark",)},
+    "tputopo.sim/v9": {"policy_gated": ("timeline",)},
 }
 
 #: The extender counters the report's per-policy ``scheduler`` block
@@ -275,11 +293,15 @@ class MetricsCollector:
             self.contiguous += 1
 
     def occupancy(self, t: float, used_chips: int,
-                  frag_by_domain: list[tuple[int, int]]) -> None:
+                  frag_by_domain: list[tuple[int, int]]
+                  ) -> tuple[float, float, int]:
         """``frag_by_domain``: (free_chips, largest_free_box_chips) per
         domain.  Fragmentation of a domain = 1 - largest_box/free (0 when
-        empty-or-full); cluster value = free-chip-weighted mean."""
-        self.utilization.sample(t, used_chips / max(1, self.total_chips))
+        empty-or-full); cluster value = free-chip-weighted mean.  Returns
+        the computed ``(util, frag, free_total)`` so the timeline
+        recorder can reuse the sample without recomputing it."""
+        util = used_chips / max(1, self.total_chips)
+        self.utilization.sample(t, util)
         free_total = sum(f for f, _ in frag_by_domain)
         if free_total > 0:
             frag = sum(f * (1.0 - box / f) for f, box in frag_by_domain
@@ -287,6 +309,7 @@ class MetricsCollector:
         else:
             frag = 0.0
         self.fragmentation.sample(t, frag)
+        return util, frag, free_total
 
     # ---- report ------------------------------------------------------------
 
@@ -429,9 +452,11 @@ def build_report(trace_desc: dict, horizon_s: float,
                  schema_priority: bool = False,
                  schema_replicas: bool = False,
                  schema_batch: bool = False,
-                 schema_watermark: bool = False) -> dict:
+                 schema_watermark: bool = False,
+                 schema_timeline: bool = False) -> dict:
     out = {
-        "schema": (SCHEMA_WATERMARK if schema_watermark
+        "schema": (SCHEMA_TIMELINE if schema_timeline
+                   else SCHEMA_WATERMARK if schema_watermark
                    else SCHEMA_BATCH if schema_batch
                    else SCHEMA_REPLICAS if schema_replicas
                    else SCHEMA_PRIORITY if schema_priority
