@@ -731,6 +731,73 @@ def bench_batch(nodes: int = 32, arrivals: int = 150, seed: int = 0,
     return out
 
 
+def bench_elastic(nodes: int = 64, arrivals: int = 500, seed: int = 0) -> dict:
+    """Checkpoint-aware disruption A/B (tputopo.elastic) — the
+    ``elastic`` block: the checkpointed trace under preemption pressure,
+    replayed evict-everything (the PR-9 baseline: every disruption
+    destroys the victim's whole run) vs ``--elastic`` (checkpoint-
+    charged victim ranking, shrink-before-evict, restore-and-resume).
+
+    Refuses to publish (SystemExit) when the elastic replay fails any
+    of the three gates: lost virtual work must drop by >= 50%, serving
+    SLO attainment must not regress, and the total chip-seconds SPEND
+    (utilization x horizon) must not grow.  The spend gate is the
+    honest utilization comparison: the baseline's RAW time-weighted
+    utilization reads higher because redoing destroyed work counts as
+    occupancy — both legs complete the same jobs, so the leg that
+    spends fewer chip-seconds doing it wins.  Raw utilization is
+    recorded for both legs anyway."""
+    from tputopo.sim.engine import run_trace
+    from tputopo.sim.trace import TraceConfig
+
+    cfg = TraceConfig(seed=seed, nodes=nodes, arrivals=arrivals,
+                      workload="checkpointed")
+    legs = {}
+    for tag, kw in (("evict", {}), ("elastic", {"elastic": True})):
+        rep = run_trace(cfg, ["ici"], flight_trace=False, preempt={}, **kw)
+        p = rep["policies"]["ici"]
+        util = p["chip_utilization"]["time_weighted_mean"]
+        horizon = rep["virtual_horizon_s"]
+        legs[tag] = {
+            "lost_virtual_s": round(sum(
+                t["preemption_disruption"]["lost_virtual_s"]
+                for t in p["tiers"].values()), 6),
+            "serving_slo_attainment":
+                p["tiers"]["serving"]["slo"]["attainment"],
+            "utilization_raw": util,
+            "virtual_horizon_s": horizon,
+            "chip_seconds_spend": round(util * horizon, 3),
+            "scheduled": p["jobs"]["scheduled"],
+            "queue_wait_p95_s": p["queue_wait_s"]["p95"],
+        }
+        if tag == "elastic":
+            legs[tag]["disruption"] = p["disruption"]
+    off, on = legs["evict"], legs["elastic"]
+    if off["lost_virtual_s"] <= 0.0:
+        raise SystemExit("bench elastic: baseline replay destroyed zero "
+                         "virtual work — the A/B is vacuous")
+    reduction = 1.0 - on["lost_virtual_s"] / off["lost_virtual_s"]
+    if reduction < 0.5:
+        raise SystemExit(f"bench elastic: lost-virtual-work reduction "
+                         f"{reduction:.1%} is below the 50% gate")
+    if on["serving_slo_attainment"] < off["serving_slo_attainment"]:
+        raise SystemExit("bench elastic: serving SLO attainment regressed "
+                         f"({off['serving_slo_attainment']} -> "
+                         f"{on['serving_slo_attainment']})")
+    if on["chip_seconds_spend"] > off["chip_seconds_spend"] * 1.001:
+        raise SystemExit("bench elastic: chip-seconds spend grew "
+                         f"({off['chip_seconds_spend']} -> "
+                         f"{on['chip_seconds_spend']})")
+    return {
+        "evict_everything": off,
+        "elastic": on,
+        "lost_virtual_reduction": round(reduction, 4),
+        "gates": {"lost_reduction_min": 0.5,
+                  "serving_slo_no_worse": True,
+                  "chip_seconds_spend_no_worse": True},
+    }
+
+
 def bench_shards(nodes: int = 256, arrivals: int = 2000, seed: int = 0,
                  counts: tuple = (1, 2, 4, 8),
                  http_pods: int = 600) -> dict:
@@ -1990,6 +2057,11 @@ def main() -> None:
     # Joint batch admission: FIFO-vs-batch A/B on the mixed and fleet
     # traces (pure-Python correctness traces — strict).
     extras["batch"] = isolated("batch", bench_batch, strict=True)
+    # Elastic disruption: evict-everything vs --elastic on the
+    # checkpointed trace (pure-Python correctness A/B — strict; the
+    # block's own gates SystemExit on a lost-work / SLO / spend
+    # regression).
+    extras["elastic"] = isolated("elastic", bench_elastic, strict=True)
     # Replicated control plane: the sim replica sweep (quality vs the
     # single-replica stream) + the real-process HTTP load leg.  Not
     # strict: the http leg spawns server subprocesses, and a sandboxed

@@ -36,6 +36,7 @@ def _stub_headline(monkeypatch):
     monkeypatch.setattr(bench, "bench_ab_gain", lambda: 3.0)
     monkeypatch.setattr(bench, "bench_sim", lambda: {"stub": True})
     monkeypatch.setattr(bench, "bench_batch", lambda: {"stub": True})
+    monkeypatch.setattr(bench, "bench_elastic", lambda: {"stub": True})
     monkeypatch.setattr(bench, "bench_shards", lambda: {"stub": True})
 
 

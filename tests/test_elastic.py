@@ -1,0 +1,407 @@
+"""tputopo.elastic (PR 20): the checkpoint cost model, the migration
+verb, and elastic gang resize.
+
+The load-bearing contracts:
+
+- :func:`checkpoint_split` is the ONE arithmetic every disruption
+  surface prices with — the sim tier tally, the defrag/preempt victim
+  ranking, and the extender dry-runs cannot drift;
+- ``--elastic`` off — flag absent OR ``SimEngine.ELASTIC`` off — keeps
+  the report byte-identical to the v9 shapes across the standing config
+  matrix (plain / defrag / chaos / preempt-mixed / replicas / batch),
+  sequential and ``--jobs 2`` alike;
+- the on-path is byte-deterministic: same checkpointed config, same
+  bytes, ``--jobs 2`` included;
+- shrink beats evict: an elastic gang under serving-tier pressure loses
+  a member, not its life, and grows back when the pressure drains;
+- migration beats fire-and-forget requeue on checkpointed traces: less
+  virtual work destroyed, classified aborts when the destination races
+  away;
+- the extender serves ``GET /debug/migrate`` dry-runs and prices
+  ``/debug/preempt`` victims with the same checkpoint arithmetic the
+  sim report charges (the cost-unification bugfix).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.cluster import build_cluster
+from tputopo.elastic import checkpoint_split, plan_destination, victim_costs
+from tputopo.extender.state import ClusterState
+from tputopo.k8s import objects as ko
+from tputopo.sim.engine import SimEngine, finalize_run_state, run_trace
+from tputopo.sim.trace import JobSpec, Trace, TraceConfig
+
+SMALL = dict(nodes=16, arrivals=60)
+
+CLOCK = lambda: 1000.0  # noqa: E731 — staged occupancy stamps this time
+
+
+def _canon(report: dict) -> str:
+    """The determinism projection: everything but the two documented
+    wall-clock blocks, as stable bytes."""
+    r = dict(report)
+    r.pop("throughput", None)
+    r.pop("phase_wall", None)
+    return json.dumps(r, sort_keys=True)
+
+
+def _run(elastic=False, jobs=1, **kw):
+    cfg_kw = dict(SMALL)
+    cfg_kw.update(kw.pop("cfg", {}))
+    return run_trace(TraceConfig(seed=0, **cfg_kw), ["ici", "naive"],
+                     elastic=elastic, jobs=jobs, **kw)
+
+
+# ---- checkpoint cost model (tputopo.elastic.ckpt) ---------------------------
+
+
+def test_checkpoint_split_no_checkpoint_loses_everything():
+    # None / 0 period: the whole segment AND any carried progress are
+    # lost — the pre-elastic accounting, exactly.
+    lost, preserved, charged = checkpoint_split(100.0, 1.0, 30.0, None, 5.0)
+    assert (lost, preserved, charged) == (130.0, 0.0, 130.0)
+    assert checkpoint_split(100.0, 1.0, 0.0, 0.0, None) == (100.0, 0.0, 100.0)
+
+
+def test_checkpoint_split_charges_since_last_checkpoint():
+    # 100 s run, 30 s period: checkpoints at 30/60/90 — 10 s destroyed,
+    # 90 s (plus carried progress) preserved, restore billed on top.
+    lost, preserved, charged = checkpoint_split(100.0, 1.0, 20.0, 30.0, 5.0)
+    assert lost == pytest.approx(10.0)
+    assert preserved == pytest.approx(110.0)
+    assert charged == pytest.approx(15.0)
+    # Restore defaults to free when undeclared.
+    assert checkpoint_split(100.0, 1.0, 0.0, 30.0, None)[2] == pytest.approx(10.0)
+
+
+def test_checkpoint_split_rate_scales_virtual_work():
+    # A gang shrunk to half width advances at rate 0.5: the same wall
+    # segment destroys/preserves half the virtual work.
+    lost, preserved, charged = checkpoint_split(100.0, 0.5, 0.0, 30.0, 5.0)
+    assert lost == pytest.approx(5.0)
+    assert preserved == pytest.approx(45.0)
+    assert charged == pytest.approx(10.0)
+    # Negative wall segments clamp (clock skew must never mint work).
+    assert checkpoint_split(-3.0, 1.0, 0.0, 30.0, 5.0)[0] == 0.0
+
+
+def _pod(name, chips, node, *, gang=None, assume=None, period=None,
+         restore=None):
+    anns = {}
+    if gang is not None:
+        anns[ko.ANN_GANG_ID] = gang
+    if assume is not None:
+        anns[ko.ANN_ASSUME_TIME] = str(assume)
+    if period is not None:
+        anns[ko.ANN_CKPT_PERIOD] = str(period)
+    if restore is not None:
+        anns[ko.ANN_RESTORE_COST] = str(restore)
+    return ko.make_pod(name, chips=chips, annotations=anns, node_name=node)
+
+
+def test_victim_costs_keys_and_gang_max_assume_time():
+    pods = [
+        _pod("g-0", 4, "node-0", gang="g", assume=100.0, period=30.0,
+             restore=5.0),
+        _pod("g-1", 4, "node-1", gang="g", assume=160.0, period=30.0,
+             restore=5.0),
+        _pod("lone", 2, "node-2", assume=100.0),
+        _pod("pending", 4, None, gang="g"),  # unbound: never a victim
+    ]
+    out = victim_costs(pods, now=200.0)
+    assert set(out) == {"default/g", "default/lone"}
+    # The gang runs from its LAST member's bind (t=160): 40 s run, one
+    # 30 s checkpoint — 10 s lost + 5 s restore; destroyed volume is the
+    # lost fraction of its 8 chips.
+    charged, destroyed = out["default/g"]
+    assert charged == pytest.approx(15.0)
+    assert destroyed == pytest.approx(8 * 10.0 / 40.0)
+    # No checkpoint annotations: whole runtime, full volume — the
+    # pre-elastic price.
+    assert out["default/lone"] == (pytest.approx(100.0), 2.0)
+
+
+def test_plan_destination_screens_per_host_boxes():
+    api, _ = build_cluster()
+    state = ClusterState(api, clock=CLOCK).sync()
+    (sid, dom), = state.domains.items()
+    domains = [(sid, dom.allocator, dom.node_masks)]
+    # Empty 4-host domain: 2x4 fits, 5x4 needs more hosts than exist.
+    assert plan_destination(2, 4, domains) == sid
+    assert plan_destination(5, 4, domains) is None
+    # Occupy two hosts: 2 feasible hosts remain, 3 do not.
+    nodes = sorted(dom.node_masks)
+    for n in nodes[:2]:
+        for c in dom.chips_by_node[n]:
+            dom.allocator.mark_used([c])
+    assert plan_destination(2, 4, domains) == sid
+    assert plan_destination(3, 4, domains) is None
+    assert plan_destination(0, 4, domains) is None
+
+
+# ---- shrink / grow lifecycle ------------------------------------------------
+
+
+def _elastic_pressure_trace() -> Trace:
+    """One elastic 4x4 batch gang fills the 4-host domain; a serving
+    quad arrives at t=50 with nowhere to go — shrink is the only
+    eviction-free answer — and completes at t=110, opening the door to
+    grow back."""
+    cfg = TraceConfig(seed=0, nodes=4, spec="v5p:2x2x4", arrivals=2,
+                      node_failures=0, ghost_prob=0.0)
+    jobs = (
+        JobSpec("job-00000", 0.0, 4, 4, 400.0, checkpoint_period_s=30.0,
+                restore_cost_s=5.0, min_replicas=2, max_replicas=4),
+        JobSpec("job-00001", 50.0, 4, 1, 60.0, priority=100,
+                slo_wait_s=60.0),
+    )
+    return Trace(config=cfg, jobs=jobs)
+
+
+def test_shrink_then_grow_lifecycle():
+    engine = SimEngine(_elastic_pressure_trace(), "ici",
+                       preempt={}, elastic=True)
+    landed = []  # effective completions: (job, t); stale incarnations skipped
+    orig = engine._on_complete
+
+    def spy(name, incarnation):
+        jr = engine.jobs.get(name)
+        if jr is not None and incarnation == jr.incarnation:
+            landed.append((name, engine.clock.t))
+        orig(name, incarnation)
+
+    engine._on_complete = spy
+    engine.run_events()
+    rs = engine.run_state()
+    rec = finalize_run_state(rs, rs.horizon_s)
+    d = rec["disruption"]
+    # The serving quad placed by shrinking one member (4 chips), never
+    # by evicting the gang: nothing destroyed, nothing restored.
+    assert d["resizes"] == {"shrink": 1, "grow": 1,
+                            "chips_freed_by_shrink": 4}
+    assert d["restores"] == {"count": 0, "cost_s": 0.0}
+    assert d["lost_virtual_s"] == 0.0
+    assert rec["jobs"]["scheduled"] == 2
+    # Serving met its 60 s wait SLO (shrink freed the host immediately).
+    assert rec["tiers"]["serving"]["slo"]["attainment"] == 1.0
+    # The gang paid for the shrink window in wall time: 60 s at 3/4
+    # rate costs 15 virtual s, so completion slid 400 -> 415 — the grow
+    # re-projected it back to full rate (the shrink-era projection was
+    # 516.7, voided on the incarnation guard).
+    assert landed == [("job-00001", 110.0), ("job-00000", 415.0)]
+
+
+def test_shrink_respects_min_replicas_floor():
+    # min_replicas == replicas: rigid in practice — the serving quad
+    # must fall back to plain preemption (evict), not shrink.
+    cfg = TraceConfig(seed=0, nodes=4, spec="v5p:2x2x4", arrivals=2,
+                      node_failures=0, ghost_prob=0.0)
+    jobs = (
+        JobSpec("job-00000", 0.0, 4, 4, 400.0, checkpoint_period_s=30.0,
+                restore_cost_s=5.0, min_replicas=4, max_replicas=4),
+        JobSpec("job-00001", 50.0, 4, 1, 60.0, priority=100,
+                slo_wait_s=60.0),
+    )
+    engine = SimEngine(Trace(config=cfg, jobs=jobs), "ici",
+                       preempt={}, elastic=True)
+    engine.run_events()
+    rs = engine.run_state()
+    rec = finalize_run_state(rs, rs.horizon_s)
+    assert rec["disruption"]["resizes"]["shrink"] == 0
+
+
+# ---- migrate vs evict: the headline differential ----------------------------
+
+
+def test_migration_reduces_destroyed_work():
+    cfg = TraceConfig(seed=0, nodes=48, arrivals=240,
+                      workload="checkpointed")
+    kw = dict(preempt={}, defrag={})
+    off = run_trace(cfg, ["ici"], elastic=False, **kw)
+    on = run_trace(cfg, ["ici"], elastic=True, **kw)
+
+    def lost(rep):
+        return sum(t["preemption_disruption"]["lost_virtual_s"]
+                   for t in rep["policies"]["ici"]["tiers"].values())
+
+    assert on["schema"] == "tputopo.sim/v10"
+    assert on["engine"]["elastic"] == {"enabled": True}
+    assert "disruption" not in off["policies"]["ici"]
+    d = on["policies"]["ici"]["disruption"]
+    # Migrations planned and landed; every abort reason is classified.
+    assert d["migrations"]["planned"] > 0
+    assert d["migrations"]["landed"] >= 1
+    from tputopo.elastic import MIGRATE_ABORT_REASONS
+    assert set(d["migrations"]["aborts"]) <= set(MIGRATE_ABORT_REASONS)
+    assert d["resizes"]["shrink"] > 0
+    # The whole point: checkpoint-aware disruption destroys less
+    # virtual work than evict-everything on the same trace.
+    assert lost(on) < lost(off)
+    # Preserved work is real (checkpoints resumed, not restarted).
+    assert d["preserved_virtual_s"] > 0.0
+    assert d["restores"]["count"] > 0
+
+
+# ---- kill-switch byte-identity ----------------------------------------------
+
+#: The standing config matrix the off-path byte-identity contract covers.
+MATRIX = {
+    "plain": {},
+    "defrag": {"defrag": {}},
+    "chaos": {"chaos": "api-flake"},
+    "preempt-mixed": {"preempt": {}, "cfg": {"workload": "mixed"}},
+    "replicas": {"replicas": {"count": 2}},
+    "batch": {"batch": {}},
+}
+
+
+@pytest.mark.parametrize("name", sorted(MATRIX))
+def test_elastic_off_path_byte_identical(name, monkeypatch):
+    off = _canon(_run(**dict(MATRIX[name])))
+    # Flag on, switch OFF: the kill switch must make --elastic
+    # byte-invisible.
+    monkeypatch.setattr(SimEngine, "ELASTIC", False)
+    assert _canon(_run(elastic=True, **dict(MATRIX[name]))) == off
+
+
+def test_elastic_off_path_jobs2_byte_identical(monkeypatch):
+    off = _canon(_run(preempt={}, cfg={"workload": "mixed"}, jobs=2))
+    monkeypatch.setattr(SimEngine, "ELASTIC", False)
+    assert _canon(_run(elastic=True, preempt={},
+                       cfg={"workload": "mixed"}, jobs=2)) == off
+
+
+def test_elastic_on_path_deterministic_and_jobs2():
+    kw = dict(elastic=True, preempt={}, cfg={"workload": "checkpointed"})
+    first = _canon(_run(**kw))
+    assert _canon(_run(**kw)) == first          # replay
+    assert _canon(_run(jobs=2, **kw)) == first  # process-parallel
+
+
+def test_checkpointed_workload_deterministic_without_elastic():
+    # The new trace vocabulary is itself deterministic with the feature
+    # off — the decoration draws ride the config-seeded stream.
+    kw = dict(preempt={}, cfg={"workload": "checkpointed"})
+    assert _canon(_run(**kw)) == _canon(_run(**kw))
+
+
+# ---- extender surfaces ------------------------------------------------------
+
+
+def _occupy(api, name, node, chips, *, gang=None, priority=None,
+            ckpt=None):
+    labels = {}
+    if gang is not None:
+        labels["tpu.dev/gang-id"] = gang[0]
+        labels["tpu.dev/gang-size"] = str(gang[1])
+    if priority is not None:
+        labels[ko.LABEL_PRIORITY] = str(priority)
+    api.create("pods", ko.make_pod(name, chips=len(chips), labels=labels))
+    anns = {
+        ko.ANN_GROUP: ko.coords_to_ann(chips),
+        ko.ANN_ASSUME_TIME: "900.0",
+        ko.ANN_ASSIGNED: "true",
+    }
+    if gang is not None:
+        anns[ko.ANN_GANG_ID] = gang[0]
+    if ckpt is not None:
+        anns[ko.ANN_CKPT_PERIOD] = str(ckpt[0])
+        anns[ko.ANN_RESTORE_COST] = str(ckpt[1])
+    api.patch_annotations("pods", name, anns, "default")
+    api.bind_pod(name, node, "default")
+
+
+def _domain(api):
+    state = ClusterState(api, clock=CLOCK).sync()
+    dom = next(iter(state.domains.values()))
+    nodes = [dom.node_by_host[h] for h in sorted(dom.node_by_host)]
+    return dom, nodes
+
+
+def test_debug_migrate_endpoint():
+    from tputopo.extender import (ExtenderConfig, ExtenderHTTPServer,
+                                  ExtenderScheduler)
+
+    api, _ = build_cluster()
+    dom, nodes = _domain(api)
+    # A checkpointed 2x4 gang on hosts 0/1; hosts 2/3 stay free — a
+    # feasible destination for its shape exists right now.
+    for i, n in enumerate(nodes[:2]):
+        _occupy(api, f"train-{i}", n, list(dom.chips_by_node[n]),
+                gang=("train", 2), ckpt=(30.0, 5.0))
+    config = ExtenderConfig()
+    sched = ExtenderScheduler(api, config, clock=CLOCK)
+    srv = ExtenderHTTPServer(sched, config, port=0).start()
+    try:
+        host, port = srv.address
+
+        def get(path):
+            with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                        timeout=5) as resp:
+                return resp.status, json.loads(resp.read())
+
+        status, out = get("/debug/migrate?gang=train")
+        assert status == 200
+        assert out["dry_run"] is True
+        assert out["gang"] == "default/train"
+        assert out["replicas"] == 2 and out["chips_per_member"] == 4
+        assert out["destination"] == dom.slice_id
+        # Bound at 900, priced at 1000: 100 s run, 30 s period — 10 s
+        # lost + 5 s restore, the shared checkpoint_split arithmetic.
+        assert out["cost"]["charged_cost_s"] == pytest.approx(15.0)
+        assert 0.0 < out["cost"]["destroyed_chips"] < 8.0
+        assert sched.metrics.counters["migrate_plans_found"] == 1
+
+        # Unknown gangs 404, missing gang= is a 400.
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/debug/migrate?gang=nope")
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/debug/migrate")
+        assert e.value.code == 400
+        assert sched.metrics.counters["migrate_plans_considered"] == 2
+    finally:
+        srv.stop()
+
+
+def test_debug_preempt_prices_checkpointed_victims(cluster_preempt=None):
+    """The cost-unification bugfix: when bound pods carry checkpoint
+    annotations, the dry-run plan ranks and reports victims by the SAME
+    checkpoint-charged cost the sim tier tally uses — not whole-runtime
+    seconds.  Without the annotations nothing changes (cost_of stays
+    None and the plan bytes are the pre-elastic ones)."""
+    from tputopo.extender import ExtenderConfig, ExtenderScheduler
+
+    api, _ = build_cluster()
+    dom, nodes = _domain(api)
+    # Checkerboard batch occupancy blocks a 2x4 serving demand.
+    _occupy(api, "batch-a", nodes[0], list(dom.chips_by_node[nodes[0]]),
+            ckpt=(30.0, 5.0))
+    _occupy(api, "batch-c", nodes[2], list(dom.chips_by_node[nodes[2]]))
+    sched = ExtenderScheduler(api, ExtenderConfig(), clock=CLOCK)
+    plan = sched.plan_preempt(2, 4, 100)
+    assert plan is not None
+    desc = plan.describe()
+    # The checkpointed quad (charged 15 s) undercuts the plain one
+    # (charged 100 s whole-runtime) — cheapest victim wins.
+    assert [v["key"] for v in desc["victims"]] == ["default/batch-a"]
+    assert desc["charged_cost_s"] == pytest.approx(15.0)
+
+    # No checkpoint annotations anywhere: pre-elastic ranking, no
+    # charged cost in the describe (plan bytes pinned).
+    api2, _ = build_cluster()
+    dom2, nodes2 = _domain(api2)
+    _occupy(api2, "batch-a", nodes2[0],
+            list(dom2.chips_by_node[nodes2[0]]))
+    _occupy(api2, "batch-c", nodes2[2],
+            list(dom2.chips_by_node[nodes2[2]]))
+    sched2 = ExtenderScheduler(api2, ExtenderConfig(), clock=CLOCK)
+    plan2 = sched2.plan_preempt(2, 4, 100)
+    assert plan2 is not None
+    assert "charged_cost_s" not in plan2.describe()

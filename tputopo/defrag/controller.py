@@ -70,7 +70,8 @@ class DefragController:
                  target_chips: int = 0, max_moves: int = 2,
                  max_chips_moved: int = 64, cooldown_s: float = 300.0,
                  hysteresis: int = 2, max_concurrent: int = 1,
-                 evict=None, state_factory=None, retry_rng=None) -> None:
+                 evict=None, state_factory=None, retry_rng=None,
+                 cost_of=None) -> None:
         self.api = api
         self.clock = clock
         self.tracer = tracer if tracer is not None else NULL_TRACER
@@ -82,6 +83,11 @@ class DefragController:
         self.hysteresis = max(1, hysteresis)
         self.max_concurrent = max_concurrent
         self._evict = evict if evict is not None else self._evict_via_api
+        # Checkpoint-aware victim repricing (tputopo.elastic): a factory
+        # returning the per-cycle ``cost_of`` callable plan_migration
+        # charges with (rebuilt each cycle — costs are a function of
+        # "now").  None keeps the pre-elastic chips-moved ranking.
+        self._cost_of_factory = cost_of
         # Eviction deletes go through the shared retry policy via the one
         # shared ``bind_retry`` wiring: a transient API failure
         # mid-eviction must not wedge the cycle (and the sweep advances
@@ -216,7 +222,9 @@ class DefragController:
             pressured: list = []
             plan = plan_migration(state, demands, max_moves=self.max_moves,
                                   max_chips_moved=self.max_chips_moved,
-                                  pressured_out=pressured)
+                                  pressured_out=pressured,
+                                  cost_of=(self._cost_of_factory()
+                                           if self._cost_of_factory else None))
             self.last_plan = plan
             if plan is None:
                 if not pressured:

@@ -65,10 +65,15 @@ class MigrationPlan:
     chips_moved: int               # total chips the evicted jobs held
     chips_to_clear: int            # occupied chips inside the target box
     predicted_gbps: float          # bandwidth of the restored box
+    # Checkpoint-charged disruption cost of the victim set, virtual
+    # seconds (tputopo.elastic) — None when the plan was ranked by the
+    # pre-elastic chips-moved key, which keeps every existing describe()
+    # byte pinned.
+    charged_cost_s: float | None = None
 
     def describe(self) -> dict:
         """JSON-safe plan record (the /debug/defrag and explain shape)."""
-        return {
+        out = {
             "slice": self.slice_id,
             "demand": {"replicas": self.demand[0],
                        "chips_per_member": self.demand[1]},
@@ -80,6 +85,9 @@ class MigrationPlan:
             "chips_to_clear": self.chips_to_clear,
             "predicted_gbps": round(self.predicted_gbps, 3),
         }
+        if self.charged_cost_s is not None:
+            out["charged_cost_s"] = round(self.charged_cost_s, 6)
+        return out
 
 
 # ---- demand -----------------------------------------------------------------
@@ -323,7 +331,8 @@ def plan_migration(state: ClusterState, demands: list[tuple[int, int]], *,
                    pressured_out: list | None = None,
                    placeable_out: dict | None = None,
                    evictable=None,
-                   require_free_capacity: bool = True) -> MigrationPlan | None:
+                   require_free_capacity: bool = True,
+                   cost_of=None) -> MigrationPlan | None:
     """The cheapest within-budget migration plan serving the largest
     pressured demand, or None (the do-nothing fallback).
 
@@ -356,7 +365,18 @@ def plan_migration(state: ClusterState, demands: list[tuple[int, int]], *,
     ``require_free_capacity=False`` drops the per-domain
     free-chips >= volume gate: defragmentation compacts (the chips must
     already exist free somewhere), preemption *frees* by evicting — the
-    capacity comes from the victims themselves."""
+    capacity comes from the victims themselves.
+
+    ``cost_of`` (tputopo.elastic) reprices victims by what eviction
+    *actually* destroys: a ``(key, chips_held) -> (charged_cost_s,
+    destroyed_chips)`` callable (see
+    :func:`tputopo.elastic.ckpt.victim_costs`).  When given, the ranking
+    leads with the summed charged cost — cheap-restore victims win ties
+    whatever volume they hold — and the net-gain rule debits the summed
+    *work-bearing* chips instead of raw volume, so a gang that just
+    checkpointed may be moved even when its raw chips match the restored
+    box (``max_chips_moved`` still caps the raw disturbance).  None (the
+    default) keeps the pre-elastic chips-moved key byte-for-byte."""
     victims = None  # built lazily — pressure usually absent
     for demand in demands:
         doms = [state.domains[sid] for sid in sorted(state.domains)]
@@ -430,10 +450,25 @@ def plan_migration(state: ClusterState, demands: list[tuple[int, int]], *,
                     if len(box_victims) > max_moves:
                         continue
                     moved = sum(r.chips for r in box_victims.values())
-                    if moved > budget:
-                        continue
+                    charged = None
+                    if cost_of is None:
+                        if moved > budget:
+                            continue
+                        head: tuple = (moved,)
+                    else:
+                        charged = destroyed = 0.0
+                        for vk, rec in box_victims.items():
+                            c_s, d_ch = cost_of(vk, rec.chips)
+                            charged += c_s
+                            destroyed += d_ch
+                        # Net gain on ACTUAL destroyed work: checkpointed
+                        # victims debit only their unsaved chips-worth;
+                        # the raw-volume ceiling still bounds disturbance.
+                        if destroyed > budget or moved > max_chips_moved:
+                            continue
+                        head = (round(charged, 6), moved)
                     free_contact = (nbr & free_mask).bit_count()
-                    key = (moved, len(box_victims), -gbps, -free_contact,
+                    key = (*head, len(box_victims), -gbps, -free_contact,
                            chips, dom.slice_id)
                     if best_key is None or key < best_key:
                         best_key = key
@@ -449,6 +484,7 @@ def plan_migration(state: ClusterState, demands: list[tuple[int, int]], *,
                             chips_moved=moved,
                             chips_to_clear=occ.bit_count(),
                             predicted_gbps=gbps,
+                            charged_cost_s=charged,
                         )
         if best_plan is not None:
             return best_plan

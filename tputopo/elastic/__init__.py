@@ -1,0 +1,34 @@
+"""tputopo.elastic — checkpoint-aware disruption costing, live gang
+migration, and elastic resize.
+
+Three layers over the existing eviction machinery:
+
+- :mod:`tputopo.elastic.ckpt` — the checkpoint cost model: jobs carry
+  ``checkpoint_period_s`` / ``restore_cost_s`` in the trace vocabulary
+  and every disruption is charged its *actual* destroyed work (the
+  virtual seconds since the last checkpoint, plus the restore bill)
+  instead of the whole runtime.
+- :mod:`tputopo.elastic.migrate` — the migration verb: plan the
+  destination box *before* eviction with the mask-native candidate
+  vocabulary, then requeue with preserved progress and land through the
+  engine's ``_MIGRATE`` event path.
+- Elastic resize lives in the engine itself (shrink-under-pressure /
+  grow-on-release of gangs tagged ``min_replicas``/``max_replicas``);
+  the planners here only supply the costing and destination search.
+
+Everything is behind the registered ``SimEngine.ELASTIC`` kill switch
+(CLI ``--elastic``): off-path reports are byte-identical to the
+evict-everything vocabulary, schema included.
+"""
+
+from tputopo.elastic.ckpt import (checkpoint_split, disruption_cost,
+                                  victim_costs)
+from tputopo.elastic.migrate import MIGRATE_ABORT_REASONS, plan_destination
+
+__all__ = [
+    "MIGRATE_ABORT_REASONS",
+    "checkpoint_split",
+    "disruption_cost",
+    "plan_destination",
+    "victim_costs",
+]

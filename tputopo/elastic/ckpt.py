@@ -1,0 +1,140 @@
+"""Checkpoint cost model: what an eviction *actually* destroys.
+
+The pre-elastic vocabulary charges every disruption the victim's whole
+runtime — delete→requeue loses all progress, so the tier block's
+``lost_virtual_s`` and the planners' victim ranking both price a gang
+by how long it has run.  Jobs that checkpoint change the bill: evicting
+a gang that checkpointed 10 s ago destroys 10 s of work plus the
+restore cost, however long it has been running.
+
+The model is deliberately minimal — two trace-vocabulary fields:
+
+- ``checkpoint_period_s``: the gang writes a full checkpoint every this
+  many *wall* seconds of running (anchored at each placement segment).
+  None (the default) means the job never checkpoints and the whole run
+  is lost on eviction — exactly the pre-elastic accounting, which is
+  what pins all prior trace and report bytes.
+- ``restore_cost_s``: wall seconds a resumed incarnation spends
+  restoring before it makes progress again (charged once per resume).
+
+:func:`checkpoint_split` is the one shared arithmetic both the sim
+engine's tier tally and the extender's ``/debug/preempt`` /
+``/debug/migrate`` dry-runs price with — the bugfix this subsystem
+ships is precisely that the two surfaces previously could not agree
+(whole-runtime seconds in the dry-run explain vs lost virtual work in
+the report).
+"""
+
+from __future__ import annotations
+
+from tputopo.k8s import objects as ko
+
+
+def checkpoint_split(run_s: float, rate: float, progress_s: float,
+                     checkpoint_period_s: float | None,
+                     restore_cost_s: float | None
+                     ) -> tuple[float, float, float]:
+    """Split a placement segment's work at the last checkpoint.
+
+    ``run_s`` — wall seconds the current placement segment has run;
+    ``rate`` — virtual progress per wall second (1.0 at full width, a
+    shrunk elastic gang advances at ``width / replicas``);
+    ``progress_s`` — virtual work already committed before this segment
+    (carried across resumes by earlier checkpoints or resizes).
+
+    Returns ``(lost_s, preserved_s, charged_s)``: virtual work destroyed
+    by evicting right now, virtual work a checkpointed resume keeps, and
+    the cost the planners charge (destroyed work plus the restore bill).
+    Without checkpointing the carried progress is lost too — restarting
+    from scratch is the only resume."""
+    if run_s < 0.0:
+        run_s = 0.0
+    if not checkpoint_period_s or checkpoint_period_s <= 0.0:
+        lost = progress_s + run_s * rate
+        return lost, 0.0, lost
+    whole = int(run_s // checkpoint_period_s)
+    lost = (run_s - whole * checkpoint_period_s) * rate
+    preserved = progress_s + whole * checkpoint_period_s * rate
+    return lost, preserved, lost + (restore_cost_s or 0.0)
+
+
+def disruption_cost(spec, now: float, started_t: float, *,
+                    progress_s: float = 0.0, width: int | None = None
+                    ) -> float:
+    """Charged cost of evicting ``spec`` at ``now``: work lost since the
+    last checkpoint plus restore time (the whole run when the job never
+    checkpoints).  ``started_t < 0`` means not started — nothing to
+    destroy."""
+    if started_t < 0.0:
+        return 0.0
+    rate = 1.0
+    if width is not None and spec.replicas > 0:
+        rate = width / spec.replicas
+    _, _, charged = checkpoint_split(
+        now - started_t, rate, progress_s,
+        spec.checkpoint_period_s, spec.restore_cost_s)
+    return charged
+
+
+def _ann_float(anns: dict, key: str) -> float | None:
+    raw = anns.get(key)
+    if raw is None:
+        return None
+    try:
+        val = float(raw)
+    except (TypeError, ValueError):
+        return None
+    return val if val == val and val > 0.0 else None
+
+
+def victim_costs(pods, now: float) -> dict[str, tuple[float, float]]:
+    """Disruption price of every evictable unit in a pod listing, keyed
+    exactly like the defrag planner's victim index ("namespace/gang-id"
+    / "namespace/pod-name" — the same ``tpu.dev/gang-id`` annotation
+    :func:`tputopo.priority.preempt.victim_priorities` reads, so the
+    three key derivations cannot drift).
+
+    Returns ``{key: (charged_cost_s, destroyed_chips)}``: the cost the
+    planner ranks by, and the *work-bearing* chip volume the net-gain
+    rule debits — a gang that checkpointed a moment ago holds chips
+    whose work is almost entirely safe, so evicting it destroys almost
+    nothing even though it disturbs the full volume.  Units without
+    checkpoint annotations price at whole-runtime / full volume, the
+    pre-elastic semantics.
+
+    A gang's run starts at its members' MAX ``assume-time`` (the gang
+    only runs once the last member bound); its chip volume is the sum
+    over members."""
+    units: dict[str, list] = {}  # key -> [start, chips, period, restore]
+    for p in pods:
+        md = p.get("metadata", {})
+        anns = md.get("annotations") or {}
+        raw = anns.get(ko.ANN_ASSUME_TIME)
+        if raw is None or not p.get("spec", {}).get("nodeName"):
+            continue  # unbound: holds nothing, cannot be a victim
+        try:
+            start = float(raw)
+        except (TypeError, ValueError):
+            start = 0.0
+        ns = md.get("namespace", "default")
+        gang = anns.get(ko.ANN_GANG_ID)
+        key = f"{ns}/{gang}" if gang else f"{ns}/{md.get('name', '')}"
+        rec = units.setdefault(key, [start, 0, None, None])
+        rec[0] = max(rec[0], start)
+        rec[1] += ko.pod_requested_chips(p)
+        period = _ann_float(anns, ko.ANN_CKPT_PERIOD)
+        if period is not None:
+            rec[2] = period
+            rec[3] = _ann_float(anns, ko.ANN_RESTORE_COST) or 0.0
+    out: dict[str, tuple[float, float]] = {}
+    for key, (start, chips, period, restore) in units.items():
+        run_s = max(0.0, now - start)
+        lost, preserved, charged = checkpoint_split(
+            run_s, 1.0, 0.0, period, restore)
+        if period is None:
+            destroyed = float(chips)
+        else:
+            total = lost + preserved
+            destroyed = chips * (lost / total) if total > 0.0 else 0.0
+        out[key] = (charged, destroyed)
+    return out

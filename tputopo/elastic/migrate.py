@@ -1,0 +1,61 @@
+"""Migration destination planning: pick the landing box *before* the
+eviction.
+
+The pre-elastic requeue is fire-and-forget — a defrag or preemption
+victim goes back to the queue and re-places whenever the tiered
+admission loop next reaches it, possibly much later, possibly nowhere.
+Migration inverts the order: the engine first checks a destination
+exists for the gang's shape (this module), only then evicts with
+preserved progress and pushes a ``_MIGRATE`` event that re-places the
+gang immediately.  No destination → plain requeue, nothing risked.
+
+The search reuses the mask-native candidate vocabulary the sort hot
+loop and the defrag planner place with: per node, ``Allocator.find``
+restricted to the node's chip mask answers "does a k-box fit on this
+host", and a gang of ``r`` members needs ``r`` distinct feasible hosts
+inside one domain.  It is a *necessary*-condition screen, not the full
+host-grid gang search — the landing goes through the real placement
+policy, and when the destination is taken by a racing placement between
+plan and land the abort is classified, never silent.
+"""
+
+from __future__ import annotations
+
+#: Classified reasons a planned migration failed to land, in the order
+#: the engine checks them.  ``destination_lost`` — the planned capacity
+#: was taken by a racing placement between evict and land;
+#: ``place_failed`` — capacity still screens feasible but the real
+#: placer declined (host-grid contiguity, transient fault);
+#: ``superseded`` — the gang already landed through the normal tiered
+#: loop before the migrate event fired; ``victim_gone`` — the gang
+#: completed or was re-evicted (stale incarnation) in between.
+MIGRATE_ABORT_REASONS = ("destination_lost", "place_failed",
+                        "superseded", "victim_gone")
+
+
+def plan_destination(replicas: int, k: int, domains) -> str | None:
+    """Slice id of the first domain (sorted order) holding ``replicas``
+    distinct hosts with a free k-chip box each, or None.
+
+    ``domains`` is an iterable of ``(slice_id, allocator, node_masks)``
+    tuples sorted by slice id — the engine passes its twin allocators,
+    the extender its derived-state domains; both speak the same mask
+    vocabulary."""
+    if replicas < 1 or k < 1:
+        return None
+    for sid, alloc, node_masks in domains:
+        free = alloc.free_mask
+        if free.bit_count() < replicas * k:
+            continue
+        hosts = 0
+        for node in sorted(node_masks):
+            node_mask = node_masks[node]
+            node_free = node_mask & free
+            if node_free.bit_count() < k:
+                continue
+            if alloc.find(k, free_mask=node_free,
+                          within_mask=node_mask) is not None:
+                hosts += 1
+                if hosts >= replicas:
+                    return sid
+    return None

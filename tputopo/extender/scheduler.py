@@ -2218,7 +2218,14 @@ class ExtenderScheduler:
         ``replicas x k``-chip demand at ``priority``: the cheapest
         strictly-lower-tier eviction set that would let it place, or
         None (served by ``GET /debug/preempt``; executing the evictions
-        is the job controller's call, exactly like /debug/defrag)."""
+        is the job controller's call, exactly like /debug/defrag).
+
+        When any bound pod carries checkpoint annotations the victims
+        are priced by :func:`tputopo.elastic.ckpt.victim_costs` — the
+        same arithmetic the sim engine's tier tally charges, fixing the
+        drift where this dry-run's explain priced victims in
+        whole-runtime seconds while the report counted lost *virtual*
+        work."""
         from tputopo.defrag.planner import list_pods_nocopy
         from tputopo.priority.preempt import plan_preemption
 
@@ -2231,10 +2238,97 @@ class ExtenderScheduler:
         plan = plan_preemption(
             state, (replicas, k), priority, pods,
             max_moves=self.config.preempt_max_moves,
-            max_chips_moved=self.config.preempt_max_chips_moved)
+            max_chips_moved=self.config.preempt_max_chips_moved,
+            cost_of=self._ckpt_cost_of(pods))
         if plan is not None:
             self.metrics.inc("preempt_plans_found")
         return plan
+
+    # ---- elastic migration (tputopo.elastic) -------------------------------
+
+    def _ckpt_cost_of(self, pods):
+        """Checkpoint-aware victim pricing closure for the dry-run
+        planners, or None when no bound pod carries checkpoint
+        annotations — a pre-elastic fleet keeps the raw chip-volume
+        ranking, so every existing plan byte is pinned.  Unknown victim
+        keys fail closed (effectively infinite cost, full volume): a
+        pod listing racing a delete must never make a victim look
+        free.  The 1e18 sentinel matters — ``float('inf')`` would leak
+        ``Infinity`` into a chosen plan's describe(), which is not
+        valid strict JSON."""
+        from tputopo.elastic.ckpt import victim_costs
+
+        if not any(ko.ANN_CKPT_PERIOD in (p.get("metadata", {})
+                                          .get("annotations") or {})
+                   for p in pods):
+            return None
+        costs = victim_costs(pods, self.clock())
+
+        def cost_of(key: str, chips_held: int) -> tuple[float, float]:
+            got = costs.get(key)
+            if got is None:
+                return 1e18, float(chips_held)
+            return got
+
+        return cost_of
+
+    def plan_migrate(self, gang: str, namespace: str = "default"):
+        """Dry-run migration plan for a BOUND gang (served at
+        ``GET /debug/migrate?gang=...``): what evicting it right now
+        would destroy (checkpoint-charged, the same
+        :func:`tputopo.elastic.ckpt.victim_costs` arithmetic the sim
+        tier tally uses) and whether a destination domain currently
+        holds enough per-host free boxes to land it
+        (:func:`tputopo.elastic.migrate.plan_destination` — the same
+        necessary-condition screen the sim engine runs before it
+        upgrades an eviction to a migration).  Read-only; returns None
+        when no bound pod matches the gang."""
+        from tputopo.defrag.planner import list_pods_nocopy
+        from tputopo.elastic.ckpt import victim_costs
+        from tputopo.elastic.migrate import plan_destination
+
+        self.metrics.inc("migrate_plans_considered")
+        informer_reader = (self.informer if self.informer is not None
+                           and self.informer.synced else None)
+        state = self._state(allow_cache=True, reader=informer_reader)
+        pods = list_pods_nocopy(informer_reader if informer_reader
+                                is not None else self.api)
+        members = []
+        for p in pods:
+            md = p.get("metadata", {})
+            if md.get("namespace", "default") != namespace:
+                continue
+            if not p.get("spec", {}).get("nodeName"):
+                continue
+            anns = md.get("annotations") or {}
+            if anns.get(ko.ANN_GANG_ID) == gang or md.get("name") == gang:
+                members.append(p)
+        if not members:
+            return None
+        replicas = len(members)
+        k = max(ko.pod_requested_chips(p) for p in members)
+        key = f"{namespace}/{gang}"
+        charged, destroyed = victim_costs(pods, self.clock()).get(
+            key, (0.0, 0.0))
+        nodes = {p["spec"]["nodeName"] for p in members}
+        current = sorted(sid for sid, dom in state.domains.items()
+                         if nodes & dom.node_masks.keys())
+        dest = plan_destination(
+            replicas, k,
+            ((sid, state.domains[sid].allocator,
+              state.domains[sid].node_masks)
+             for sid in sorted(state.domains)))
+        if dest is not None:
+            self.metrics.inc("migrate_plans_found")
+        return {
+            "gang": key,
+            "replicas": replicas,
+            "chips_per_member": k,
+            "current_domains": current,
+            "cost": {"charged_cost_s": round(charged, 6),
+                     "destroyed_chips": round(destroyed, 6)},
+            "destination": dest,
+        }
 
     # ---- joint batch admission (tputopo.batch) -----------------------------
 

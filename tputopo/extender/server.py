@@ -187,6 +187,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._handle_pending()
             elif url.path == "/debug/batchplan":
                 self._handle_batchplan(url.query)
+            elif url.path == "/debug/migrate":
+                self._handle_migrate(url.query)
             elif url.path == "/debug/timeline":
                 self._handle_timeline()
             elif url.path == "/policy":
@@ -373,6 +375,28 @@ class _Handler(BaseHTTPRequestHandler):
             return
         plan = self.scheduler.plan_batch(window=window)
         self._send_json(200, {"dry_run": True, **plan.describe()})
+
+    def _handle_migrate(self, query: str) -> None:
+        """GET /debug/migrate?gang=NAME[&namespace=NS] — DRY-RUN
+        migration plan for a BOUND gang (tputopo.elastic): the
+        checkpoint-charged cost of evicting it right now and the
+        destination domain that currently screens feasible for its
+        shape, or null.  Read-only — the sim engine's ``_MIGRATE``
+        path is the only executor; 404 when no bound pod matches."""
+        qs = urllib.parse.parse_qs(query)
+        gang = qs.get("gang", [""])[0]
+        namespace = qs.get("namespace", ["default"])[0]
+        if not gang:
+            self.scheduler.metrics.inc("bad_requests")
+            self._send_json(400, {"error": f"bad migrate query "
+                                           f"{query!r}: gang required"})
+            return
+        plan = self.scheduler.plan_migrate(gang, namespace=namespace)
+        if plan is None:
+            self._send_json(404, {"error": f"no bound gang "
+                                           f"{namespace}/{gang}"})
+            return
+        self._send_json(200, {"dry_run": True, **plan})
 
     def _handle_timeline(self) -> None:
         """GET /debug/timeline — the live fleet-gauge trajectory: the

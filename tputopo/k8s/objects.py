@@ -54,6 +54,15 @@ ANN_BOUND_BY = "tpu.dev/bound-by"          # replica id that committed the bind
                                            # recover() reads it to count
                                            # adoptions of a peer's binds.
 
+# -- Checkpoint declaration (tputopo.elastic).  A pod (every member of a
+#    gang carries the same values) declares how its job checkpoints; the
+#    disruption cost model prices evicting it as work-since-the-last-
+#    checkpoint plus the restore bill instead of the whole runtime.
+#    Absent == the job never checkpoints — whole-runtime pricing, the
+#    pre-elastic vocabulary byte-for-byte.
+ANN_CKPT_PERIOD = "tpu.dev/checkpoint-period-s"  # wall seconds between checkpoints
+ANN_RESTORE_COST = "tpu.dev/restore-cost-s"      # wall seconds to resume from one
+
 # -- Priority tiers (tputopo.priority).  A pod (or every pod of a gang)
 #    declares its tier via this label/annotation; the value is either a
 #    named tier or a bare integer 0..MAX_PRIORITY_VALUE.  Higher wins:

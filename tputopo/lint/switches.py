@@ -67,6 +67,7 @@ SWITCH_REGISTRY: tuple[tuple[str, str, str], ...] = (
     ("tputopo/extender/state.py", "ClusterState", "PA_CACHE"),
     ("tputopo/sim/engine.py", "SimEngine", "PLAN_STATE_REUSE"),
     ("tputopo/sim/engine.py", "SimEngine", "TIMELINE"),
+    ("tputopo/sim/engine.py", "SimEngine", "ELASTIC"),
     ("tputopo/sim/policies.py", "BaselinePolicy", "delta_fold"),
     ("tputopo/k8s/fakeapi.py", "FakeApiServer", "nocopy_writes"),
 )

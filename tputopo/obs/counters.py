@@ -57,6 +57,12 @@ COUNTERS = (
     # v7 report schema instead)
     "batch_plans_considered",
     "batch_plans_planned",
+    # elastic migration (tputopo.elastic; extender /debug/migrate
+    # dry-run planning — the sim engine's migration/resize tallies are
+    # deterministic report dicts, not Metrics counters, pinned by the
+    # v10 report schema instead)
+    "migrate_plans_considered",
+    "migrate_plans_found",
     # baseline-policy state maintenance (tputopo/sim/policies.py,
     # BaselinePolicy.inc — deterministic report-dict counters): the
     # three-way split that replaced invalidate_drops.  delta_applied =

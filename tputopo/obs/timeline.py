@@ -63,11 +63,19 @@ SATURATION_UTIL = 0.90
 #: executed preemption plans, defrag = executed defrag cycles.
 MARK_KINDS = ("conflict", "preempt", "defrag")
 
+#: Extra mark kinds the elastic subsystem feeds (tputopo.elastic):
+#: migrate = migration verbs initiated, resize = shrink/grow steps.
+#: Armed per recorder via ``extra_marks`` ONLY when the engine runs
+#: ``--elastic`` — a default-constructed recorder emits exactly the
+#: pre-elastic marks dict, so timeline-on/elastic-off bytes are pinned.
+ELASTIC_MARK_KINDS = ("migrate", "resize")
+
 # Bucket slot layout (plain lists: merged thousands of times per run,
 # so no per-point object/dict overhead on the sampling hot path).
 _T, _N, _UTIL, _FRAG, _FREE, _QUEUE, _RUN, _WM = range(8)
-_MARK0 = 8          # then one slot per MARK_KINDS entry
-_TIERS = _MARK0 + len(MARK_KINDS)   # per-tier queue-depth dict or None
+_MARK0 = 8          # then one slot per mark kind; the per-tier
+                    # queue-depth dict (or None) follows the marks, so
+                    # its slot index depends on the recorder's mark set.
 
 
 def _r(x: float, nd: int = 6) -> float:
@@ -77,9 +85,11 @@ def _r(x: float, nd: int = 6) -> float:
     return round(float(x), nd)
 
 
-def _merge(a: list, b: list) -> list:
+def _merge(a: list, b: list, nmarks: int = len(MARK_KINDS)) -> list:
     """Fold two ADJACENT buckets (a precedes b) into one: max gauges,
-    min free, b's cumulative tail, summed marks, per-tier max."""
+    min free, b's cumulative tail, summed marks, per-tier max.
+    ``nmarks`` is the owning recorder's mark-kind count (the tier dict
+    sits right after the mark slots)."""
     out = [
         b[_T], a[_N] + b[_N],
         a[_UTIL] if a[_UTIL] > b[_UTIL] else b[_UTIL],
@@ -89,9 +99,10 @@ def _merge(a: list, b: list) -> list:
         a[_RUN] if a[_RUN] > b[_RUN] else b[_RUN],
         b[_WM],
     ]
-    for k in range(len(MARK_KINDS)):
+    for k in range(nmarks):
         out.append(a[_MARK0 + k] + b[_MARK0 + k])
-    ta, tb = a[_TIERS], b[_TIERS]
+    tiers_i = _MARK0 + nmarks
+    ta, tb = a[tiers_i], b[tiers_i]
     if ta is None:
         out.append(tb)
     elif tb is None:
@@ -115,19 +126,26 @@ class TimelineRecorder:
 
     __slots__ = ("budget", "sat_util", "stride", "samples", "_points",
                  "_cur", "_cur_n", "_pending_marks", "_tiers_seen",
+                 "_marks", "_tiers_i",
                  "_prev_t", "_prev_util", "_onset_t", "_peak_q",
                  "_peak_q_t", "_above_s", "_last_arrival_t", "_drain_t")
 
     def __init__(self, budget: int = POINT_BUDGET,
-                 sat_util: float = SATURATION_UTIL) -> None:
+                 sat_util: float = SATURATION_UTIL,
+                 extra_marks: tuple[str, ...] = ()) -> None:
         self.budget = max(2, int(budget))
         self.sat_util = float(sat_util)
+        # Mark vocabulary: the standing kinds plus caller extras (the
+        # engine arms ELASTIC_MARK_KINDS only under --elastic).  Default
+        # construction emits exactly the pre-elastic marks dict.
+        self._marks = MARK_KINDS + tuple(extra_marks)
+        self._tiers_i = _MARK0 + len(self._marks)
         self.stride = 1          # samples per sealed bucket (power of two)
         self.samples = 0
         self._points: list[list] = []
         self._cur: list | None = None
         self._cur_n = 0
-        self._pending_marks = [0] * len(MARK_KINDS)
+        self._pending_marks = [0] * len(self._marks)
         self._tiers_seen = False
         # Exact analytics state (raw stream, step-function convention:
         # a gauge holds its value until the next sample).
@@ -150,9 +168,10 @@ class TimelineRecorder:
         self._drain_t = None
 
     def mark(self, kind: str) -> None:
-        """Count one event of ``kind`` (a :data:`MARK_KINDS` entry)
-        against the next sample's bucket."""
-        self._pending_marks[MARK_KINDS.index(kind)] += 1
+        """Count one event of ``kind`` (an entry of this recorder's mark
+        vocabulary — :data:`MARK_KINDS` plus any armed extras) against
+        the next sample's bucket."""
+        self._pending_marks[self._marks.index(kind)] += 1
 
     def sample(self, t: float, util: float, frag: float, free_chips: int,
                queue_depth: int, running: int, wm_skips: int = 0,
@@ -193,19 +212,19 @@ class TimelineRecorder:
             if running > cur[_RUN]:
                 cur[_RUN] = running
             cur[_WM] = wm_skips
-            for k in range(len(MARK_KINDS)):
+            for k in range(len(self._marks)):
                 cur[_MARK0 + k] += self._pending_marks[k]
             if tier_depths:
-                ts = cur[_TIERS]
+                ts = cur[self._tiers_i]
                 if ts is None:
-                    cur[_TIERS] = dict(tier_depths)
+                    cur[self._tiers_i] = dict(tier_depths)
                 else:
                     for name, d in tier_depths.items():
                         if ts.get(name, -1) < d:
                             ts[name] = d
         if tier_depths is not None:
             self._tiers_seen = True
-        for k in range(len(MARK_KINDS)):
+        for k in range(len(self._marks)):
             self._pending_marks[k] = 0
         self._cur_n += 1
         if self._cur_n >= self.stride:
@@ -220,7 +239,8 @@ class TimelineRecorder:
         doubles the stride.  An odd trailing point carries over as-is
         (it simply represents fewer samples than its new stride)."""
         pts = self._points
-        folded = [_merge(pts[i], pts[i + 1])
+        nm = len(self._marks)
+        folded = [_merge(pts[i], pts[i + 1], nm)
                   for i in range(0, len(pts) - 1, 2)]
         if len(pts) % 2:
             folded.append(pts[-1])
@@ -250,7 +270,7 @@ class TimelineRecorder:
         # The partial bucket can push the count to budget+0 at most
         # (compaction fires AT budget), but keep the pin explicit.
         while len(pts) > self.budget:
-            folded = [_merge(pts[i], pts[i + 1])
+            folded = [_merge(pts[i], pts[i + 1], len(self._marks))
                       for i in range(0, len(pts) - 1, 2)]
             if len(pts) % 2:
                 folded.append(pts[-1])
@@ -283,16 +303,17 @@ class TimelineRecorder:
             "running": [p[_RUN] for p in pts],
             "wm_skips": [p[_WM] for p in pts],
             "marks": {kind: [p[_MARK0 + k] for p in pts]
-                      for k, kind in enumerate(MARK_KINDS)},
+                      for k, kind in enumerate(self._marks)},
             "saturation": sat,
         }
         if self._tiers_seen:
             # Per-tier pending depth, present only when the feed carried
             # tiers (the mixed workload) — same presence rule as the
             # report's tiers block.  Missing tier-in-bucket = depth 0.
-            names = sorted({name for p in pts if p[_TIERS]
-                            for name in p[_TIERS]})
-            out["tiers"] = {name: [(p[_TIERS] or {}).get(name, 0)
+            ti = self._tiers_i
+            names = sorted({name for p in pts if p[ti]
+                            for name in p[ti]})
+            out["tiers"] = {name: [(p[ti] or {}).get(name, 0)
                                    for p in pts] for name in names}
         return out
 

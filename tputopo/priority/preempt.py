@@ -47,7 +47,8 @@ def victim_priorities(pods) -> dict[str, int]:
 def plan_preemption(state: ClusterState, demand: tuple[int, int],
                     demand_priority: int, pods, *,
                     max_moves: int = 1,
-                    max_chips_moved: int = 64) -> MigrationPlan | None:
+                    max_chips_moved: int = 64,
+                    cost_of=None) -> MigrationPlan | None:
     """The cheapest strictly-lower-tier eviction set that would let
     ``demand`` (replicas, chips-per-member) place, or None.
 
@@ -56,7 +57,13 @@ def plan_preemption(state: ClusterState, demand: tuple[int, int],
     bottom tier can never preempt (nothing is strictly lower), and the
     net-gain rule structurally forbids evicting an equal-or-larger
     volume than the demand needs — disruption is bounded by
-    construction, not by goodwill."""
+    construction, not by goodwill.
+
+    ``cost_of`` (tputopo.elastic) passes through to
+    :func:`plan_migration`: victims priced by checkpoint-charged
+    disruption cost instead of whole runtimes / raw chip volume, so a
+    gang that checkpointed moments ago is the cheap victim however long
+    it has run."""
     if demand_priority <= 0:
         return None  # bottom tier: no strictly-lower victims exist
     if demand[0] * demand[1] <= 1:
@@ -73,4 +80,5 @@ def plan_preemption(state: ClusterState, demand: tuple[int, int],
         max_moves=max_moves, max_chips_moved=max_chips_moved,
         evictable=lambda key: prio.get(key, ko.MAX_PRIORITY_VALUE)
         < demand_priority,
-        require_free_capacity=False)
+        require_free_capacity=False,
+        cost_of=cost_of)

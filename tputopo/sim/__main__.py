@@ -88,14 +88,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--defrag-hysteresis", type=int,
                    default=DEFAULT_DEFRAG["hysteresis"],
                    help="consecutive pressured cycles before acting")
-    p.add_argument("--workload", choices=("standard", "mixed"),
+    p.add_argument("--workload", choices=("standard", "mixed",
+                                          "checkpointed"),
                    default="standard",
                    help="trace class: 'standard' = the single-tenant "
                         "batch vocabulary; 'mixed' = serving-tier "
                         "inference (small k, tight queue-wait SLO, "
                         "diurnal arrivals) interleaved with long "
                         "prod/batch training gangs (tputopo.priority; "
-                        "adds the per-tier block, schema tputopo.sim/v5)")
+                        "adds the per-tier block, schema tputopo.sim/v5); "
+                        "'checkpointed' = the mixed trace with training "
+                        "gangs carrying checkpoint/restore costs and "
+                        "elastic min/max replica bounds "
+                        "(tputopo.elastic)")
     p.add_argument("--slo-wait", type=float, default=None,
                    help="serving-tier queue-wait SLO, virtual seconds "
                         "(mixed workload; default 60)")
@@ -181,6 +186,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "adds the per-policy timeline block (schema "
                         "tputopo.sim/v9).  Off is byte-identical to the "
                         "flag being absent")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic gangs & checkpoint-aware disruption "
+                        "(tputopo.elastic): victims priced by "
+                        "checkpoint-charged cost, planned evictions "
+                        "upgrade to migrations when a destination box "
+                        "exists, checkpointed gangs resume instead of "
+                        "restarting, elastic gangs shrink under pressure "
+                        "and grow back on releases; adds the per-policy "
+                        "disruption block (schema tputopo.sim/v10).  Off "
+                        "is byte-identical to the flag being absent")
     p.add_argument("--out", default=None, help="also write the report here")
     p.add_argument("--no-trace", action="store_true",
                    help="disable the flight recorder (NullTracer hot "
@@ -306,6 +321,7 @@ def main(argv: list[str] | None = None) -> int:
                                    replicas=replicas,
                                    batch=batch,
                                    timeline=args.timeline,
+                                   elastic=args.elastic,
                                    return_states=True)
         prof.disable()
         buf = io.StringIO()
@@ -323,6 +339,7 @@ def main(argv: list[str] | None = None) -> int:
                                    replicas=replicas,
                                    batch=batch,
                                    timeline=args.timeline,
+                                   elastic=args.elastic,
                                    return_states=True)
     # tpulint: disable=determinism -- CLI wall timing feeds the throughput block only
     wall_s = time.perf_counter() - t0

@@ -29,8 +29,10 @@ from tputopo.deviceplugin.reporter import node_object_for_probe
 from tputopo.extender.replicas import DEFAULT_REPLICAS
 from tputopo.discovery.shim import _probe_python, _to_host_probe
 from tputopo.extender.gc import AssumptionGC
+from tputopo.elastic import checkpoint_split, plan_destination
 from tputopo.obs import NULL_TRACER, POINT_BUDGET, TimelineRecorder, bucket_at
 from tputopo.obs import Tracer as ObsTracer
+from tputopo.obs.timeline import ELASTIC_MARK_KINDS
 from tputopo.extender.state import ClusterState, full_sync
 from tputopo.k8s import objects as ko
 from tputopo.k8s.fakeapi import FakeApiServer, NotFound
@@ -38,9 +40,9 @@ from tputopo.priority import backfill_ok, plan_preemption
 from tputopo.defrag.planner import list_pods_nocopy
 from tputopo.sim.policies import get_policy, pods_for_job
 from tputopo.sim.report import (MetricsCollector, batch_block, build_report,
-                                tier_block)
+                                disruption_block, tier_block)
 from tputopo.sim.trace import JobSpec, Trace, TraceConfig, generate_trace
-from tputopo.topology.slices import Allocator, enumerate_shapes
+from tputopo.topology.slices import Allocator, chips_mask, enumerate_shapes
 from tputopo.topology.score import (_box_of, predict_allreduce_gbps,
                                     predict_multidomain_allreduce_gbps,
                                     score_chip_set)
@@ -102,7 +104,8 @@ class _JobRun:
     """Mutable per-job lifecycle state (the trace JobSpec stays frozen)."""
 
     __slots__ = ("spec", "enqueued_t", "incarnation", "chips_held",
-                 "failed_epoch", "handles", "started_t")
+                 "failed_epoch", "handles", "started_t", "progress_s",
+                 "width", "pending_restore", "member_chips")
 
     def __init__(self, spec: JobSpec, enqueued_t: float) -> None:
         self.spec = spec
@@ -117,6 +120,19 @@ class _JobRun:
         # lost-virtual-work accounting reads (run time thrown away when a
         # victim restarts from its queue).
         self.started_t = -1.0
+        # Elastic lifecycle state (tputopo.elastic).  All inert at their
+        # defaults: progress 0 and full width reproduce the pre-elastic
+        # completion arithmetic exactly, so nothing off-path reads them.
+        # ``progress_s`` is committed virtual work in full-width job
+        # seconds (completion when it reaches duration_s); ``width`` is
+        # the current replica count (work rate = width/replicas);
+        # ``pending_restore`` charges the restore surcharge at the next
+        # placement; ``member_chips`` maps each member pod to its ledger
+        # keys so a shrink can free exactly one member's chips.
+        self.progress_s = 0.0
+        self.width = spec.replicas
+        self.pending_restore = False
+        self.member_chips: dict[str, list[tuple[str, tuple]]] = {}
 
 
 def stage_nodes(cfg: TraceConfig,
@@ -217,6 +233,10 @@ class SimEngine:
     # defrag cycle runs last so a same-instant GC sweep or completion is
     # reflected in the state it plans from.
     _COMPLETE, _REPAIR, _FAIL, _ARRIVAL, _GC, _DEFRAG = 0, 1, 2, 3, 4, 5
+    # Elastic migration landing (tputopo.elastic): sorts after every
+    # other same-instant kind — the destination re-place must see the
+    # world the eviction (and anything else at this instant) produced.
+    _MIGRATE = 6
 
     #: Kill switch for the copy-free fakeapi write path (leg 3 of the
     #: fleet hot-path pass): the engine is the single-threaded sole
@@ -274,6 +294,20 @@ class SimEngine:
     #: scheduling, so both directions place identically.
     TIMELINE = True
 
+    #: Kill switch for elastic gangs & checkpoint-aware disruption
+    #: (tputopo.elastic): with the ``elastic`` ctor flag set (CLI
+    #: ``--elastic``) AND this True, victim selection prices gangs by
+    #: checkpoint-charged disruption cost instead of whole runtimes,
+    #: evicted checkpointed gangs resume from their last checkpoint
+    #: (restore surcharge paid, completed virtual work preserved),
+    #: planned evictions upgrade to migrations when a destination box
+    #: exists BEFORE the victim is touched, and elastic gangs shrink by
+    #: one replica under pressure / grow back opportunistically on
+    #: releases.  The report gains the per-policy ``disruption`` block
+    #: (schema v10).  False — or the flag absent — runs every eviction
+    #: and pricing path byte-for-byte as before, schema included.
+    ELASTIC = True
+
     def __init__(self, trace: Trace, policy_name: str, *,
                  assume_ttl_s: float = 60.0, gc_period_s: float = 30.0,
                  max_backfill_failures: int = 8,
@@ -284,6 +318,7 @@ class SimEngine:
                  replicas: dict | None = None,
                  batch: dict | None = None,
                  timeline: bool = False,
+                 elastic: bool = False,
                  audit_every: int = 0) -> None:
         self.trace = trace
         self.cfg = trace.config
@@ -366,11 +401,33 @@ class SimEngine:
         self._ideal_gbps: dict[tuple[str, int], float] = {}
 
         self.metrics = MetricsCollector(self.cfg.total_chips)
+        # Elastic gangs & checkpoint-aware disruption (tputopo.elastic),
+        # opt-in behind the registered ELASTIC kill switch: the stats
+        # dict doubles as the armed flag — None (flag or switch off)
+        # leaves every eviction/pricing path byte-for-byte as before,
+        # and its absent ``disruption`` report block pins every prior
+        # schema's bytes.
+        self.elastic_stats: dict | None = ({
+            "migrations_planned": 0, "migrations_landed": 0,
+            "migration_aborts": {}, "shrinks": 0, "grows": 0,
+            "shrink_chips_freed": 0, "restores": 0, "restore_cost_s": 0.0,
+            "lost_virtual_s": 0.0, "charged_cost_s": 0.0,
+            "preserved_virtual_s": 0.0,
+        } if (elastic and self.ELASTIC) else None)
+        # Lazy per-domain {node: chip mask} for the migration destination
+        # screen and the grow re-place; _grow_epoch gates the grow sweep
+        # to wakes where capacity actually moved.
+        self._elastic_node_masks: dict[str, dict[str, int]] = {}
+        self._grow_epoch = -1
         # Fleet-gauge timeline (tputopo.obs.timeline), opt-in behind the
         # registered TIMELINE kill switch: the recorder doubles as the
         # armed flag — None (flag or switch off) records nothing and its
-        # absent report block pins every prior schema's bytes.
-        self.timeline = (TimelineRecorder()
+        # absent report block pins every prior schema's bytes.  Elastic
+        # runs extend THIS recorder's mark vocabulary (migrate/resize);
+        # the default construction emits the pre-elastic bytes exactly.
+        self.timeline = (TimelineRecorder(
+            extra_marks=(ELASTIC_MARK_KINDS
+                         if self.elastic_stats is not None else ()))
                          if (timeline and self.TIMELINE) else None)
         self.queue: list[_JobRun] = []
         self.jobs: dict[str, _JobRun] = {}
@@ -565,6 +622,12 @@ class SimEngine:
                 max_concurrent=int(knobs["max_concurrent"]),
                 retry_rng=random.Random(0xDEF4),
                 evict=self._defrag_evict,
+                # Checkpoint-charged victim pricing (tputopo.elastic):
+                # a factory, rebuilt per cycle — costs depend on "now".
+                # None when elastic is off keeps the pre-elastic ranking
+                # byte-for-byte.
+                cost_of=(self._victim_cost_of
+                         if self.elastic_stats is not None else None),
                 state_factory=lambda: ClusterState(
                     read_api, assume_ttl_s=assume_ttl_s,
                     clock=self.clock).sync())
@@ -678,6 +741,12 @@ class SimEngine:
             # process boundary as a plain dict.
             timeline=(self.timeline.block()
                       if self.timeline is not None else None),
+            # Elastic disruption block (None with --elastic off or the
+            # ELASTIC switch off — its absence pins the v2–v9 report
+            # bytes).  Shaped here so it ships across the --jobs N
+            # process boundary as a plain dict.
+            disruption=(disruption_block(self.elastic_stats)
+                        if self.elastic_stats is not None else None),
         )
 
     def run_events(self) -> None:
@@ -718,6 +787,8 @@ class SimEngine:
                 self._on_gc()
             elif kind == self._DEFRAG:
                 self._on_defrag()
+            elif kind == self._MIGRATE:
+                self._on_migrate(*payload)
             if kind not in (self._GC, self._DEFRAG):
                 self._substantive_pending -= 1
             if not self._heap and self.queue:
@@ -945,7 +1016,7 @@ class SimEngine:
             run = self.jobs.get(jname)
             if run is None:
                 continue  # completed/reclaimed since the plan was built
-            self._requeue_job(run, "defrag_evict")
+            self._evict(run, "defrag_evict")
 
     def _requeue_job(self, run: _JobRun, reason: str = "other") -> None:
         """THE eviction/requeue path (node failures AND defrag
@@ -962,6 +1033,32 @@ class SimEngine:
         self.metrics.preempt["pods_evicted"] += run.spec.replicas
         self.metrics.preempt["jobs_requeued"] += 1
         self.metrics.counts["evicted_requeues"] += 1
+        st = self.elastic_stats
+        if st is not None and run.started_t >= 0 \
+                and run.spec.name not in self.ghosts:
+            # Checkpoint accounting at the moment of eviction (the same
+            # clock the planners priced at): work since the last whole
+            # checkpoint is lost; the checkpointed prefix survives as
+            # ``progress_s`` and the next placement pays the restore
+            # surcharge.  Non-checkpointed jobs lose everything — the
+            # pre-elastic restart-from-zero, now visible in the tally.
+            spec = run.spec
+            rate = run.width / spec.replicas if spec.replicas else 1.0
+            lost, preserved, charged = checkpoint_split(
+                max(0.0, self.clock.t - run.started_t), rate,
+                run.progress_s, spec.checkpoint_period_s,
+                spec.restore_cost_s)
+            st["lost_virtual_s"] += lost
+            st["charged_cost_s"] += charged
+            if spec.checkpoint_period_s:
+                run.progress_s = preserved
+                run.pending_restore = True
+                st["preserved_virtual_s"] += preserved
+            else:
+                run.progress_s = 0.0
+                run.pending_restore = False
+            run.width = spec.replicas  # requeue recreates every member
+            run.started_t = -1.0
         self._free_job(run)
         self._delete_job_pods(run.spec)
         self.ghosts.pop(run.spec.name, None)
@@ -977,6 +1074,16 @@ class SimEngine:
     # ---- scheduling --------------------------------------------------------
 
     def _try_schedule(self) -> None:
+        self._try_schedule_inner()
+        if (self.elastic_stats is not None and not self.queue
+                and self._grow_epoch != self.capacity_epoch):
+            # Grow-back sweep (tputopo.elastic): only when capacity
+            # moved since the last sweep AND no pending work wants the
+            # chips — queued gangs always outrank opportunistic growth.
+            self._grow_epoch = self.capacity_epoch
+            self._try_grow()
+
+    def _try_schedule_inner(self) -> None:
         # Ghost assumptions past their TTL are ALREADY free in the
         # scheduler's ClusterState view; reap them before placing so the
         # engine's ledger agrees (otherwise a legitimate placement onto
@@ -1437,6 +1544,11 @@ class SimEngine:
         set, chips freed)."""
         spec = run.spec
         knobs = self.preempt_knobs
+        if self.elastic_stats is not None and self._try_shrink(run):
+            # Shrink-instead-of-evict (tputopo.elastic): enough elastic
+            # lower-tier gangs gave up one replica each to free hosts
+            # for the demand — no eviction plan needed, nothing lost.
+            return True
         self._pcount("plans_considered")
         tr = self.tracer.start("preempt", job=spec.name)
         with tr:
@@ -1459,7 +1571,8 @@ class SimEngine:
                     # waived hot-path debt — deleted, not re-worded.
                     self._list_victims(),
                     max_moves=int(knobs["max_moves"]),
-                    max_chips_moved=int(knobs["max_chips_moved"]))
+                    max_chips_moved=int(knobs["max_chips_moved"]),
+                    cost_of=self._victim_cost_of())
                 if plan is not None:
                     sp.count("victims", len(plan.victims))
                     sp.count("chips", plan.chips_moved)
@@ -1516,8 +1629,340 @@ class SimEngine:
                 ts["pods_evicted"] += vrun.spec.replicas
                 ts["chips_moved"] += len(vrun.chips_held)
                 if vrun.started_t >= 0:
-                    ts["lost_virtual_s"] += now - vrun.started_t
-            self._requeue_job(vrun, "preempted")
+                    if self.elastic_stats is not None:
+                        # The tier tally charges ACTUAL destroyed work —
+                        # the same checkpoint arithmetic the planner
+                        # priced this victim by — not the whole runtime.
+                        vspec = vrun.spec
+                        rate = (vrun.width / vspec.replicas
+                                if vspec.replicas else 1.0)
+                        lost, _, _ = checkpoint_split(
+                            max(0.0, now - vrun.started_t), rate,
+                            vrun.progress_s, vspec.checkpoint_period_s,
+                            vspec.restore_cost_s)
+                        ts["lost_virtual_s"] += lost
+                    else:
+                        ts["lost_virtual_s"] += now - vrun.started_t
+            self._evict(vrun, "preempted")
+
+    # ---- elastic gangs & migration (tputopo.elastic) -----------------------
+
+    def _evict(self, run: _JobRun, reason: str) -> None:
+        """THE planned-eviction entry (preemption + defrag — node
+        failures keep the plain requeue: there is nothing to plan around
+        a dead node).  With elastic armed and the victim checkpointed,
+        the eviction upgrades to a migration: the destination box is
+        screened BEFORE the victim is touched, the gang evicts through
+        the shared requeue path (checkpoint progress preserved), and the
+        landing attempt fires after every same-instant event settles —
+        classified as an abort if a race took the destination."""
+        st = self.elastic_stats
+        spec = run.spec
+        if (st is None or not spec.checkpoint_period_s or spec.ghost
+                or spec.multislice or spec.name in self.ghosts):
+            self._requeue_job(run, reason)
+            return
+        tr = self.tracer.start("migrate", job=spec.name)
+        with tr:
+            with tr.phase("plan") as sp:
+                dest = self._plan_migration_dest(spec)
+                if dest is not None:
+                    sp.count("planned", 1)
+            if dest is None:
+                self._requeue_job(run, reason)
+                return
+            st["migrations_planned"] += 1
+            with tr.phase("evict") as sp:
+                self._requeue_job(run, reason)
+                sp.count("pods", spec.replicas)
+            if tr.enabled:
+                tr.explain({"verb": "migrate", "job": spec.name,
+                            "dest": dest, "evict_reason": reason})
+        if self.timeline is not None:
+            self.timeline.mark("migrate")
+        # Landing fires at the SAME virtual instant but after every
+        # already-queued event (kind sorts last): the destination
+        # re-place sees the post-eviction world, and the preemptor —
+        # whose wake continues synchronously — claims its box first.
+        self._push(self.clock.t, self._MIGRATE,
+                   (spec.name, run.incarnation, dest))
+
+    def _elastic_masks(self, sid: str) -> dict[str, int]:
+        """This domain's {node: chip mask}, built once on first use —
+        the mask-native candidate vocabulary the destination screen and
+        the grow re-place walk (failed nodes need no filtering: their
+        chips are blocked in the twin, so free-mask intersections are
+        already empty there)."""
+        masks = self._elastic_node_masks.get(sid)
+        if masks is None:
+            topo = self.domains[sid]
+            masks = {n: chips_mask(topo, self.chips_by_node[n])
+                     for n, d in self.domain_of_node.items() if d == sid}
+            self._elastic_node_masks[sid] = masks
+        return masks
+
+    def _plan_migration_dest(self, spec: JobSpec) -> str | None:
+        """The destination domain for a would-be migrant, screened
+        against CURRENT free capacity (the victim's own chips are still
+        held — a migration must not depend on the space it vacates)."""
+        return plan_destination(
+            spec.replicas, spec.chips,
+            [(sid, self.twin[sid], self._elastic_masks(sid))
+             for sid in sorted(self.twin)])
+
+    def _migrate_abort(self, reason: str) -> None:
+        ab = self.elastic_stats["migration_aborts"]
+        ab[reason] = ab.get(reason, 0) + 1
+
+    def _on_migrate(self, name: str, incarnation: int, dest: str) -> None:
+        """The migration landing: re-place the evicted gang through the
+        production policy path (same sort/bind/ledger invariants as any
+        placement).  Aborts are classified, never silent: the victim
+        completed or re-incarnated (``victim_gone``), something else
+        already placed it (``superseded``), the planned destination was
+        raced away (``destination_lost``), or placement failed with the
+        destination still standing (``place_failed`` — e.g. an injected
+        fault, or the screen's necessary condition was not sufficient).
+        An aborted migrant stays queued — ordinary wakes retry it."""
+        run = self.jobs.get(name)
+        if run is None or run.incarnation != incarnation:
+            self._migrate_abort("victim_gone")
+            return
+        if not any(r is run for r in self.queue):
+            self._migrate_abort("superseded")
+            return
+        spec = run.spec
+        tr = self.tracer.start("migrate", job=name)
+        with tr:
+            with tr.phase("land") as sp:
+                alive = [n for n in self.node_names
+                         if n not in self.failed_nodes]
+                decisions = self.policy.place(spec, alive,
+                                              handles=run.handles)
+                if decisions is None:
+                    reason = getattr(self.policy, "last_none_reason", None)
+                    self._migrate_abort(
+                        "destination_lost"
+                        if self._plan_migration_dest(spec) is None
+                        else "place_failed")
+                    self._note_place_failure(run, reason)
+                    return
+                sp.count("pods", len(decisions))
+            self._commit(run, decisions)
+            self.queue = [r for r in self.queue if r is not run]
+            self.elastic_stats["migrations_landed"] += 1
+            if tr.enabled:
+                tr.explain({"verb": "migrate", "job": name, "dest": dest,
+                            "landed": True})
+        self._sample_occupancy()
+
+    def _victim_cost_of(self):
+        """The per-plan victim-pricing callable for the defrag/
+        preemption planners (None when elastic is off — the pre-elastic
+        ranking byte-for-byte): planner victim key -> (checkpoint-
+        charged disruption seconds, ACTUAL destroyed work volume in
+        chips), read straight off the engine's own run ledger — exact
+        progress and width, no annotation parsing.  Both key
+        vocabularies are indexed (gang-id for annotated gangs, per-pod
+        for policies that bind without the gang annotation); an unknown
+        key fails CLOSED at a cost no real victim can reach."""
+        if self.elastic_stats is None:
+            return None
+        now = self.clock.t
+        index: dict[str, _JobRun] = {}
+        for jname, jr in self.jobs.items():
+            if not jr.chips_held:
+                continue
+            index[f"default/{jname}"] = jr
+            for m in range(jr.spec.replicas):
+                index[f"default/{jname}-{m}"] = jr
+
+        def cost_of(key: str, chips_held: int) -> tuple[float, float]:
+            jr = index.get(key)
+            if jr is None:
+                return (1e18, float(chips_held))  # fail closed
+            spec = jr.spec
+            rate = jr.width / spec.replicas if spec.replicas else 1.0
+            run_s = (max(0.0, now - jr.started_t)
+                     if jr.started_t >= 0 else 0.0)
+            lost, preserved, charged = checkpoint_split(
+                run_s, rate, jr.progress_s,
+                spec.checkpoint_period_s, spec.restore_cost_s)
+            total = lost + preserved
+            if not spec.checkpoint_period_s or total <= 0.0:
+                destroyed = float(chips_held)
+            else:
+                # Only the work-bearing fraction of the victim's chips
+                # counts against the net-gain budget: a gang that
+                # checkpointed moments ago destroys almost nothing.
+                destroyed = chips_held * (lost / total)
+            return (charged, destroyed)
+
+        return cost_of
+
+    def _try_shrink(self, run: _JobRun) -> bool:
+        """Shrink-by-one-replica as the cheapest victim action: when
+        enough elastic strictly-lower-tier gangs can each give up one
+        member in a single domain to free the hosts the demand is
+        short, take those instead of evicting anyone — no virtual work
+        is lost at all (progress commits at the old rate).  A shrunk
+        member only provably frees a usable host when it held at least
+        the demand's per-member chips; domains are tried cheapest-first
+        (fewest shrinks needed)."""
+        spec = run.spec
+        by_dom: dict[str, list[_JobRun]] = {}
+        for jname in sorted(self.jobs):
+            jr = self.jobs[jname]
+            js = jr.spec
+            if (js.min_replicas < 1 or jr.width <= max(js.min_replicas, 1)
+                    or jr.started_t < 0 or not jr.chips_held
+                    or js.priority >= spec.priority or js.ghost
+                    or jname in self.ghosts or js.chips < spec.chips
+                    or not jr.member_chips):
+                continue
+            by_dom.setdefault(jr.chips_held[0][0], []).append(jr)
+        best: tuple[int, str] | None = None
+        for sid in sorted(by_dom):
+            free = self.twin[sid].free_mask
+            have = sum(1 for m in self._elastic_masks(sid).values()
+                       if (m & free).bit_count() >= spec.chips)
+            need = spec.replicas - have
+            if need <= 0:
+                # Capacity already suffices by count — the failure is
+                # geometry/policy, and shrinking cannot provably fix it.
+                continue
+            if need <= len(by_dom[sid]) and (best is None
+                                             or need < best[0]):
+                best = (need, sid)
+        if best is None:
+            return False
+        need, sid = best
+        # Lowest tier loses a replica first; name breaks ties.
+        cands = sorted(by_dom[sid],
+                       key=lambda jr: (jr.spec.priority, jr.spec.name))
+        for jr in cands[:need]:
+            self._shrink_member(jr)
+        self.capacity_epoch += 1
+        self._wm_invalidate()
+        self._sample_occupancy()
+        return True
+
+    def _shrink_member(self, jr: _JobRun) -> None:
+        """Drop one member (the highest-indexed) from a running elastic
+        gang: commit progress at the old rate, free exactly that
+        member's chips, and re-key the completion on a fresh
+        incarnation (the stale event no-ops on the incarnation guard)."""
+        spec = jr.spec
+        now = self.clock.t
+        pod = f"{spec.name}-{jr.width - 1}"
+        keys = jr.member_chips.pop(pod, [])
+        if jr.started_t >= 0:
+            jr.progress_s += max(0.0, now - jr.started_t) \
+                * jr.width / spec.replicas
+        freed = 0
+        by_dom: dict[str, list[tuple]] = {}
+        for key in keys:
+            if self.ledger.pop(key, None) is not None:
+                by_dom.setdefault(key[0], []).append(key[1])
+                self.placed_chips -= 1
+                freed += 1
+        for sid, chips in by_dom.items():
+            self._twin_release(sid, chips)
+        dropped = set(keys)
+        jr.chips_held = [k for k in jr.chips_held if k not in dropped]
+        try:
+            self.api.delete("pods", pod, "default")
+            self.policy.invalidate(events=[
+                ("pods", "DELETED",
+                 {"metadata": {"name": pod, "namespace": "default"}})])
+        except NotFound:
+            pass
+        jr.width -= 1
+        jr.started_t = now
+        jr.incarnation += 1
+        remaining = max(0.0, spec.duration_s - jr.progress_s)
+        self._push(now + remaining * spec.replicas / jr.width,
+                   self._COMPLETE, (spec.name, jr.incarnation))
+        st = self.elastic_stats
+        st["shrinks"] += 1
+        st["shrink_chips_freed"] += freed
+        if self.timeline is not None:
+            self.timeline.mark("resize")
+
+    def _try_grow(self) -> None:
+        """Opportunistic grow-back on release events: every shrunk
+        elastic gang regains at most ONE member per wake (pressure can
+        return any moment — ratchet gently), through a real twin
+        placement on a single host of the gang's own domain and a bound
+        pod carrying the full bind annotation vocabulary, so the
+        policy's derived state folds it like any other bind."""
+        grew = False
+        for jname in sorted(self.jobs):
+            jr = self.jobs[jname]
+            spec = jr.spec
+            if (spec.min_replicas < 1 or jr.width >= spec.replicas
+                    or jr.started_t < 0 or spec.ghost
+                    or jname in self.ghosts or not jr.chips_held):
+                continue
+            if self._grow_member(jr):
+                grew = True
+        if grew:
+            self._sample_occupancy()
+
+    def _grow_member(self, jr: _JobRun) -> bool:
+        spec = jr.spec
+        sid = jr.chips_held[0][0]
+        alloc = self.twin[sid]
+        free = alloc.free_mask
+        placement = node = None
+        for n in sorted(self._elastic_masks(sid)):
+            nmask = self._elastic_masks(sid)[n]
+            if (nmask & free).bit_count() < spec.chips:
+                continue
+            placement = alloc.find(spec.chips, free_mask=nmask & free,
+                                   within_mask=nmask)
+            if placement is not None:
+                node = n
+                break
+        if placement is None:
+            return False
+        now = self.clock.t
+        m = jr.width
+        pod_name = f"{spec.name}-{m}"
+        chips = [tuple(c) for c in placement.chips]
+        keys = [(sid, c) for c in chips]
+        for key in keys:
+            holder = self.ledger.get(key)
+            if holder is not None:  # twin raced — refuse, never corrupt
+                return False
+        anns = {ko.ANN_GROUP: ko.coords_to_ann(chips),
+                ko.ANN_ASSUME_TIME: str(now),
+                ko.ANN_ASSIGNED: "true"}
+        if spec.replicas > 1:
+            anns[ko.ANN_GANG_ID] = spec.name
+        pod = ko.make_pod(pod_name, chips=spec.chips,
+                          annotations=anns, node_name=node)
+        self.api.create("pods", pod)
+        self.policy.invalidate(events=[("pods", "ADDED", pod)])
+        for key in keys:
+            self.ledger[key] = spec.name
+        jr.chips_held.extend(keys)
+        jr.member_chips[pod_name] = keys
+        self._twin_mark(sid, chips)
+        self.placed_chips += len(chips)
+        if jr.started_t >= 0:
+            jr.progress_s += max(0.0, now - jr.started_t) \
+                * jr.width / spec.replicas
+        jr.width += 1
+        jr.started_t = now
+        jr.incarnation += 1
+        remaining = max(0.0, spec.duration_s - jr.progress_s)
+        self._push(now + remaining * spec.replicas / jr.width,
+                   self._COMPLETE, (spec.name, jr.incarnation))
+        self.elastic_stats["grows"] += 1
+        if self.timeline is not None:
+            self.timeline.mark("resize")
+        return True
 
     def _reset_if_partially_bound(self, run: _JobRun) -> None:
         """Defensive: a policy returning None must leave no member bound;
@@ -1593,6 +2038,14 @@ class SimEngine:
                                    contiguous)
         self.metrics.job_scheduled(now - run.enqueued_t)
         run.started_t = now
+        if self.elastic_stats is not None:
+            # Member -> ledger keys, in decision order: what a later
+            # shrink needs to free exactly one member's chips.  Width
+            # is full at every commit (requeues recreate all members).
+            run.member_chips = {
+                d["pod"]: [(d["slice"], tuple(c)) for c in d["chips"]]
+                for d in decisions}
+            run.width = spec.replicas
         if self.tier_stats is not None:
             ts = self._tier(spec)
             ts["scheduled"] += 1
@@ -1609,7 +2062,19 @@ class SimEngine:
             for d in decisions:
                 self.api.patch_annotations(
                     "pods", d["pod"], {ko.ANN_ASSIGNED: "true"}, "default")
-            self._push(now + spec.duration_s, self._COMPLETE,
+            dur = spec.duration_s
+            if self.elastic_stats is not None and (
+                    run.progress_s > 0.0 or run.pending_restore):
+                # Resume-from-checkpoint: only the unfinished work is
+                # owed, plus the restore surcharge for this placement.
+                dur = max(0.0, dur - run.progress_s)
+                if run.pending_restore:
+                    extra = spec.restore_cost_s or 0.0
+                    dur += extra
+                    run.pending_restore = False
+                    self.elastic_stats["restores"] += 1
+                    self.elastic_stats["restore_cost_s"] += extra
+            self._push(now + dur, self._COMPLETE,
                        (spec.name, run.incarnation))
 
     # ---- bookkeeping -------------------------------------------------------
@@ -1633,6 +2098,7 @@ class SimEngine:
         for sid, chips in by_dom.items():
             self._twin_release(sid, chips)
         run.chips_held = []
+        run.member_chips = {}
         self.capacity_epoch += 1
 
     def _delete_job_pods(self, spec: JobSpec) -> None:
@@ -1706,14 +2172,15 @@ class RunState:
                  "placed_chips", "frag", "counters", "events_processed",
                  "phases", "phase_wall_ms", "decision_log", "defrag",
                  "chaos", "tiers", "preempt", "replicas", "batch",
-                 "watermark", "timeline")
+                 "watermark", "timeline", "disruption")
 
     def __init__(self, *, policy_name, horizon_s, end_t, metrics,
                  placed_chips, frag, counters, events_processed,
                  phases=None, phase_wall_ms=None,
                  decision_log=None, defrag=None, chaos=None,
                  tiers=None, preempt=None, replicas=None,
-                 batch=None, watermark=None, timeline=None) -> None:
+                 batch=None, watermark=None, timeline=None,
+                 disruption=None) -> None:
         self.policy_name = policy_name
         self.horizon_s = horizon_s
         self.end_t = end_t
@@ -1733,6 +2200,7 @@ class RunState:
         self.batch = batch
         self.watermark = watermark
         self.timeline = timeline
+        self.disruption = disruption
 
 
 def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
@@ -1793,6 +2261,13 @@ def finalize_run_state(rs: RunState, horizon_s: float) -> dict:
         # recorder: a pure function of the virtual-time sample stream,
         # part of the byte-determinism contract.
         out["timeline"] = rs.timeline
+    if rs.disruption is not None:
+        # Elastic disruption accounting (schema tputopo.sim/v10,
+        # tputopo.elastic) — present only under --elastic with the
+        # ELASTIC switch on; its absence pins every prior schema's
+        # report bytes.  Migrations/resizes/restores plus the
+        # lost-vs-charged-vs-preserved virtual-work ledger.
+        out["disruption"] = rs.disruption
     return out
 
 
@@ -1845,12 +2320,12 @@ def _run_policy_worker(args) -> RunState:
     pinned by tests) so nothing heavyweight crosses the process boundary
     in either direction."""
     (cfg, name, assume_ttl_s, gc_period_s, flight_trace, defrag, chaos,
-     preempt, replicas, batch, timeline) = args
+     preempt, replicas, batch, timeline, elastic) = args
     engine = SimEngine(generate_trace(cfg), name,
                        assume_ttl_s=assume_ttl_s, gc_period_s=gc_period_s,
                        flight_trace=flight_trace, defrag=defrag,
                        chaos=chaos, preempt=preempt, replicas=replicas,
-                       batch=batch, timeline=timeline)
+                       batch=batch, timeline=timeline, elastic=elastic)
     engine.run_events()
     return engine.run_state()
 
@@ -1864,6 +2339,7 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
               replicas: dict | None = None,
               batch: dict | None = None,
               timeline: bool = False,
+              elastic: bool = False,
               return_states: bool = False):
     """Replay one deterministic trace under each policy and build the
     A/B report.  Every policy sees the identical event stream.
@@ -1934,7 +2410,19 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
     side's timeline bucket at the divergence point, the point budget is
     recorded under ``engine.timeline``, and the schema becomes
     ``tputopo.sim/v9``.  False — or the switch off — keeps every prior
-    shape byte-for-byte."""
+    shape byte-for-byte.
+
+    ``elastic`` (CLI ``--elastic``, behind the registered
+    ``SimEngine.ELASTIC`` kill switch) arms elastic gangs &
+    checkpoint-aware disruption (tputopo.elastic) in every engine:
+    victims are priced by checkpoint-charged cost, planned evictions
+    upgrade to migrations when a destination exists, checkpointed gangs
+    resume instead of restarting, and elastic gangs shrink under
+    pressure / grow back on releases.  Each policy record gains the
+    deterministic ``disruption`` block, the flag lands under
+    ``engine.elastic``, and the schema becomes ``tputopo.sim/v10``.
+    False — or the switch off — keeps every prior shape
+    byte-for-byte."""
     # tpulint: disable=determinism -- throughput.wall_s is the documented wall-clock exception
     t0 = time.perf_counter()
     defrag_knobs = ({**DEFAULT_DEFRAG, **defrag}
@@ -1950,9 +2438,10 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
                    if (batch is not None and SimEngine.BATCH_ADMISSION)
                    else None)
     timeline_on = bool(timeline) and SimEngine.TIMELINE
+    elastic_on = bool(elastic) and SimEngine.ELASTIC
     work = [(cfg, name, assume_ttl_s, gc_period_s, flight_trace,
              defrag_knobs, chaos, preempt_knobs, replica_knobs,
-             batch_knobs, timeline_on)
+             batch_knobs, timeline_on, elastic_on)
             for name in policy_names]
     if jobs > 1 and len(work) > 1:
         import multiprocessing as mp
@@ -2015,6 +2504,12 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
         # content; recorded like the other feature knobs and absent on
         # timeline-off runs so prior schema bytes stay pinned.
         engine_params["timeline"] = {"points_budget": POINT_BUDGET}
+    if elastic_on:
+        # The elastic arming record — same rule as the other feature
+        # knobs; absent on elastic-off runs so prior schema bytes stay
+        # pinned.  (The checkpoint/elastic knobs themselves live in the
+        # trace config — they shape the workload, not the engine.)
+        engine_params["elastic"] = {"enabled": True}
     report = build_report(
         cfg.describe(), horizon, policies,
         engine_params=engine_params,
@@ -2035,6 +2530,10 @@ def run_trace(cfg: TraceConfig, policy_names: list[str], *,
         # (--timeline AND the TIMELINE switch) — the same condition that
         # makes the per-policy `timeline` block appear.
         schema_timeline=timeline_on,
+        # v10 exactly when the engines armed elastic disruption
+        # (--elastic AND the ELASTIC switch) — the same condition that
+        # makes the per-policy `disruption` block appear.
+        schema_elastic=elastic_on,
         throughput={
             "events": events,  # deterministic
             "wall_s": round(wall_s, 3),

@@ -53,7 +53,17 @@ def pods_for_job(job: JobSpec) -> list[dict]:
         # trace used.  Absent at priority 0 — batch pods are
         # byte-identical to the pre-priority vocabulary.
         labels[ko.LABEL_PRIORITY] = str(ko.parse_priority(job.priority))
-    return [ko.make_pod(f"{job.name}-{m}", chips=job.chips, labels=labels)
+    anns = {}
+    if job.checkpoint_period_s:
+        # Checkpoint cost annotations (tputopo.elastic): what the
+        # extender's /debug/preempt and /debug/migrate dry-runs price
+        # victims by.  Stamped only when the trace carries them — prior
+        # workloads keep the pre-elastic pod vocabulary byte-for-byte.
+        anns[ko.ANN_CKPT_PERIOD] = str(job.checkpoint_period_s)
+        if job.restore_cost_s:
+            anns[ko.ANN_RESTORE_COST] = str(job.restore_cost_s)
+    return [ko.make_pod(f"{job.name}-{m}", chips=job.chips, labels=labels,
+                        annotations=anns or None)
             for m in range(job.replicas)]
 
 

@@ -151,6 +151,14 @@ SCHEMA_WATERMARK = "tputopo.sim/v8"
 #: of the virtual-time sample stream — part of the byte-determinism
 #: contract.
 SCHEMA_TIMELINE = "tputopo.sim/v9"
+#: v10 = the above plus the per-policy ``disruption`` block
+#: (tputopo.elastic): migrations planned/landed with classified abort
+#: reasons, shrink/grow resize counts, restore count/cost, and the
+#: lost-vs-charged virtual-work ledger (what evictions actually
+#: destroyed vs what the checkpoint cost model billed) — emitted ONLY
+#: when ``--elastic`` requested it AND the SimEngine.ELASTIC switch is
+#: on.  Elastic-off runs keep emitting the v2..v9 shapes byte-for-byte.
+SCHEMA_ELASTIC = "tputopo.sim/v10"
 
 #: The pinned schema-key manifest: which top-level report keys and
 #: per-policy record keys each schema version emits, and which of them
@@ -181,6 +189,7 @@ SCHEMA_KEY_MANIFEST = {
     "tputopo.sim/v7": {"policy_gated": ("batch",)},
     "tputopo.sim/v8": {"policy_gated": ("watermark",)},
     "tputopo.sim/v9": {"policy_gated": ("timeline",)},
+    "tputopo.sim/v10": {"policy_gated": ("disruption",)},
 }
 
 #: The extender counters the report's per-policy ``scheduler`` block
@@ -412,6 +421,38 @@ def batch_block(stats: dict) -> dict:
     }
 
 
+def disruption_block(stats: dict) -> dict:
+    """Shape the engine's elastic tallies into the report's
+    ``disruption`` block (schema v10, tputopo.elastic): the migration
+    verb's plan/land/abort traffic (aborts keyed by classified reason,
+    sorted), resize activity by direction, the restore bill, and the
+    virtual-work ledger — ``lost_virtual_s`` is what evictions actually
+    destroyed (work since the last checkpoint), ``charged_cost_s`` what
+    the cost model billed the planners (lost + restores), and
+    ``preserved_virtual_s`` the checkpointed progress carried across
+    requeues instead of burned."""
+    return {
+        "migrations": {
+            "planned": stats["migrations_planned"],
+            "landed": stats["migrations_landed"],
+            "aborts": {k: stats["migration_aborts"][k]
+                       for k in sorted(stats["migration_aborts"])},
+        },
+        "resizes": {
+            "shrink": stats["shrinks"],
+            "grow": stats["grows"],
+            "chips_freed_by_shrink": stats["shrink_chips_freed"],
+        },
+        "restores": {
+            "count": stats["restores"],
+            "cost_s": _r(stats["restore_cost_s"]),
+        },
+        "lost_virtual_s": _r(stats["lost_virtual_s"]),
+        "charged_cost_s": _r(stats["charged_cost_s"]),
+        "preserved_virtual_s": _r(stats["preserved_virtual_s"]),
+    }
+
+
 #: Scalar extractors for the A/B delta block: name -> path into a policy
 #: record.  Deltas are first-listed-policy minus each comparator.
 _DELTA_AXES = {
@@ -453,9 +494,11 @@ def build_report(trace_desc: dict, horizon_s: float,
                  schema_replicas: bool = False,
                  schema_batch: bool = False,
                  schema_watermark: bool = False,
-                 schema_timeline: bool = False) -> dict:
+                 schema_timeline: bool = False,
+                 schema_elastic: bool = False) -> dict:
     out = {
-        "schema": (SCHEMA_TIMELINE if schema_timeline
+        "schema": (SCHEMA_ELASTIC if schema_elastic
+                   else SCHEMA_TIMELINE if schema_timeline
                    else SCHEMA_WATERMARK if schema_watermark
                    else SCHEMA_BATCH if schema_batch
                    else SCHEMA_REPLICAS if schema_replicas

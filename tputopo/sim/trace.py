@@ -17,7 +17,7 @@ tests/test_sim.py).
 from __future__ import annotations
 
 import math
-from dataclasses import asdict, dataclass, field
+from dataclasses import asdict, dataclass, field, replace
 
 import numpy as np
 
@@ -43,6 +43,20 @@ class JobSpec:
     # Queue-wait SLO, virtual seconds (0 = none): a scheduled job meets
     # its SLO when wait <= slo_wait_s — the per-tier attainment figure.
     slo_wait_s: float = 0.0
+    # ---- checkpoint + elasticity declaration (tputopo.elastic) --------
+    # checkpoint_period_s: the job writes a full checkpoint every this
+    # many wall seconds of running; an eviction destroys only the work
+    # since the last one (plus restore_cost_s on resume).  None == never
+    # checkpoints == the whole run is lost on eviction — the pre-elastic
+    # accounting, byte-for-byte, which pins all prior trace bytes.
+    checkpoint_period_s: float | None = None
+    restore_cost_s: float | None = None
+    # Elastic width bounds: a gang with min_replicas >= 1 may shrink to
+    # that width under pressure (freeing whole members instead of being
+    # evicted) and grow back toward max_replicas on release events.
+    # 0/0 (the default) == rigid — the entire pre-elastic vocabulary.
+    min_replicas: int = 0
+    max_replicas: int = 0
 
     @property
     def total_chips(self) -> int:
@@ -109,6 +123,18 @@ class TraceConfig:
     diurnal_amp: float = 0.6          # peak-to-mean modulation (0..1)
     train_duration_factor: float = 2.0  # training mean = factor x duration_mean_s
     prod_train_frac: float = 0.25  # training jobs at the prod (50) tier
+    # ---- checkpointed workload (tputopo.elastic) -----------------------
+    # "checkpointed" is the mixed stream with checkpoint/elasticity
+    # declarations stamped onto the training gangs (serving stays rigid
+    # and un-checkpointed): ckpt_frac of training jobs checkpoint every
+    # ~ckpt_period_mean_s with a ~ckpt_restore_mean_s restore bill, and
+    # elastic_frac of THOSE are resizable down to half width.  The knobs
+    # are dropped from describe() on other workloads so every prior
+    # report's bytes stay pinned.
+    ckpt_frac: float = 0.8
+    ckpt_period_mean_s: float = 120.0
+    ckpt_restore_mean_s: float = 15.0
+    elastic_frac: float = 0.5
 
     def __post_init__(self) -> None:
         if self.offered_load is not None:
@@ -178,10 +204,18 @@ class TraceConfig:
                     "diurnal_period_s", "diurnal_amp",
                     "train_duration_factor", "prod_train_frac")
 
+    #: The checkpointed-workload knobs, present in describe() only when
+    #: workload == "checkpointed" (same absent-when-off rule).
+    _CKPT_KNOBS = ("ckpt_frac", "ckpt_period_mean_s",
+                   "ckpt_restore_mean_s", "elastic_frac")
+
     def describe(self) -> dict:
         d = asdict(self)
         if self.workload == "standard":
             for k in self._MIXED_KNOBS:
+                d.pop(k, None)
+        if self.workload != "checkpointed":
+            for k in self._CKPT_KNOBS:
                 d.pop(k, None)
         if self.offered_load is None:
             # Absent when unset (same rule as the mixed knobs): every
@@ -329,18 +363,53 @@ def _generate_mixed(cfg: TraceConfig, rng: np.random.Generator) -> list[JobSpec]
     return jobs
 
 
+def _decorate_checkpointed(cfg: TraceConfig, rng: np.random.Generator,
+                           jobs: list[JobSpec]) -> list[JobSpec]:
+    """Stamp checkpoint/elasticity declarations onto the mixed stream's
+    training gangs (the ``checkpointed`` workload).  Serving jobs stay
+    rigid and un-checkpointed — a latency tier neither checkpoints nor
+    shrinks.  Draw order is fixed (one block of four arrays AFTER the
+    mixed draws), so the stream stays byte-deterministic per config."""
+    from tputopo.k8s.objects import PRIORITY_TIERS
+
+    n = max(len(jobs), 1)
+    ckpt = rng.random(n) < min(max(cfg.ckpt_frac, 0.0), 1.0)
+    periods = rng.lognormal(math.log(max(cfg.ckpt_period_mean_s, 1e-9)),
+                            0.5, n)
+    restores = rng.lognormal(math.log(max(cfg.ckpt_restore_mean_s, 1e-9)),
+                             0.5, n)
+    elastic = rng.random(n) < min(max(cfg.elastic_frac, 0.0), 1.0)
+    serving_tier = PRIORITY_TIERS["serving"]
+    out: list[JobSpec] = []
+    for i, job in enumerate(jobs):
+        if job.priority == serving_tier or not ckpt[i]:
+            out.append(job)
+            continue
+        kw: dict = {
+            "checkpoint_period_s": round(float(periods[i]), 6),
+            "restore_cost_s": round(float(restores[i]), 6),
+        }
+        if elastic[i] and job.replicas > 1:
+            kw["min_replicas"] = max(1, job.replicas // 2)
+            kw["max_replicas"] = job.replicas
+        out.append(replace(job, **kw))
+    return out
+
+
 def generate_trace(cfg: TraceConfig) -> Trace:
     """The deterministic trace for ``cfg`` — one Philox stream, consumed in
     a fixed order, so equal configs give byte-equal traces."""
     rng = cfg.rng()
-    if cfg.workload == "mixed":
+    if cfg.workload in ("mixed", "checkpointed"):
         jobs_mixed = _generate_mixed(cfg, rng)
+        if cfg.workload == "checkpointed":
+            jobs_mixed = _decorate_checkpointed(cfg, rng, jobs_mixed)
         horizon = jobs_mixed[-1].arrival_s if jobs_mixed else 0.0
         return Trace(config=cfg, jobs=tuple(jobs_mixed),
                      node_events=tuple(_node_events(cfg, rng, horizon)))
     if cfg.workload != "standard":
         raise ValueError(f"unknown workload {cfg.workload!r} "
-                         "(want 'standard' or 'mixed')")
+                         "(want 'standard', 'mixed' or 'checkpointed')")
     times = _arrival_times(cfg, rng)
     kinds = rng.choice(4, size=cfg.arrivals,
                        p=np.asarray(cfg.job_mix) / sum(cfg.job_mix))
